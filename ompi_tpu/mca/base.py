@@ -1,0 +1,140 @@
+"""Framework / Component / Module plugin architecture.
+
+The load-bearing structural idea carried over from Open MPI's MCA
+(ref: opal/mca/base/mca_base_framework.h:166+, opal/mca/mca.h:267-321,
+opal/mca/base/mca_base_components_select.c): everything pluggable is a
+
+    framework  — a fixed interface (e.g. "coll", "btl", "pml")
+    component  — an implementation of that interface, discovered at
+                 import time, with a priority and a query function
+    module     — a per-use instance (per-communicator coll module,
+                 per-peer btl endpoint set, ...)
+
+Selection is priority-based and user-overridable through the variable
+registry: ``--mca <framework> <comma-list>`` restricts/reorders the
+candidate components exactly like the reference's include/exclude
+lists (a leading ``^`` excludes).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from .params import registry
+
+
+class Component:
+    """Base class for components.  Subclasses set ``name`` and
+    ``priority`` and implement ``query`` / framework-specific hooks."""
+
+    name: str = "base"
+    priority: int = 0
+
+    def __init__(self) -> None:
+        self.enabled = True
+
+    def register_params(self, framework: "Framework") -> None:
+        """Register this component's MCA variables."""
+
+    def query(self, *args: Any, **kwargs: Any) -> Optional[Tuple[int, Any]]:
+        """Return (priority, module_or_payload) or None if unusable
+        in this context.  Mirrors mca_base_components_select's
+        per-component query round."""
+        return (self.priority, self)
+
+
+class Framework:
+    """A named registry of components with open/close lifecycle and
+    priority selection (ref: mca_base_framework_{register,open,close})."""
+
+    def __init__(self, project: str, name: str) -> None:
+        self.project = project
+        self.name = name
+        self._components: Dict[str, Component] = {}
+        self._opened = False
+        self._lock = threading.RLock()
+        self.verbose_var = registry.register(
+            name, "base", "verbose", 0, int,
+            help=f"Verbosity level for the {name} framework")
+        self.select_var = registry.register(
+            name, "", "", "", str,
+            help=f"Comma-list of {name} components to allow "
+                 "(leading ^ excludes the list instead)")
+
+    def add_component(self, component: Component) -> Component:
+        with self._lock:
+            self._components[component.name] = component
+            component.register_params(self)
+        return component
+
+    def component(self, name: str) -> Optional[Component]:
+        return self._components.get(name)
+
+    def components(self) -> List[Component]:
+        """Components permitted by the user's include/exclude list."""
+        spec = registry.get(f"{self.name}", "") or ""
+        comps = list(self._components.values())
+        if spec:
+            if spec.startswith("^"):
+                excluded = set(spec[1:].split(","))
+                comps = [c for c in comps if c.name not in excluded]
+            else:
+                included = [s for s in spec.split(",") if s]
+                comps = [self._components[n] for n in included
+                         if n in self._components]
+        return [c for c in comps if c.enabled]
+
+    def select_one(self, *args: Any, **kwargs: Any) -> Tuple[Component, Any]:
+        """Pick the single highest-priority component whose query
+        succeeds (the pml model: exactly one engine per process,
+        ref: mca_pml_base_select, ompi_mpi_init.c:640)."""
+        best: Optional[Tuple[int, Component, Any]] = None
+        for comp in self.components():
+            res = comp.query(*args, **kwargs)
+            if res is None:
+                continue
+            pri, payload = res
+            if best is None or pri > best[0]:
+                best = (pri, comp, payload)
+        if best is None:
+            raise RuntimeError(
+                f"No usable component found for framework '{self.name}'")
+        return best[1], best[2]
+
+    def select_all(self, *args: Any, **kwargs: Any) -> List[Tuple[int, Component, Any]]:
+        """All usable components, highest priority first (the coll
+        model: modules stack per communicator,
+        ref: coll_base_comm_select.c:128-151)."""
+        out: List[Tuple[int, Component, Any]] = []
+        for comp in self.components():
+            res = comp.query(*args, **kwargs)
+            if res is None:
+                continue
+            pri, payload = res
+            out.append((pri, comp, payload))
+        out.sort(key=lambda t: -t[0])
+        return out
+
+
+class FrameworkRegistry:
+    """All frameworks in the process, for introspection (ompi_info)."""
+
+    def __init__(self) -> None:
+        self._frameworks: Dict[str, Framework] = {}
+
+    def create(self, project: str, name: str) -> Framework:
+        fw = self._frameworks.get(name)
+        if fw is None:
+            fw = Framework(project, name)
+            self._frameworks[name] = fw
+        return fw
+
+    def get(self, name: str) -> Optional[Framework]:
+        return self._frameworks.get(name)
+
+    def all(self) -> List[Framework]:
+        return sorted(self._frameworks.values(), key=lambda f: (f.project, f.name))
+
+
+frameworks = FrameworkRegistry()

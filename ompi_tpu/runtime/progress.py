@@ -1,0 +1,106 @@
+"""Progress engine: the framework's hot polling loop.
+
+Re-design of opal_progress (ref: opal/runtime/opal_progress.c:183-243)
+plus the wait_sync completion primitive used by MPI_Wait
+(ref: opal/threads/wait_sync.h:27,40,79-82).
+
+Every rank owns one ``Progress``.  Transports and nonblocking
+collective schedules register callbacks; blocking waits spin on
+``progress()``.  High-priority callbacks fire every call; low-priority
+callbacks every 8th call (the reference's opal_progress_lp_call_ratio
+idea).  An optional idle yield keeps oversubscribed thread-ranks and
+oversubscribed local processes fair, mirroring opal_progress_yield.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List
+
+from ompi_tpu.mca.params import registry
+
+_yield_var = registry.register(
+    "opal", "progress", "yield_when_idle", True, bool,
+    help="Call sched_yield (time.sleep(0)) when a progress sweep "
+         "finds no events")
+_lp_ratio_var = registry.register(
+    "opal", "progress", "lp_call_ratio", 8, int,
+    help="Low-priority callbacks run every Nth progress call")
+
+
+class Progress:
+    def __init__(self) -> None:
+        self._callbacks: List[Callable[[], int]] = []
+        self._lp_callbacks: List[Callable[[], int]] = []
+        self._counter = 0
+        self._lock = threading.Lock()
+
+    def register(self, cb: Callable[[], int], low_priority: bool = False) -> None:
+        with self._lock:
+            if low_priority:
+                self._lp_callbacks.append(cb)
+            else:
+                self._callbacks.append(cb)
+
+    def unregister(self, cb: Callable[[], int]) -> None:
+        with self._lock:
+            if cb in self._callbacks:
+                self._callbacks.remove(cb)
+            if cb in self._lp_callbacks:
+                self._lp_callbacks.remove(cb)
+
+    def progress(self) -> int:
+        """One sweep; returns number of events completed."""
+        self._counter += 1
+        events = 0
+        for cb in list(self._callbacks):
+            events += cb()
+        if self._lp_callbacks and self._counter % max(1, _lp_ratio_var.value) == 0:
+            for cb in list(self._lp_callbacks):
+                events += cb()
+        if events == 0 and _yield_var.value:
+            time.sleep(0)
+        return events
+
+
+class WaitSync:
+    """Completion object a blocking wait parks on.
+
+    The reference spins on opal_progress() single-threaded and blocks
+    on a pthread condvar under MPI_THREAD_MULTIPLE
+    (ref: opal/threads/wait_sync.c:84).  Here completions may arrive
+    from a peer rank-thread (inproc btl) or from our own progress
+    sweeps, so we spin on progress with a short adaptive backoff and
+    an Event for cross-thread wakeups.
+    """
+
+    __slots__ = ("_event", "_count")
+
+    def __init__(self, count: int = 1) -> None:
+        self._event = threading.Event()
+        self._count = count
+
+    def signal(self, n: int = 1) -> None:
+        self._count -= n
+        if self._count <= 0:
+            self._event.set()
+
+    @property
+    def done(self) -> bool:
+        return self._count <= 0
+
+    def wait(self, progress: Progress, timeout: float | None = None) -> bool:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        spins = 0
+        while not self._event.is_set():
+            if progress.progress() == 0:
+                spins += 1
+                if spins > 1000:
+                    # Park briefly; remote completions set the event.
+                    self._event.wait(0.0005)
+            else:
+                spins = 0
+            if deadline is not None and time.monotonic() > deadline:
+                return self._event.is_set()
+        return True

@@ -1,0 +1,22 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+Multi-chip sharding logic is validated on a virtual CPU mesh
+(xla_force_host_platform_device_count) since only one real TPU chip is
+reachable in CI.  This must run before jax initializes its backends;
+the axon sitecustomize pins jax_platforms, so we override via
+jax.config as well as the environment.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+try:
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass

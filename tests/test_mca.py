@@ -1,0 +1,104 @@
+"""MCA registry tests: variable precedence, component selection.
+
+Models the reference's variable-system behavior
+(opal/mca/base/mca_base_var.c): defaults < files < env < override.
+"""
+
+import os
+
+from ompi_tpu.mca import base as mca_base
+from ompi_tpu.mca import params
+
+
+def test_var_default_and_env(monkeypatch):
+    var = params.registry.register("tst", "comp", "alpha", 7, int, help="x")
+    assert var.value == 7
+    assert var.source == params.SOURCE_DEFAULT
+
+    monkeypatch.setenv(params.ENV_PREFIX + "tst_comp_alpha", "42")
+    params.registry.refresh()
+    assert params.registry.get("tst_comp_alpha") == 42
+
+    params.registry.set("tst_comp_alpha", 9)
+    assert params.registry.get("tst_comp_alpha") == 9  # override beats env
+    monkeypatch.delenv(params.ENV_PREFIX + "tst_comp_alpha")
+
+
+def test_var_size_suffixes():
+    var = params.registry.register("tst", "comp", "eager", "64k", int)
+    assert var.value == 65536
+
+
+def test_var_bool_coercion(monkeypatch):
+    monkeypatch.setenv(params.ENV_PREFIX + "tst_comp_flag", "yes")
+    var = params.registry.register("tst", "comp", "flag", False, bool)
+    assert var.value is True
+
+
+def test_param_file(tmp_path, monkeypatch):
+    f = tmp_path / "params.conf"
+    f.write_text("# comment\ntst_comp_beta = 13\n")
+    monkeypatch.setenv(params.PARAM_FILE_ENV, str(f))
+    params.registry.refresh()
+    var = params.registry.register("tst", "comp", "beta", 1, int)
+    assert var.value == 13
+    assert var.source == params.SOURCE_FILE
+
+
+def test_pvar_counter():
+    pv = params.registry.register_pvar("tst", "comp", "msgs", var_class="counter")
+    pv.add(3)
+    pv.add(2)
+    assert pv.read() == 5
+
+
+class _Comp(mca_base.Component):
+    def __init__(self, name, priority, usable=True):
+        super().__init__()
+        self.name = name
+        self.priority = priority
+        self.usable = usable
+
+    def query(self):
+        if not self.usable:
+            return None
+        return (self.priority, f"module-{self.name}")
+
+
+def test_framework_select_one_priority():
+    fw = mca_base.Framework("test", "tfw1")
+    fw.add_component(_Comp("lo", 10))
+    fw.add_component(_Comp("hi", 50))
+    fw.add_component(_Comp("broken", 99, usable=False))
+    comp, payload = fw.select_one()
+    assert comp.name == "hi"
+    assert payload == "module-hi"
+
+
+def test_framework_user_exclusion():
+    fw = mca_base.Framework("test", "tfw2")
+    fw.add_component(_Comp("a", 10))
+    fw.add_component(_Comp("b", 50))
+    params.registry.register("tfw2", "", "", "", str)
+    params.registry.set("tfw2", "^b")
+    try:
+        comp, _ = fw.select_one()
+        assert comp.name == "a"
+    finally:
+        params.registry.set("tfw2", "")
+
+
+def test_framework_select_all_sorted():
+    fw = mca_base.Framework("test", "tfw3")
+    fw.add_component(_Comp("a", 10))
+    fw.add_component(_Comp("b", 50))
+    allc = fw.select_all()
+    assert [c.name for _, c, _ in allc] == ["b", "a"]
+
+
+def test_parse_mca_args():
+    rest = params.parse_mca_args(
+        ["prog", "--mca", "tst_comp_gamma", "5", "arg1"])
+    assert rest == ["prog", "arg1"]
+    var = params.registry.register("tst", "comp", "gamma", 0, int)
+    assert var.value == 5
