@@ -1,0 +1,162 @@
+"""Reduction operations: per-(op, datatype) dispatch tables.
+
+Re-design of ompi/op (ref: ompi/op/op.h:541 ompi_op_reduce dispatch;
+ompi/mca/op/base/op_base_functions.c — 1544 LoC of per-type C loops;
+ompi/mca/op/op.h:55-74 module-per-function selection).  Instead of C
+loops, each op carries two implementations selected per buffer
+residency:
+
+  * ``np_fn(a, b) -> b`` — vectorized numpy, for host buffers on the
+    p2p reduction path (ring/recursive-doubling steps);
+  * ``jax_fn`` — a traceable elementwise lambda, used by coll/tpu to
+    lower the whole reduction into the XLA collective (psum et al.)
+    so the MXU/VPU does the math on-device.
+
+MAXLOC/MINLOC operate on the structured pair dtypes from
+datatype.engine (FLOAT_INT ...), matching MPI semantics of minimum
+index on ties.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+
+class Op:
+    def __init__(self, name: str, np_fn: Optional[Callable] = None,
+                 jax_name: Optional[str] = None, commute: bool = True,
+                 float_ok: bool = True, int_ok: bool = True,
+                 logical_ok: bool = True, complex_ok: bool = False,
+                 pair_fn: Optional[Callable] = None) -> None:
+        self.name = name
+        self.np_fn = np_fn
+        self.jax_name = jax_name  # psum/pmax/pmin lowering hint for coll/tpu
+        self.commute = commute
+        self.is_user = False
+        self.float_ok = float_ok
+        self.int_ok = int_ok
+        self.logical_ok = logical_ok
+        self.complex_ok = complex_ok
+        self.pair_fn = pair_fn
+
+    def __repr__(self) -> str:
+        return f"Op({self.name})"
+
+    def valid_for(self, dtype: np.dtype) -> bool:
+        if self.is_user:
+            return True
+        if dtype.fields is not None:
+            return self.pair_fn is not None
+        k = dtype.kind
+        if k in "fg":
+            return self.float_ok
+        if k in "iu":
+            return self.int_ok
+        if k == "b":
+            return self.logical_ok
+        if k == "c":
+            return self.complex_ok
+        return False
+
+    def reduce(self, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+        """b = a OP b elementwise (the MPI accumulate convention:
+        ref ompi/op/op.h ompi_op_reduce(op, source, target))."""
+        if a.dtype.fields is not None:
+            if self.pair_fn is None:
+                raise TypeError(f"{self.name} invalid on pair type")
+            return self.pair_fn(a, b)
+        if self.np_fn is None:
+            raise TypeError(f"{self.name} has no elementwise form")
+        return self.np_fn(a, b)
+
+
+def _maxloc(a, b):
+    # value field "v", index field "i"; ties pick the smaller index
+    take_a = (a["v"] > b["v"]) | ((a["v"] == b["v"]) & (a["i"] < b["i"]))
+    out = b.copy()
+    out[take_a] = a[take_a]
+    return out
+
+
+def _minloc(a, b):
+    take_a = (a["v"] < b["v"]) | ((a["v"] == b["v"]) & (a["i"] < b["i"]))
+    out = b.copy()
+    out[take_a] = a[take_a]
+    return out
+
+
+def _land(a, b):
+    return ((a != 0) & (b != 0)).astype(b.dtype)
+
+
+def _lor(a, b):
+    return ((a != 0) | (b != 0)).astype(b.dtype)
+
+
+def _lxor(a, b):
+    return ((a != 0) ^ (b != 0)).astype(b.dtype)
+
+
+MAX = Op("MPI_MAX", np.maximum, "max", complex_ok=False)
+MIN = Op("MPI_MIN", np.minimum, "min", complex_ok=False)
+SUM = Op("MPI_SUM", np.add, "add", complex_ok=True)
+PROD = Op("MPI_PROD", np.multiply, "mul", complex_ok=True)
+LAND = Op("MPI_LAND", _land, "and", float_ok=False)
+BAND = Op("MPI_BAND", np.bitwise_and, "and", float_ok=False)
+LOR = Op("MPI_LOR", _lor, "or", float_ok=False)
+BOR = Op("MPI_BOR", np.bitwise_or, "or", float_ok=False)
+LXOR = Op("MPI_LXOR", _lxor, "xor", float_ok=False)
+BXOR = Op("MPI_BXOR", np.bitwise_xor, "xor", float_ok=False)
+MAXLOC = Op("MPI_MAXLOC", None, None, pair_fn=_maxloc,
+            float_ok=False, int_ok=False, logical_ok=False)
+MINLOC = Op("MPI_MINLOC", None, None, pair_fn=_minloc,
+            float_ok=False, int_ok=False, logical_ok=False)
+# REPLACE/NO_OP are data-movement ops: legal on every datatype incl.
+# pair types (MPI_Accumulate with MPI_REPLACE on MPI_DOUBLE_INT is valid)
+REPLACE = Op("MPI_REPLACE", lambda a, b: a.copy(), None, commute=False,
+             complex_ok=True, pair_fn=lambda a, b: a.copy())
+NO_OP = Op("MPI_NO_OP", lambda a, b: b, None, complex_ok=True,
+           pair_fn=lambda a, b: b)
+
+OP_NULL = Op("MPI_OP_NULL", None, None)
+
+PREDEFINED: Dict[str, Op] = {
+    op.name: op for op in (MAX, MIN, SUM, PROD, LAND, BAND, LOR, BOR,
+                           LXOR, BXOR, MAXLOC, MINLOC, REPLACE, NO_OP)
+}
+
+
+def create(user_fn: Callable, commute: bool) -> Op:
+    """MPI_Op_create: user_fn(invec, inoutvec, datatype) -> None,
+    mutating inoutvec in place (matching the C callback shape)."""
+    def np_fn(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+        out = b.copy()
+        user_fn(a, out, None)
+        return out
+
+    op = Op(f"MPI_USER_{id(user_fn):x}", np_fn, None, commute=commute)
+    op.is_user = True
+    return op
+
+
+# jax elementwise forms, resolved lazily so host-only paths never
+# import jax.  Used by coll/tpu and coll/hbm to fuse the reduction
+# into the compiled collective.
+def jax_binary(op: Op):
+    import jax.numpy as jnp
+
+    table = {
+        "MPI_MAX": jnp.maximum,
+        "MPI_MIN": jnp.minimum,
+        "MPI_SUM": jnp.add,
+        "MPI_PROD": jnp.multiply,
+        "MPI_LAND": lambda a, b: ((a != 0) & (b != 0)).astype(b.dtype),
+        "MPI_BAND": jnp.bitwise_and,
+        "MPI_LOR": lambda a, b: ((a != 0) | (b != 0)).astype(b.dtype),
+        "MPI_BOR": jnp.bitwise_or,
+        "MPI_LXOR": lambda a, b: ((a != 0) ^ (b != 0)).astype(b.dtype),
+        "MPI_BXOR": jnp.bitwise_xor,
+    }
+    return table.get(op.name)

@@ -1,0 +1,93 @@
+"""Reduction op table tests (ref: ompi/mca/op/base/op_base_functions.c
+loops; MAXLOC/MINLOC pair semantics from the MPI standard)."""
+
+import numpy as np
+import pytest
+
+from ompi_tpu.datatype import engine as dt
+from ompi_tpu.op import op as mpi_op
+
+
+def test_sum_prod_max_min():
+    a = np.array([1, 5, 3], dtype=np.int32)
+    b = np.array([4, 2, 3], dtype=np.int32)
+    np.testing.assert_array_equal(mpi_op.SUM.reduce(a, b), [5, 7, 6])
+    np.testing.assert_array_equal(mpi_op.PROD.reduce(a, b), [4, 10, 9])
+    np.testing.assert_array_equal(mpi_op.MAX.reduce(a, b), [4, 5, 3])
+    np.testing.assert_array_equal(mpi_op.MIN.reduce(a, b), [1, 2, 3])
+
+
+def test_logical_vs_bitwise():
+    a = np.array([2, 0, 1], dtype=np.int32)
+    b = np.array([1, 1, 0], dtype=np.int32)
+    np.testing.assert_array_equal(mpi_op.LAND.reduce(a, b), [1, 0, 0])
+    np.testing.assert_array_equal(mpi_op.BAND.reduce(a, b), [0, 0, 0])
+    np.testing.assert_array_equal(mpi_op.LOR.reduce(a, b), [1, 1, 1])
+    np.testing.assert_array_equal(mpi_op.LXOR.reduce(a, b), [0, 1, 1])
+    np.testing.assert_array_equal(mpi_op.BXOR.reduce(a, b), [3, 1, 1])
+
+
+def test_validity():
+    assert mpi_op.SUM.valid_for(np.dtype(np.float32))
+    assert not mpi_op.BAND.valid_for(np.dtype(np.float32))
+    assert mpi_op.BAND.valid_for(np.dtype(np.int16))
+    assert mpi_op.SUM.valid_for(np.dtype(np.complex64))
+    assert not mpi_op.MAX.valid_for(np.dtype(np.complex64))
+    assert mpi_op.MAXLOC.valid_for(dt.FLOAT_INT.base)
+    assert not mpi_op.SUM.valid_for(dt.FLOAT_INT.base)
+
+
+def test_maxloc_minloc_ties():
+    a = np.zeros(3, dtype=dt.DOUBLE_INT.base)
+    b = np.zeros(3, dtype=dt.DOUBLE_INT.base)
+    a["v"] = [1.0, 5.0, 2.0]
+    a["i"] = [0, 0, 2]
+    b["v"] = [3.0, 5.0, 2.0]
+    b["i"] = [1, 1, 0]
+    r = mpi_op.MAXLOC.reduce(a, b)
+    np.testing.assert_array_equal(r["v"], [3.0, 5.0, 2.0])
+    np.testing.assert_array_equal(r["i"], [1, 0, 0])  # ties → min index
+    r = mpi_op.MINLOC.reduce(a, b)
+    np.testing.assert_array_equal(r["v"], [1.0, 5.0, 2.0])
+    np.testing.assert_array_equal(r["i"], [0, 0, 0])
+
+
+def test_user_op():
+    def fn(invec, inoutvec, _dt):
+        inoutvec += 2 * invec
+
+    op = mpi_op.create(fn, commute=True)
+    a = np.array([1, 2], dtype=np.int64)
+    b = np.array([10, 20], dtype=np.int64)
+    np.testing.assert_array_equal(op.reduce(a, b), [12, 24])
+    assert op.is_user and op.commute
+
+
+def test_replace_noop():
+    a = np.array([1.0], dtype=np.float64)
+    b = np.array([2.0], dtype=np.float64)
+    assert mpi_op.REPLACE.reduce(a, b)[0] == 1.0
+    assert mpi_op.NO_OP.reduce(a, b)[0] == 2.0
+
+
+def test_jax_binary_forms():
+    import jax.numpy as jnp
+
+    f = mpi_op.jax_binary(mpi_op.SUM)
+    assert float(f(jnp.float32(2), jnp.float32(3))) == 5.0
+    f = mpi_op.jax_binary(mpi_op.MAX)
+    assert float(f(jnp.float32(2), jnp.float32(3))) == 3.0
+    assert mpi_op.jax_binary(mpi_op.MAXLOC) is None
+
+
+def test_valid_for_matches_reduce():
+    """valid_for must agree with what reduce accepts."""
+    pair = dt.DOUBLE_INT.base
+    flt = np.dtype(np.float32)
+    assert not mpi_op.MAXLOC.valid_for(flt)
+    assert mpi_op.REPLACE.valid_for(pair)
+    assert mpi_op.NO_OP.valid_for(pair)
+    a = np.zeros(2, dtype=pair)
+    b = np.ones(2, dtype=pair)
+    np.testing.assert_array_equal(mpi_op.REPLACE.reduce(a, b), a)
+    np.testing.assert_array_equal(mpi_op.NO_OP.reduce(a, b), b)
