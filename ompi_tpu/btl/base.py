@@ -1,0 +1,78 @@
+"""BTL — Byte Transfer Layer: the pluggable data plane under the PML.
+
+Re-design of opal/mca/btl (module API ref: opal/mca/btl/btl.h:374-820;
+tcp component ref: btl_tcp_component.c / btl_tcp_endpoint.c; vader
+shared-memory ref: btl_vader_module.c).  A BTL module moves whole
+frags (opaque tuples serialized as needed) between this rank and a
+set of peers.  The PML stacks eligible BTLs per peer (the bml/r2
+multiplexing idea, ref: ompi/mca/bml/r2) and picks by priority,
+honoring eager/max-send sizes per module.
+
+Delivery contract: the peer's ``deliver(frag)`` enqueues into that
+rank's inbox; the owning rank's progress sweep drains and dispatches.
+That keeps all matching state single-threaded per rank (actor-style),
+which is the lock-free analog of ob1's matching lock.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Tuple
+
+from ompi_tpu.mca.base import Component, frameworks
+from ompi_tpu.mca.params import registry
+
+btl_framework = frameworks.create("opal", "btl")
+
+
+class BTLModule:
+    """One transport instance; knows how to reach some set of peers."""
+
+    name = "base"
+    eager_limit = 64 * 1024
+    max_send_size = 128 * 1024  # ref: btl_tcp_component.c:304 (128 KiB)
+    exclusivity = 0             # higher wins when multiple btls reach a peer
+
+    def reaches(self, peer: int) -> bool:
+        raise NotImplementedError
+
+    def send(self, peer: int, frag: Any) -> None:
+        """Enqueue frag for delivery to peer's PML inbox.  Must be
+        callable from the owning rank's thread only."""
+        raise NotImplementedError
+
+    def progress(self) -> int:
+        """Poll transport internals (sockets etc.); return events."""
+        return 0
+
+    def finalize(self) -> None:
+        pass
+
+
+class BTLComponent(Component):
+    def init_modules(self, state) -> List[BTLModule]:
+        """Create modules for this rank, publish modex addresses."""
+        return []
+
+
+class Endpoint:
+    """Per-peer transport choice (the bml_base_btl analog)."""
+
+    __slots__ = ("peer", "btl")
+
+    def __init__(self, peer: int, btl: BTLModule) -> None:
+        self.peer = peer
+        self.btl = btl
+
+
+def wire_endpoints(state, modules: List[BTLModule]) -> List[Optional[Endpoint]]:
+    """For each peer pick the highest-exclusivity btl that reaches it
+    (mca_bml_r2_add_procs analog)."""
+    eps: List[Optional[Endpoint]] = []
+    for peer in range(state.size):
+        best: Optional[BTLModule] = None
+        for m in modules:
+            if m.reaches(peer) and (best is None
+                                    or m.exclusivity > best.exclusivity):
+                best = m
+        eps.append(Endpoint(peer, best) if best is not None else None)
+    return eps

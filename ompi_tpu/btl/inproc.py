@@ -1,0 +1,58 @@
+"""In-process BTL: thread-ranks on one host exchange frags through
+lock-free deques.
+
+This is the data plane for the TPU-host execution model (ranks =
+threads driving local chips) and the analog of the reference's
+`self` + `vader` shared-memory btls (ref: opal/mca/btl/self,
+opal/mca/btl/vader/btl_vader_module.c:178-180 single-copy fast box) —
+except peers share an address space, so "single-copy" here is literal:
+frags carry object references (bytes / numpy views), never re-packed.
+
+Exclusivity is set above tcp/shm so co-located ranks always prefer it,
+matching the reference's btl selection (vader > tcp).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, List
+
+from .base import BTLComponent, BTLModule, btl_framework
+from ompi_tpu.mca.params import registry
+
+_eager_var = registry.register(
+    "btl", "inproc", "eager_limit", 512 * 1024, int,
+    help="Max bytes sent eagerly (single frag) between thread-ranks")
+
+
+class InprocModule(BTLModule):
+    name = "inproc"
+    exclusivity = 100
+
+    def __init__(self, state) -> None:
+        self.state = state
+        self.world = state.rte.world  # InprocWorld
+        self.eager_limit = _eager_var.value
+        self.max_send_size = 4 * 1024 * 1024
+
+    def reaches(self, peer: int) -> bool:
+        return 0 <= peer < self.world.size
+
+    def send(self, peer: int, frag: Any) -> None:
+        peer_state = self.world.states[peer]
+        peer_state.pml.inbox.append(frag)
+        # ring the peer's doorbell: wakes a rank parked in WaitSync
+        peer_state.progress.wakeup()
+
+
+class InprocComponent(BTLComponent):
+    name = "inproc"
+    priority = 100
+
+    def init_modules(self, state) -> List[BTLModule]:
+        if not hasattr(state.rte, "world"):
+            return []
+        return [InprocModule(state)]
+
+
+btl_framework.add_component(InprocComponent())

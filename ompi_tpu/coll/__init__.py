@@ -1,0 +1,3 @@
+from . import framework  # noqa: F401
+from . import basic  # noqa: F401  (registers coll/basic)
+from . import tuned  # noqa: F401  (registers coll/tuned)

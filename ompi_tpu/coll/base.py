@@ -1,0 +1,617 @@
+"""coll/base: the shared collective-algorithm library over p2p.
+
+Re-design of ompi/mca/coll/base (ref: coll_base_allreduce.c — ring
+:343, recursive doubling :128, segmented ring :619;
+coll_base_alltoall.c — pairwise :131, bruck :190;
+coll_base_bcast.c tree engine; coll_base_reduce_scatter.c;
+coll_base_allgather*.c; coll_base_barrier.c; coll_base_topo.c trees).
+
+All algorithms operate on flat typed numpy arrays (see buffers.py)
+and exchange contiguous slices through the pml — each hop is a
+§3.3-stack message exactly like the reference.  Collective traffic
+uses reserved negative tags per collective type; MPI's ordered-
+collective-call rule plus per-(cid,src) sequence matching keeps
+concurrent instances from cross-talking.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from ompi_tpu.coll.buffers import mpi_dtype_of
+from ompi_tpu.op.op import Op
+
+# reserved tags (one per collective type)
+T_BARRIER = -101
+T_BCAST = -102
+T_REDUCE = -103
+T_ALLREDUCE = -104
+T_ALLGATHER = -105
+T_ALLTOALL = -106
+T_RS = -107
+T_SCAN = -108
+T_GATHER = -109
+T_SCATTER = -110
+T_ALLGATHERV = -111
+T_ALLTOALLV = -112
+T_GATHERV = -113
+T_SCATTERV = -114
+
+
+def _pml(comm):
+    return comm.state.pml
+
+
+def _send(comm, arr: np.ndarray, dst: int, tag: int) -> None:
+    arr = np.ascontiguousarray(arr)
+    _pml(comm).send(arr, arr.size, mpi_dtype_of(arr), dst, tag, comm)
+
+
+def _isend(comm, arr: np.ndarray, dst: int, tag: int):
+    arr = np.ascontiguousarray(arr)
+    return _pml(comm).isend(arr, arr.size, mpi_dtype_of(arr), dst, tag, comm)
+
+
+def _recv(comm, n: int, dtype, src: int, tag: int) -> np.ndarray:
+    out = np.empty(n, dtype=dtype)
+    _pml(comm).recv(out, n, mpi_dtype_of(out), src, tag, comm)
+    return out
+
+
+def _irecv_into(comm, view: np.ndarray, src: int, tag: int):
+    assert view.flags.c_contiguous
+    return _pml(comm).irecv(view, view.size, mpi_dtype_of(view), src, tag,
+                            comm)
+
+
+def _recv_into(comm, view: np.ndarray, src: int, tag: int) -> None:
+    _irecv_into(comm, view, src, tag).wait()
+
+
+def _sendrecv(comm, sarr: np.ndarray, dst: int, rview: np.ndarray,
+              src: int, tag: int) -> None:
+    rq = _irecv_into(comm, rview, src, tag)
+    sq = _isend(comm, sarr, dst, tag)
+    rq.wait()
+    sq.wait()
+
+
+# ---------------------------------------------------------------------------
+# barrier (ref: coll_base_barrier.c)
+# ---------------------------------------------------------------------------
+
+_zero = np.zeros(0, dtype=np.uint8)
+
+
+def barrier_linear(comm) -> None:
+    """Fan-in to rank 0, fan-out."""
+    if comm.size == 1:
+        return
+    if comm.rank == 0:
+        for r in range(1, comm.size):
+            _recv(comm, 0, np.uint8, r, T_BARRIER)
+        for r in range(1, comm.size):
+            _send(comm, _zero, r, T_BARRIER)
+    else:
+        _send(comm, _zero, 0, T_BARRIER)
+        _recv(comm, 0, np.uint8, 0, T_BARRIER)
+
+
+def barrier_bruck(comm) -> None:
+    """Dissemination barrier (ref: coll_base_barrier.c bruck)."""
+    size, rank = comm.size, comm.rank
+    dist = 1
+    while dist < size:
+        to = (rank + dist) % size
+        frm = (rank - dist + size) % size
+        _sendrecv(comm, _zero, to, np.empty(0, np.uint8), frm, T_BARRIER)
+        dist <<= 1
+
+
+def barrier_doublering(comm) -> None:
+    size, rank = comm.size, comm.rank
+    if size == 1:
+        return
+    left = (rank - 1 + size) % size
+    right = (rank + 1) % size
+    for _round in range(2):
+        if rank == 0:
+            _send(comm, _zero, right, T_BARRIER)
+            _recv(comm, 0, np.uint8, left, T_BARRIER)
+        else:
+            _recv(comm, 0, np.uint8, left, T_BARRIER)
+            _send(comm, _zero, right, T_BARRIER)
+
+
+# ---------------------------------------------------------------------------
+# bcast (ref: coll_base_bcast.c generic tree engine + coll_base_topo.c)
+# ---------------------------------------------------------------------------
+
+def bcast_linear(comm, arr: np.ndarray, root: int) -> None:
+    if comm.rank == root:
+        for r in range(comm.size):
+            if r != root:
+                _send(comm, arr, r, T_BCAST)
+    else:
+        _recv_into(comm, arr, root, T_BCAST)
+
+
+def bcast_binomial(comm, arr: np.ndarray, root: int) -> None:
+    size, rank = comm.size, comm.rank
+    vrank = (rank - root) % size
+    # receive from parent
+    mask = 1
+    while mask < size:
+        if vrank & mask:
+            parent = (rank - mask + size) % size
+            _recv_into(comm, arr, parent, T_BCAST)
+            break
+        mask <<= 1
+    # forward to children
+    mask >>= 1
+    while mask > 0:
+        if vrank + mask < size:
+            child = (rank + mask) % size
+            _send(comm, arr, child, T_BCAST)
+        mask >>= 1
+
+
+def bcast_pipeline(comm, arr: np.ndarray, root: int,
+                   segsize_bytes: int = 1 << 20) -> None:
+    """Chain pipeline with segmentation (ref: coll_base_bcast.c:256
+    pipeline using segments)."""
+    size, rank = comm.size, comm.rank
+    if size == 1:
+        return
+    vrank = (rank - root) % size
+    prev = (rank - 1 + size) % size
+    nxt = (rank + 1) % size
+    seg_elems = max(1, segsize_bytes // arr.dtype.itemsize)
+    nseg = (arr.size + seg_elems - 1) // seg_elems
+    prev_send = None
+    for s in range(nseg):
+        sl = arr[s * seg_elems:(s + 1) * seg_elems]
+        if vrank != 0:
+            _recv_into(comm, sl, prev, T_BCAST)
+        if vrank != size - 1:
+            if prev_send is not None:
+                prev_send.wait()
+            prev_send = _isend(comm, sl, nxt, T_BCAST)
+    if prev_send is not None:
+        prev_send.wait()
+
+
+# ---------------------------------------------------------------------------
+# reduce (ref: coll_base_reduce.c)
+# ---------------------------------------------------------------------------
+
+def reduce_linear(comm, sarr: np.ndarray, rarr: Optional[np.ndarray],
+                  op: Op, root: int) -> None:
+    """In-rank-order left fold at root: deterministic for
+    non-commutative ops (basic_linear semantics)."""
+    if comm.rank == root:
+        parts = {}
+        for r in range(comm.size):
+            if r == comm.rank:
+                parts[r] = sarr.copy()
+            else:
+                parts[r] = _recv(comm, sarr.size, sarr.dtype, r, T_REDUCE)
+        # left fold in rank order: buf_0 OP buf_1 OP ... (op.reduce(a,b)
+        # computes a OP b with a the left operand, see op.py)
+        acc = parts[0]
+        for r in range(1, comm.size):
+            acc = op.reduce(acc, parts[r])
+        rarr[:] = acc
+    else:
+        _send(comm, sarr, root, T_REDUCE)
+
+
+def reduce_binomial(comm, sarr: np.ndarray, rarr: Optional[np.ndarray],
+                    op: Op, root: int) -> None:
+    """Binomial-tree reduce (commutative ops)."""
+    size, rank = comm.size, comm.rank
+    vrank = (rank - root) % size
+    acc = sarr.copy()
+    mask = 1
+    while mask < size:
+        if vrank & mask:
+            parent = ((vrank & ~mask) + root) % size
+            _send(comm, acc, parent, T_REDUCE)
+            break
+        else:
+            vchild = vrank | mask
+            if vchild < size:
+                child = (vchild + root) % size
+                data = _recv(comm, acc.size, acc.dtype, child, T_REDUCE)
+                acc = op.reduce(data, acc)
+        mask <<= 1
+    if rank == root:
+        rarr[:] = acc
+
+
+# ---------------------------------------------------------------------------
+# allreduce (ref: coll_base_allreduce.c)
+# ---------------------------------------------------------------------------
+
+def allreduce_linear(comm, sarr, rarr, op: Op) -> None:
+    """nonoverlapping: reduce to 0 then bcast (ref :46)."""
+    reduce_linear(comm, sarr, rarr, op, 0)
+    bcast_binomial(comm, rarr, 0)
+
+
+def allreduce_recursivedoubling(comm, sarr, rarr, op: Op) -> None:
+    """ref: coll_base_allreduce.c:128.  Handles non-power-of-2 by
+    folding extra ranks into a pow2 core."""
+    size, rank = comm.size, comm.rank
+    acc = sarr.copy()
+    pow2 = 1
+    while pow2 * 2 <= size:
+        pow2 *= 2
+    extra = size - pow2
+    # pre-phase: ranks [0, 2*extra) pair up; evens send to odds
+    newrank = -1
+    if rank < 2 * extra:
+        if rank % 2 == 0:
+            _send(comm, acc, rank + 1, T_ALLREDUCE)
+            newrank = -1
+        else:
+            data = _recv(comm, acc.size, acc.dtype, rank - 1, T_ALLREDUCE)
+            acc = op.reduce(data, acc)
+            newrank = rank // 2
+    else:
+        newrank = rank - extra
+    if newrank != -1:
+        mask = 1
+        while mask < pow2:
+            npeer = newrank ^ mask
+            peer = npeer * 2 + 1 if npeer < extra else npeer + extra
+            tmp = np.empty_like(acc)
+            _sendrecv(comm, acc, peer, tmp, peer, T_ALLREDUCE)
+            # keep rank order for non-commutative ops: lower rank's
+            # contribution is the left operand
+            if peer < rank:
+                acc = op.reduce(tmp, acc)
+            else:
+                acc = op.reduce(acc, tmp)
+            mask <<= 1
+    # post-phase: odds send result back to evens
+    if rank < 2 * extra:
+        if rank % 2 == 0:
+            acc = _recv(comm, acc.size, acc.dtype, rank + 1, T_ALLREDUCE)
+        else:
+            _send(comm, acc, rank - 1, T_ALLREDUCE)
+    rarr[:] = acc
+
+
+def allreduce_ring(comm, sarr, rarr, op: Op,
+                   segsize_bytes: int = 0) -> None:
+    """Bandwidth-optimal ring: P-1 reduce-scatter steps + P-1
+    allgather steps (ref: coll_base_allreduce.c:343; :619 for the
+    segmented variant when segsize_bytes > 0)."""
+    size, rank = comm.size, comm.rank
+    if size == 1:
+        rarr[:] = sarr
+        return
+    n = sarr.size
+    rarr[:] = sarr
+    # chunk boundaries
+    base, rem = divmod(n, size)
+    counts = [base + (1 if i < rem else 0) for i in range(size)]
+    offs = np.cumsum([0] + counts).tolist()
+
+    def chunk(i):
+        i %= size
+        return rarr[offs[i]:offs[i + 1]]
+
+    right = (rank + 1) % size
+    left = (rank - 1 + size) % size
+    # reduce-scatter phase
+    for step in range(size - 1):
+        sidx = (rank - step) % size
+        ridx = (rank - step - 1) % size
+        tmp = np.empty(counts[ridx], dtype=rarr.dtype)
+        _sendrecv(comm, chunk(sidx), right, tmp, left, T_ALLREDUCE)
+        dst = chunk(ridx)
+        dst[:] = op.reduce(tmp, dst.copy())
+    # allgather phase
+    for step in range(size - 1):
+        sidx = (rank + 1 - step) % size
+        ridx = (rank - step) % size
+        tmp = np.empty(counts[ridx], dtype=rarr.dtype)
+        _sendrecv(comm, chunk(sidx), right, tmp, left, T_ALLREDUCE)
+        chunk(ridx)[:] = tmp
+
+
+# ---------------------------------------------------------------------------
+# allgather (ref: coll_base_allgather.c)
+# ---------------------------------------------------------------------------
+
+def allgather_linear(comm, sarr, rarr) -> None:
+    """gather to 0 + bcast."""
+    gather_linear(comm, sarr, rarr, 0)
+    bcast_binomial(comm, rarr, 0)
+
+
+def allgather_ring(comm, sarr, rarr) -> None:
+    size, rank = comm.size, comm.rank
+    n = sarr.size
+    rarr[rank * n:(rank + 1) * n] = sarr
+    right = (rank + 1) % size
+    left = (rank - 1 + size) % size
+    for step in range(size - 1):
+        sidx = (rank - step) % size
+        ridx = (rank - step - 1) % size
+        _sendrecv(comm, rarr[sidx * n:(sidx + 1) * n], right,
+                  rarr[ridx * n:(ridx + 1) * n], left, T_ALLGATHER)
+
+
+def allgather_recursivedoubling(comm, sarr, rarr) -> None:
+    """pow2 only; caller guards (ref: coll_base_allgather.c recdbl)."""
+    size, rank = comm.size, comm.rank
+    n = sarr.size
+    rarr[rank * n:(rank + 1) * n] = sarr
+    mask = 1
+    while mask < size:
+        peer = rank ^ mask
+        blk = (rank // mask) * mask  # my current block start
+        pblk = (peer // mask) * mask
+        _sendrecv(comm, rarr[blk * n:(blk + mask) * n], peer,
+                  rarr[pblk * n:(pblk + mask) * n], peer, T_ALLGATHER)
+        mask <<= 1
+
+
+def allgather_bruck(comm, sarr, rarr) -> None:
+    """log-P allgather with post-rotation (ref: allgather bruck)."""
+    size, rank = comm.size, comm.rank
+    n = sarr.size
+    tmp = np.empty(size * n, dtype=sarr.dtype)
+    tmp[:n] = sarr
+    dist = 1
+    while dist < size:
+        cnt = min(dist, size - dist)
+        to = (rank - dist + size) % size
+        frm = (rank + dist) % size
+        _sendrecv(comm, tmp[:cnt * n], to,
+                  tmp[dist * n:(dist + cnt) * n], frm, T_ALLGATHER)
+        dist <<= 1
+    # rotate: tmp[i] holds block (rank + i) % size
+    for i in range(size):
+        rarr[((rank + i) % size) * n:(((rank + i) % size) + 1) * n] = \
+            tmp[i * n:(i + 1) * n]
+
+
+def allgatherv_linear(comm, sarr, rarr, counts: Sequence[int],
+                      displs: Sequence[int]) -> None:
+    gatherv_linear(comm, sarr, rarr if comm.rank == 0 else None,
+                   counts, displs, 0)
+    # bcast the whole rarr (counts/displs identical everywhere)
+    bcast_binomial(comm, rarr, 0)
+
+
+# ---------------------------------------------------------------------------
+# gather / scatter (ref: coll_base_gather.c, coll_base_scatter.c)
+# ---------------------------------------------------------------------------
+
+def gather_linear(comm, sarr, rarr, root: int) -> None:
+    n = sarr.size
+    if comm.rank == root:
+        rarr[root * n:(root + 1) * n] = sarr
+        for r in range(comm.size):
+            if r != root:
+                _recv_into(comm, rarr[r * n:(r + 1) * n], r, T_GATHER)
+    else:
+        _send(comm, sarr, root, T_GATHER)
+
+
+def gather_binomial(comm, sarr, rarr, root: int) -> None:
+    """In-order binomial gather: internal nodes accumulate their
+    subtree's blocks contiguously in vrank space, root unrotates."""
+    size, rank = comm.size, comm.rank
+    n = sarr.size
+    vrank = (rank - root) % size
+    # subtree size in vrank space
+    mask = 1
+    while mask < size:
+        if vrank & mask:
+            break
+        mask <<= 1
+    subtree = min(mask, size - vrank)
+    buf = np.empty(subtree * n, dtype=sarr.dtype)
+    buf[:n] = sarr
+    have = 1
+    m = 1
+    while m < size:
+        if vrank & m:
+            parent = ((vrank & ~m) + root) % size
+            _send(comm, buf[:have * n], parent, T_GATHER)
+            break
+        vchild = vrank | m
+        if vchild < size:
+            child = (vchild + root) % size
+            csub = min(m, size - vchild)
+            _recv_into(comm, buf[m * n:(m + csub) * n], child, T_GATHER)
+            have = m + csub
+        m <<= 1
+    if rank == root:
+        for v in range(size):
+            g = (v + root) % size
+            rarr[g * n:(g + 1) * n] = buf[v * n:(v + 1) * n]
+
+
+def gatherv_linear(comm, sarr, rarr, counts, displs, root: int) -> None:
+    if comm.rank == root:
+        for r in range(comm.size):
+            if r == root:
+                rarr[displs[r]:displs[r] + counts[r]] = sarr[:counts[r]]
+            else:
+                _recv_into(comm, rarr[displs[r]:displs[r] + counts[r]],
+                           r, T_GATHERV)
+    else:
+        _send(comm, sarr, root, T_GATHERV)
+
+
+def scatter_linear(comm, sarr, rarr, root: int) -> None:
+    n = rarr.size
+    if comm.rank == root:
+        rarr[:] = sarr[root * n:(root + 1) * n]
+        for r in range(comm.size):
+            if r != root:
+                _send(comm, sarr[r * n:(r + 1) * n], r, T_SCATTER)
+    else:
+        _recv_into(comm, rarr, root, T_SCATTER)
+
+
+def scatterv_linear(comm, sarr, rarr, counts, displs, root: int) -> None:
+    if comm.rank == root:
+        for r in range(comm.size):
+            if r == root:
+                rarr[:counts[r]] = sarr[displs[r]:displs[r] + counts[r]]
+            else:
+                _send(comm, sarr[displs[r]:displs[r] + counts[r]], r,
+                      T_SCATTERV)
+    else:
+        _recv_into(comm, rarr, root, T_SCATTERV)
+
+
+# ---------------------------------------------------------------------------
+# alltoall (ref: coll_base_alltoall.c)
+# ---------------------------------------------------------------------------
+
+def alltoall_linear(comm, sarr, rarr) -> None:
+    """basic_linear: post everything nonblocking (ref :493)."""
+    size, rank = comm.size, comm.rank
+    n = sarr.size // size
+    rarr[rank * n:(rank + 1) * n] = sarr[rank * n:(rank + 1) * n]
+    reqs = []
+    for r in range(size):
+        if r != rank:
+            reqs.append(_irecv_into(comm, rarr[r * n:(r + 1) * n], r,
+                                    T_ALLTOALL))
+    for r in range(size):
+        if r != rank:
+            reqs.append(_isend(comm, sarr[r * n:(r + 1) * n], r, T_ALLTOALL))
+    for q in reqs:
+        q.wait()
+
+
+def alltoall_pairwise(comm, sarr, rarr) -> None:
+    """ref :131: step k exchanges with rank±k."""
+    size, rank = comm.size, comm.rank
+    n = sarr.size // size
+    rarr[rank * n:(rank + 1) * n] = sarr[rank * n:(rank + 1) * n]
+    for step in range(1, size):
+        to = (rank + step) % size
+        frm = (rank - step + size) % size
+        _sendrecv(comm, sarr[to * n:(to + 1) * n], to,
+                  rarr[frm * n:(frm + 1) * n], frm, T_ALLTOALL)
+
+
+def alltoall_bruck(comm, sarr, rarr) -> None:
+    """ref :190: log-P latency-optimal for small messages."""
+    size, rank = comm.size, comm.rank
+    n = sarr.size // size
+    # local rotation: tmp block i = sendblock (rank + i) % size
+    tmp = np.empty_like(sarr)
+    for i in range(size):
+        tmp[i * n:(i + 1) * n] = sarr[((rank + i) % size) * n:
+                                      ((rank + i) % size + 1) * n]
+    scratch = np.empty_like(tmp)
+    dist = 1
+    while dist < size:
+        to = (rank + dist) % size
+        frm = (rank - dist + size) % size
+        idxs = [i for i in range(size) if i & dist]
+        send = np.concatenate([tmp[i * n:(i + 1) * n] for i in idxs])
+        recv = np.empty_like(send)
+        _sendrecv(comm, send, to, recv, frm, T_ALLTOALL)
+        for j, i in enumerate(idxs):
+            tmp[i * n:(i + 1) * n] = recv[j * n:(j + 1) * n]
+        dist <<= 1
+    # inverse rotation: result block src = tmp[(src - rank) % size],
+    # then bruck's final reversal
+    for i in range(size):
+        rarr[((rank - i + size) % size) * n:
+             ((rank - i + size) % size + 1) * n] = tmp[i * n:(i + 1) * n]
+
+
+def alltoallv_linear(comm, sarr, rarr, scounts, sdispls, rcounts,
+                     rdispls) -> None:
+    size, rank = comm.size, comm.rank
+    rarr[rdispls[rank]:rdispls[rank] + rcounts[rank]] = \
+        sarr[sdispls[rank]:sdispls[rank] + scounts[rank]]
+    reqs = []
+    for r in range(size):
+        if r != rank and rcounts[r]:
+            reqs.append(_irecv_into(
+                comm, rarr[rdispls[r]:rdispls[r] + rcounts[r]], r,
+                T_ALLTOALLV))
+    for r in range(size):
+        if r != rank and scounts[r]:
+            reqs.append(_isend(
+                comm, sarr[sdispls[r]:sdispls[r] + scounts[r]], r,
+                T_ALLTOALLV))
+    for q in reqs:
+        q.wait()
+
+
+# ---------------------------------------------------------------------------
+# reduce_scatter (ref: coll_base_reduce_scatter.c)
+# ---------------------------------------------------------------------------
+
+def reduce_scatter_ring(comm, sarr, rarr, counts: Sequence[int],
+                        op: Op) -> None:
+    """ring reduce-scatter with per-rank counts (ref :403 ring)."""
+    size, rank = comm.size, comm.rank
+    if size == 1:
+        rarr[:counts[0]] = sarr[:counts[0]]
+        return
+    offs = np.cumsum([0] + list(counts)).tolist()
+    work = sarr.copy()
+    right = (rank + 1) % size
+    left = (rank - 1 + size) % size
+    # step k: send chunk (rank - k - 1), recv chunk (rank - k - 2),
+    # accumulate; the -1 shift (vs allreduce_ring's phase) makes the
+    # chunk completed after size-1 steps land on index `rank`
+    for step in range(size - 1):
+        sidx = (rank - step - 1) % size
+        ridx = (rank - step - 2) % size
+        tmp = np.empty(counts[ridx], dtype=work.dtype)
+        _sendrecv(comm, work[offs[sidx]:offs[sidx] + counts[sidx]],
+                  right, tmp, left, T_RS)
+        seg = work[offs[ridx]:offs[ridx] + counts[ridx]]
+        seg[:] = op.reduce(tmp, seg.copy())
+    rarr[:counts[rank]] = work[offs[rank]:offs[rank] + counts[rank]]
+
+
+def reduce_scatter_block_ring(comm, sarr, rarr, op: Op) -> None:
+    n = sarr.size // comm.size
+    reduce_scatter_ring(comm, sarr, rarr, [n] * comm.size, op)
+
+
+# ---------------------------------------------------------------------------
+# scan / exscan (linear pipeline, ref: coll_base_scan.c semantics)
+# ---------------------------------------------------------------------------
+
+def scan_linear(comm, sarr, rarr, op: Op) -> None:
+    rank = comm.rank
+    rarr[:] = sarr
+    if rank > 0:
+        prev = _recv(comm, sarr.size, sarr.dtype, rank - 1, T_SCAN)
+        rarr[:] = op.reduce(prev, rarr.copy())
+    if rank < comm.size - 1:
+        _send(comm, rarr, rank + 1, T_SCAN)
+
+
+def exscan_linear(comm, sarr, rarr, op: Op) -> None:
+    rank = comm.rank
+    if rank > 0:
+        prev = _recv(comm, sarr.size, sarr.dtype, rank - 1, T_SCAN)
+        rarr[:] = prev
+    if rank < comm.size - 1:
+        if rank == 0:
+            _send(comm, sarr, rank + 1, T_SCAN)
+        else:
+            nxt = op.reduce(rarr, sarr.copy())
+            _send(comm, nxt, rank + 1, T_SCAN)

@@ -1,0 +1,273 @@
+"""coll/basic: safe p2p-backed collective module.
+
+The buffer-adaptation layer between MPI (buf, count, datatype, op)
+arguments and the flat-array algorithms in coll/base, plus fixed
+"always correct" algorithm choices (ref: ompi/mca/coll/basic).
+coll/tuned subclasses this and overrides only the decision hooks
+(ref: coll_tuned_decision_fixed.c).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ompi_tpu.coll import base as alg
+from ompi_tpu.coll.buffers import IN_PLACE, TypedBuf, typed
+from ompi_tpu.coll.framework import CollComponent, CollModule, coll_framework
+from ompi_tpu.op.op import Op
+
+
+def _is_pow2(n: int) -> bool:
+    return n & (n - 1) == 0
+
+
+class P2PCollModule(CollModule):
+    name = "basic"
+
+    # -- decision hooks (overridden by tuned) ----------------------------
+    def _pick_allreduce(self, comm, nbytes, op):
+        return alg.allreduce_linear
+
+    def _pick_bcast(self, comm, nbytes):
+        return alg.bcast_binomial
+
+    def _pick_reduce(self, comm, nbytes, op):
+        return alg.reduce_binomial if op.commute else alg.reduce_linear
+
+    def _pick_allgather(self, comm, nbytes):
+        return alg.allgather_ring
+
+    def _pick_alltoall(self, comm, nbytes):
+        return alg.alltoall_pairwise
+
+    def _pick_barrier(self, comm):
+        return alg.barrier_bruck
+
+    def _pick_gather(self, comm, nbytes):
+        return alg.gather_linear
+
+    # -- collectives -----------------------------------------------------
+    def barrier(self, comm) -> None:
+        if comm.size > 1:
+            self._pick_barrier(comm)(comm)
+
+    def bcast(self, comm, buf, count, datatype, root) -> None:
+        if comm.size == 1 or count == 0:
+            return
+        tb = typed(buf, count, datatype, writable=True)
+        self._pick_bcast(comm, tb.arr.nbytes)(comm, tb.arr, root)
+        if comm.rank != root:
+            tb.flush()
+
+    def reduce(self, comm, sbuf, rbuf, count, datatype, op: Op,
+               root) -> None:
+        rb = typed(rbuf, count, datatype, writable=True) \
+            if comm.rank == root else None
+        if sbuf is IN_PLACE:
+            sarr = rb.arr.copy()
+        else:
+            sarr = typed(sbuf, count, datatype).arr
+        if comm.size == 1:
+            rb.arr[:] = sarr
+        else:
+            self._pick_reduce(comm, sarr.nbytes, op)(
+                comm, sarr, rb.arr if rb is not None else None, op, root)
+        if rb is not None:
+            rb.flush()
+
+    def allreduce(self, comm, sbuf, rbuf, count, datatype, op: Op) -> None:
+        rb = typed(rbuf, count, datatype, writable=True)
+        if sbuf is IN_PLACE:
+            sarr = rb.arr.copy()
+        else:
+            sarr = typed(sbuf, count, datatype).arr
+        if comm.size == 1:
+            rb.arr[:] = sarr
+        else:
+            self._pick_allreduce(comm, sarr.nbytes, op)(
+                comm, sarr, rb.arr, op)
+        rb.flush()
+
+    def allgather(self, comm, sbuf, scount, sdtype, rbuf, rcount,
+                  rdtype) -> None:
+        rb = typed(rbuf, rcount * comm.size, rdtype, writable=True)
+        n = rb.arr.size // comm.size
+        if sbuf is IN_PLACE:
+            sarr = rb.arr[comm.rank * n:(comm.rank + 1) * n].copy()
+        else:
+            sarr = typed(sbuf, scount, sdtype).arr
+        if comm.size == 1:
+            rb.arr[:] = sarr
+        else:
+            self._pick_allgather(comm, sarr.nbytes)(comm, sarr, rb.arr)
+        rb.flush()
+
+    def allgatherv(self, comm, sbuf, scount, sdtype, rbuf, rcounts,
+                   displs, rdtype) -> None:
+        total = max(displs[i] + rcounts[i] for i in range(comm.size))
+        rb = typed(rbuf, total, rdtype, writable=True)
+        elem = rb.datatype.size // rb.prim.itemsize
+        counts = [c * elem for c in rcounts]
+        dis = [d * elem for d in displs]
+        if sbuf is IN_PLACE:
+            sarr = rb.arr[dis[comm.rank]:dis[comm.rank] +
+                          counts[comm.rank]].copy()
+        else:
+            sarr = typed(sbuf, scount, sdtype).arr
+        alg.allgatherv_linear(comm, sarr, rb.arr, counts, dis)
+        rb.flush()
+
+    def gather(self, comm, sbuf, scount, sdtype, rbuf, rcount, rdtype,
+               root) -> None:
+        rb = typed(rbuf, rcount * comm.size, rdtype, writable=True) \
+            if comm.rank == root else None
+        if sbuf is IN_PLACE and comm.rank == root:
+            n = rb.arr.size // comm.size
+            sarr = rb.arr[root * n:(root + 1) * n].copy()
+        else:
+            sarr = typed(sbuf, scount, sdtype).arr
+        if comm.size == 1:
+            rb.arr[:] = sarr
+        else:
+            self._pick_gather(comm, sarr.nbytes)(
+                comm, sarr, rb.arr if rb is not None else None, root)
+        if rb is not None:
+            rb.flush()
+
+    def gatherv(self, comm, sbuf, scount, sdtype, rbuf, rcounts, displs,
+                rdtype, root) -> None:
+        if comm.rank == root:
+            total = max(displs[i] + rcounts[i] for i in range(comm.size))
+            rb = typed(rbuf, total, rdtype, writable=True)
+            elem = rb.datatype.size // rb.prim.itemsize
+            counts = [c * elem for c in rcounts]
+            dis = [d * elem for d in displs]
+        else:
+            rb, counts, dis = None, None, None
+        if sbuf is IN_PLACE and comm.rank == root:
+            sarr = rb.arr[dis[root]:dis[root] + counts[root]].copy()
+        else:
+            sarr = typed(sbuf, scount, sdtype).arr
+        alg.gatherv_linear(comm, sarr, rb.arr if rb else None,
+                           counts, dis, root)
+        if rb is not None:
+            rb.flush()
+
+    def scatter(self, comm, sbuf, scount, sdtype, rbuf, rcount, rdtype,
+                root) -> None:
+        sb = typed(sbuf, scount * comm.size, sdtype) \
+            if comm.rank == root else None
+        if rbuf is IN_PLACE and comm.rank == root:
+            # root keeps its own block in place but must still feed
+            # every other rank
+            n = sb.arr.size // comm.size
+            for r in range(comm.size):
+                if r != root:
+                    alg._send(comm, sb.arr[r * n:(r + 1) * n], r,
+                              alg.T_SCATTER)
+            return
+        rb = typed(rbuf, rcount, rdtype, writable=True)
+        if comm.size == 1:
+            rb.arr[:] = sb.arr
+        else:
+            alg.scatter_linear(comm, sb.arr if sb is not None else None,
+                               rb.arr, root)
+        rb.flush()
+
+    def scatterv(self, comm, sbuf, scounts, displs, sdtype, rbuf, rcount,
+                 rdtype, root) -> None:
+        if comm.rank == root:
+            total = max(displs[i] + scounts[i] for i in range(comm.size))
+            sb = typed(sbuf, total, sdtype)
+            elem = sb.datatype.size // sb.prim.itemsize
+            counts = [c * elem for c in scounts]
+            dis = [d * elem for d in displs]
+        else:
+            sb, counts, dis = None, None, None
+        rb = typed(rbuf, rcount, rdtype, writable=True)
+        alg.scatterv_linear(comm, sb.arr if sb else None, rb.arr,
+                            counts, dis, root)
+        rb.flush()
+
+    def alltoall(self, comm, sbuf, scount, sdtype, rbuf, rcount,
+                 rdtype) -> None:
+        rb = typed(rbuf, rcount * comm.size, rdtype, writable=True)
+        if sbuf is IN_PLACE:
+            sarr = rb.arr.copy()
+        else:
+            sarr = typed(sbuf, scount * comm.size, sdtype).arr
+        if comm.size == 1:
+            rb.arr[:] = sarr
+        else:
+            self._pick_alltoall(comm, sarr.nbytes // comm.size)(
+                comm, sarr, rb.arr)
+        rb.flush()
+
+    def alltoallv(self, comm, sbuf, scounts, sdispls, sdtype, rbuf,
+                  rcounts, rdispls, rdtype) -> None:
+        stotal = max(sdispls[i] + scounts[i] for i in range(comm.size))
+        rtotal = max(rdispls[i] + rcounts[i] for i in range(comm.size))
+        sb = typed(sbuf, stotal, sdtype)
+        rb = typed(rbuf, rtotal, rdtype, writable=True)
+        selem = sb.datatype.size // sb.prim.itemsize
+        relem = rb.datatype.size // rb.prim.itemsize
+        alg.alltoallv_linear(
+            comm, sb.arr, rb.arr,
+            [c * selem for c in scounts], [d * selem for d in sdispls],
+            [c * relem for c in rcounts], [d * relem for d in rdispls])
+        rb.flush()
+
+    def reduce_scatter(self, comm, sbuf, rbuf, rcounts, datatype,
+                       op: Op, sdtype=None) -> None:
+        total = sum(rcounts)
+        rb = typed(rbuf, rcounts[comm.rank], datatype, writable=True)
+        if sbuf is IN_PLACE:
+            sarr = typed(rbuf, total, datatype).arr.copy()
+        else:
+            sarr = typed(sbuf, total, sdtype or datatype).arr
+        elem = rb.datatype.size // rb.prim.itemsize
+        counts = [c * elem for c in rcounts]
+        if comm.size == 1:
+            rb.arr[:] = sarr[:counts[0]]
+        elif op.commute:
+            alg.reduce_scatter_ring(comm, sarr, rb.arr, counts, op)
+        else:
+            # rank-ordered reduce at 0, then scatterv (the reference
+            # basic module's non-commutative path)
+            full = np.empty_like(sarr) if comm.rank == 0 else None
+            alg.reduce_linear(comm, sarr, full, op, 0)
+            displs = np.cumsum([0] + counts[:-1]).tolist()
+            alg.scatterv_linear(comm, full, rb.arr, counts, displs, 0)
+        rb.flush()
+
+    def reduce_scatter_block(self, comm, sbuf, rbuf, rcount, datatype,
+                             op: Op) -> None:
+        self.reduce_scatter(comm, sbuf, rbuf, [rcount] * comm.size,
+                            datatype, op)
+
+    def scan(self, comm, sbuf, rbuf, count, datatype, op: Op) -> None:
+        rb = typed(rbuf, count, datatype, writable=True)
+        sarr = rb.arr.copy() if sbuf is IN_PLACE \
+            else typed(sbuf, count, datatype).arr
+        alg.scan_linear(comm, sarr, rb.arr, op)
+        rb.flush()
+
+    def exscan(self, comm, sbuf, rbuf, count, datatype, op: Op) -> None:
+        rb = typed(rbuf, count, datatype, writable=True)
+        sarr = rb.arr.copy() if sbuf is IN_PLACE \
+            else typed(sbuf, count, datatype).arr
+        alg.exscan_linear(comm, sarr, rb.arr, op)
+        rb.flush()
+
+
+class BasicComponent(CollComponent):
+    name = "basic"
+    priority = 10
+
+    def comm_query(self, comm):
+        return (self.priority, P2PCollModule())
+
+
+coll_framework.add_component(BasicComponent())

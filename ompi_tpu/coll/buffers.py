@@ -1,0 +1,63 @@
+"""Typed-buffer adapter between MPI (buf, count, datatype) triples and
+the flat numpy arrays the collective algorithms run on.
+
+The reference's collectives push every message through the convertor
+on each hop; here the datatype is materialized ONCE per collective
+(zero-copy when the buffer is already a contiguous numpy array of the
+primitive type) and the algorithms work on flat arrays — the layout
+XLA wants too, so coll/hbm and coll/tpu consume the same adapter.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ompi_tpu.datatype import engine as dtmod
+from ompi_tpu.datatype.convertor import Convertor
+
+IN_PLACE = object()  # MPI_IN_PLACE sentinel
+
+
+class TypedBuf:
+    """`count` elements of `datatype` in `buf`, exposed as a flat
+    numpy array of the primitive dtype."""
+
+    def __init__(self, buf, count: int, datatype, writable: bool = False):
+        self.buf = buf
+        self.count = count
+        self.datatype = datatype
+        prim_set = {r.dtype for r in datatype.runs}
+        if len(prim_set) != 1:
+            # heterogeneous struct: operate on raw bytes
+            self.prim = np.dtype(np.uint8)
+        else:
+            self.prim = prim_set.pop()
+        self.nprim = (datatype.size * count) // self.prim.itemsize
+        self._copied = False
+        if (isinstance(buf, np.ndarray) and datatype.is_contiguous
+                and buf.dtype == self.prim and buf.flags.c_contiguous
+                and buf.size >= self.nprim):
+            self.arr = buf.reshape(-1)[: self.nprim]
+        else:
+            conv = Convertor(datatype, count, buf)
+            data = conv.pack()
+            self.arr = np.frombuffer(bytearray(data), dtype=self.prim)
+            self._copied = True
+        self.writable = writable
+
+    def flush(self) -> None:
+        """Write the (possibly modified) flat array back to the user
+        buffer when it was materialized by copy."""
+        if self._copied and self.writable:
+            conv = Convertor(self.datatype, self.count, self.buf)
+            conv.unpack(self.arr.tobytes())
+
+
+def typed(buf, count, datatype, writable=False) -> TypedBuf:
+    return TypedBuf(buf, count, datatype, writable)
+
+
+def mpi_dtype_of(arr: np.ndarray):
+    return dtmod.from_numpy_dtype(arr.dtype)

@@ -1,0 +1,89 @@
+"""Collectives framework: per-communicator module stacks with
+per-function merging.
+
+Re-design of ompi/mca/coll selection (ref: coll_base_comm_select.c:
+51-58,128-151,262-300 — every component is queried with the comm,
+returns a module + priority, and the winning *function pointers* are
+merged per collective so different components can serve different
+collectives on the same communicator; module interface ref:
+coll.h:139-256).
+
+The merged vtable lives on ``comm.coll``.  Components register here;
+coll/basic, coll/base+tuned, coll/hbm and coll/tpu each fill the
+functions they implement, and the highest-priority provider of each
+function wins — exactly how the reference lets coll/tuned own
+allreduce while coll/sm owns barrier on the same comm.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+from ompi_tpu.mca.base import Component, frameworks
+
+coll_framework = frameworks.create("ompi", "coll")
+
+# the collective function names a module may provide
+COLL_FUNCS = (
+    "barrier", "bcast", "reduce", "allreduce", "allgather", "allgatherv",
+    "gather", "gatherv", "scatter", "scatterv", "alltoall", "alltoallv",
+    "alltoallw", "reduce_scatter", "reduce_scatter_block", "scan", "exscan",
+    # nonblocking
+    "ibarrier", "ibcast", "ireduce", "iallreduce", "iallgather",
+    "iallgatherv", "igather", "iscatter", "ialltoall", "ireduce_scatter",
+)
+
+
+class CollModule:
+    """Base class: set attributes named after COLL_FUNCS."""
+
+    def enable(self, comm) -> None:
+        pass
+
+
+class MergedColl:
+    """The per-comm vtable of winning collective implementations."""
+
+    def __init__(self) -> None:
+        self.providers: Dict[str, Any] = {}
+
+    def __getattr__(self, name: str):
+        # AttributeError (not NotImplementedError) so hasattr/getattr
+        # probing for optional collectives behaves normally
+        if name in COLL_FUNCS:
+            raise AttributeError(
+                f"no collective module provides '{name}' on this comm")
+        raise AttributeError(name)
+
+
+class CollComponent(Component):
+    def comm_query(self, comm) -> Optional[tuple]:
+        """Return (priority, module) or None."""
+        return None
+
+    def query(self, comm=None):
+        if comm is None:
+            return (self.priority, None)
+        return self.comm_query(comm)
+
+
+def comm_select(comm) -> None:
+    """Stack modules on a communicator (coll_base_comm_select analog)."""
+    merged = MergedColl()
+    candidates = coll_framework.select_all(comm)  # sorted high→low
+    for pri, component, module in reversed(candidates):  # low→high overlay
+        if module is None:
+            continue
+        module.enable(comm)
+        for fname in COLL_FUNCS:
+            fn = getattr(module, fname, None)
+            if fn is not None:
+                setattr(merged, fname, fn)
+                merged.providers[fname] = component.name
+    comm.coll = merged
+    # verify the mandatory blocking set is covered
+    for fname in ("barrier", "bcast", "allreduce", "reduce", "allgather",
+                  "alltoall", "gather", "scatter", "reduce_scatter_block"):
+        if not hasattr(merged, fname):
+            raise RuntimeError(
+                f"no coll component provides {fname} for {comm}")

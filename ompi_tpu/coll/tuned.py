@@ -1,0 +1,143 @@
+"""coll/tuned: decision layer choosing algorithms by communicator and
+message size.
+
+Re-design of ompi/mca/coll/tuned fixed decisions
+(ref: coll_tuned_decision_fixed.c:44-86 — allreduce: <10 KB →
+recursive doubling; commutative → ring (segmented above 1 MiB);
+else nonoverlapping) plus the dynamic rule-file mechanism
+(ref: coll_tuned_dynamic_file.c:46-64) via the
+``coll_tuned_dynamic_rules`` MCA parameter.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Callable, Dict, Optional
+
+from ompi_tpu.coll import base as alg
+from ompi_tpu.coll.basic import P2PCollModule, _is_pow2
+from ompi_tpu.coll.framework import CollComponent, coll_framework
+from ompi_tpu.mca.params import registry
+
+_small_var = registry.register(
+    "coll", "tuned", "allreduce_small_msg", 10000, int,
+    help="Below this many bytes allreduce uses recursive doubling "
+         "(ref: coll_tuned_decision_fixed.c:52)")
+_seg_var = registry.register(
+    "coll", "tuned", "allreduce_ring_segsize", 1 << 20, int,
+    help="Segment size for segmented-ring allreduce "
+         "(ref: coll_tuned_decision_fixed.c:72)")
+_rules_var = registry.register(
+    "coll", "tuned", "dynamic_rules", "", str,
+    help="Path to a JSON rules file mapping collective -> "
+         "[[max_bytes, algorithm_name], ...]")
+
+_ALGS = {
+    "allreduce": {
+        "linear": alg.allreduce_linear,
+        "recursive_doubling": alg.allreduce_recursivedoubling,
+        "ring": alg.allreduce_ring,
+    },
+    "bcast": {
+        "linear": alg.bcast_linear,
+        "binomial": alg.bcast_binomial,
+        "pipeline": alg.bcast_pipeline,
+    },
+    "allgather": {
+        "linear": alg.allgather_linear,
+        "ring": alg.allgather_ring,
+        "recursive_doubling": alg.allgather_recursivedoubling,
+        "bruck": alg.allgather_bruck,
+    },
+    "alltoall": {
+        "linear": alg.alltoall_linear,
+        "pairwise": alg.alltoall_pairwise,
+        "bruck": alg.alltoall_bruck,
+    },
+}
+
+
+class TunedModule(P2PCollModule):
+    name = "tuned"
+
+    def __init__(self) -> None:
+        self._rules: Dict[str, list] = {}
+        path = _rules_var.value
+        if path and os.path.exists(path):
+            try:
+                with open(path) as fh:
+                    self._rules = json.load(fh)
+            except (OSError, ValueError):
+                self._rules = {}
+
+    def _rule(self, coll: str, nbytes: int) -> Optional[Callable]:
+        for max_bytes, name in self._rules.get(coll, []):
+            if nbytes <= max_bytes:
+                fn = _ALGS.get(coll, {}).get(name)
+                if fn is not None:
+                    return fn
+        return None
+
+    # decision functions (ref: coll_tuned_decision_fixed.c:44-86)
+    def _pick_allreduce(self, comm, nbytes, op):
+        fn = self._rule("allreduce", nbytes)
+        if fn is not None:
+            return fn
+        if not op.commute:
+            # only the rank-ordered fold is deterministic+correct for
+            # non-commutative ops (ref decision: "else nonoverlapping")
+            return alg.allreduce_linear
+        if nbytes < _small_var.value and _is_pow2(comm.size):
+            return alg.allreduce_recursivedoubling
+        if nbytes // max(1, comm.size) > 0:
+            if nbytes > _seg_var.value * comm.size:
+                return lambda c, s, r, o: alg.allreduce_ring(
+                    c, s, r, o, segsize_bytes=_seg_var.value)
+            return alg.allreduce_ring
+        if _is_pow2(comm.size):
+            return alg.allreduce_recursivedoubling
+        return alg.allreduce_linear
+
+    def _pick_bcast(self, comm, nbytes):
+        fn = self._rule("bcast", nbytes)
+        if fn is not None:
+            return fn
+        if nbytes > 256 * 1024 and comm.size > 2:
+            return alg.bcast_pipeline
+        return alg.bcast_binomial
+
+    def _pick_allgather(self, comm, nbytes):
+        fn = self._rule("allgather", nbytes)
+        if fn is not None:
+            return fn
+        if nbytes <= 4096:
+            return alg.allgather_bruck
+        if _is_pow2(comm.size):
+            return alg.allgather_recursivedoubling
+        return alg.allgather_ring
+
+    def _pick_alltoall(self, comm, nbytes):
+        fn = self._rule("alltoall", nbytes)
+        if fn is not None:
+            return fn
+        if nbytes <= 1024 and comm.size >= 8:
+            return alg.alltoall_bruck
+        return alg.alltoall_pairwise
+
+    def _pick_reduce(self, comm, nbytes, op):
+        return alg.reduce_binomial if op.commute else alg.reduce_linear
+
+    def _pick_barrier(self, comm):
+        return alg.barrier_bruck
+
+
+class TunedComponent(CollComponent):
+    name = "tuned"
+    priority = 30
+
+    def comm_query(self, comm):
+        return (self.priority, TunedModule())
+
+
+coll_framework.add_component(TunedComponent())

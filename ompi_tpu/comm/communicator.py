@@ -1,0 +1,464 @@
+"""Groups and communicators: the substrate of every parallelism axis.
+
+Re-design of ompi/communicator (ref: comm.c:406 ompi_comm_split,
+split_type :650-749; comm_cid.c:47-86 — CID allocation as an
+agreement over the parent communicator; ompi/group dense groups).
+
+A communicator is (cid, ordered list of global ranks, my position).
+CID agreement runs as a max-allreduce of each member's smallest free
+cid over the *parent* communicator using reserved internal tags,
+repeated until the agreed cid is free everywhere — the same
+multi-round idea as the reference, built on p2p so it works before
+any collective module exists.
+
+TPU mapping: a communicator whose member ranks own devices caches a
+1-D jax Mesh over those devices (comm ↔ sub-mesh), which coll/tpu
+uses to lower collectives onto the ICI axis (SURVEY.md §2.8).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ompi_tpu.datatype import engine as dtmod
+from ompi_tpu.pml.request import ANY_TAG, PROC_NULL, Status
+
+# internal tags (user tags must be >= 0)
+TAG_CID = -17
+TAG_SPLIT = -18
+TAG_BCAST = -19
+TAG_GATHER = -20
+
+UNDEFINED = -32766
+
+COMM_TYPE_SHARED = 1
+
+
+class Group:
+    """Dense ordered set of global ranks (ref: ompi/group)."""
+
+    def __init__(self, ranks: Sequence[int]) -> None:
+        self.ranks = list(ranks)
+
+    @property
+    def size(self) -> int:
+        return len(self.ranks)
+
+    def rank_of(self, global_rank: int) -> int:
+        try:
+            return self.ranks.index(global_rank)
+        except ValueError:
+            return UNDEFINED
+
+    def translate(self, other: "Group", rank: int) -> int:
+        return other.rank_of(self.ranks[rank])
+
+    def incl(self, ranks: Sequence[int]) -> "Group":
+        return Group([self.ranks[r] for r in ranks])
+
+    def excl(self, ranks: Sequence[int]) -> "Group":
+        drop = set(ranks)
+        return Group([g for i, g in enumerate(self.ranks) if i not in drop])
+
+    def union(self, other: "Group") -> "Group":
+        out = list(self.ranks)
+        out += [r for r in other.ranks if r not in set(self.ranks)]
+        return Group(out)
+
+    def intersection(self, other: "Group") -> "Group":
+        oset = set(other.ranks)
+        return Group([r for r in self.ranks if r in oset])
+
+    def difference(self, other: "Group") -> "Group":
+        oset = set(other.ranks)
+        return Group([r for r in self.ranks if r not in oset])
+
+
+class Communicator:
+    def __init__(self, state, cid: int, group: Group, name: str = "") -> None:
+        self.state = state
+        self.cid = cid
+        self._group = group
+        self.name = name or f"comm-{cid}"
+        self.rank = group.rank_of(state.rank)
+        self.size = group.size
+        self.coll: Any = None       # collective module stack (coll framework)
+        self.errhandler = None
+        self.attrs: Dict[int, Any] = {}
+        self.info: Dict[str, str] = {}
+        self.topo = None
+        self._mesh = None
+        state.comms[cid] = self
+        # stack collective modules (coll_base_comm_select analog);
+        # local-only, so safe even mid-split on a subset of ranks
+        from ompi_tpu.coll import framework as _coll_fw
+        _coll_fw.comm_select(self)
+
+    # group is exposed as the raw rank list for hot-path translation
+    @property
+    def group(self) -> List[int]:
+        return self._group.ranks
+
+    def group_obj(self) -> Group:
+        return Group(self._group.ranks)
+
+    # -- p2p shorthands used by comm management + coll/base --------------
+    def _pml(self):
+        return self.state.pml
+
+    def psend(self, obj: Any, dst: int, tag: int) -> None:
+        """Internal typed-object send (numpy int64 vectors)."""
+        arr = np.atleast_1d(np.asarray(obj, dtype=np.int64))
+        self._pml().send(arr, arr.size, dtmod.INT64_T, dst, tag, self)
+
+    def precv(self, n: int, src: int, tag: int) -> np.ndarray:
+        arr = np.empty(n, dtype=np.int64)
+        self._pml().recv(arr, n, dtmod.INT64_T, src, tag, self)
+        return arr
+
+    # -- cid agreement ---------------------------------------------------
+    def _allreduce_max_int(self, value: int, tag: int) -> int:
+        """Recursive-doubling-free simple max: gather to comm rank 0,
+        bcast back (used only for management traffic)."""
+        if self.size == 1:
+            return value
+        if self.rank == 0:
+            best = value
+            for r in range(1, self.size):
+                best = max(best, int(self.precv(1, r, tag)[0]))
+            for r in range(1, self.size):
+                self.psend(best, r, tag)
+            return best
+        self.psend(value, 0, tag)
+        return int(self.precv(1, 0, tag)[0])
+
+    def next_cid(self) -> int:
+        """Agree on a cid free on every member of *this* comm
+        (ref: ompi_comm_nextcid multi-round agreement)."""
+        while True:
+            proposal = self.state.next_cid_local()
+            agreed = self._allreduce_max_int(proposal, TAG_CID)
+            ok = 1 if agreed not in self.state.comms else 0
+            all_ok = self._allreduce_max_int(-ok, TAG_CID)  # max(-ok)=0 iff any not ok
+            if all_ok == -1:
+                return agreed
+            # else: someone had it taken; reserve and retry
+            self.state.comms.setdefault(agreed, None)
+
+    # -- management operations ------------------------------------------
+    def dup(self, name: str = "") -> "Communicator":
+        cid = self.next_cid()
+        return Communicator(self.state, cid, Group(self.group),
+                            name or f"{self.name}-dup")
+
+    def create(self, group: Group) -> Optional["Communicator"]:
+        """MPI_Comm_create: collective over the parent; ranks outside
+        `group` get None (MPI_COMM_NULL)."""
+        cid = self.next_cid()
+        if group.rank_of(self.state.rank) == UNDEFINED:
+            self.state.comms.setdefault(cid, None)  # keep cid reserved
+            return None
+        return Communicator(self.state, cid, group)
+
+    def split(self, color: int, key: int = 0) -> Optional["Communicator"]:
+        """MPI_Comm_split (ref: comm.c:406): gather (color,key) on
+        rank 0, compute ordered subgroups, scatter memberships, then a
+        single parent-wide cid round per resulting group."""
+        me = [color, key, self.state.rank]
+        if self.rank == 0:
+            table = [me] + [list(self.precv(3, r, TAG_SPLIT))
+                            for r in range(1, self.size)]
+            groups: Dict[int, List] = {}
+            for i, (c, k, g) in enumerate(table):
+                if c == UNDEFINED:
+                    continue
+                groups.setdefault(c, []).append((k, i, g))
+            for c in groups:
+                groups[c].sort()
+            # send each rank its group's global-rank list (or empty);
+            # fixed-size messages: [n, pad...] then payload
+            mine: List[int] = []
+            for r in range(self.size):
+                c = table[r][0]
+                payload = [] if c == UNDEFINED else \
+                    [g for (_, _, g) in groups[c]]
+                if r == 0:
+                    mine = payload
+                else:
+                    self.psend([len(payload)], r, TAG_SPLIT)
+                    if payload:
+                        self.psend(payload, r, TAG_SPLIT)
+        else:
+            self.psend(me, 0, TAG_SPLIT)
+            n = int(self.precv(1, 0, TAG_SPLIT)[0])
+            mine = [int(x) for x in self.precv(n, 0, TAG_SPLIT)] if n else []
+        # every parent rank participates in ONE cid agreement so the
+        # cid is globally fresh even across disjoint split groups
+        cid = self.next_cid()
+        if not mine:
+            self.state.comms.setdefault(cid, None)
+            return None
+        return Communicator(self.state, cid, Group(mine))
+
+    def split_type(self, split_type: int, key: int = 0
+                   ) -> Optional["Communicator"]:
+        """MPI_Comm_split_type (ref: comm.c:650-749).  On the TPU-host
+        model every thread-rank shares the node, so SHARED groups all
+        co-located ranks (locality via the rte)."""
+        node = getattr(self.state.rte, "node_id", 0)
+        if split_type == COMM_TYPE_SHARED:
+            return self.split(node, key)
+        return self.split(UNDEFINED, key)
+
+    def free(self) -> None:
+        self.state.comms.pop(self.cid, None)
+        # keep the cid burned so in-flight traffic can't alias it
+        self.state.comms.setdefault(self.cid, None)
+
+    # -- TPU mesh mapping (SURVEY.md §2.8) -------------------------------
+    def mesh(self):
+        """1-D jax Mesh over member devices, or None when members
+        don't own distinct devices (then coll/tpu is not eligible)."""
+        if self._mesh is not None:
+            return self._mesh
+        devs = []
+        for g in self.group:
+            st = self._peer_state(g)
+            if st is None or st.device is None:
+                return None
+            devs.append(st.device)
+        if len({d.id for d in devs}) != len(devs):
+            return None
+        import numpy as _np
+        from jax.sharding import Mesh
+        self._mesh = Mesh(_np.array(devs), ("r",))
+        return self._mesh
+
+    def _peer_state(self, global_rank: int):
+        world = getattr(self.state.rte, "world", None)
+        if world is None:
+            return self.state if global_rank == self.state.rank else None
+        return world.states[global_rank]
+
+    def abort(self, errorcode: int = 1) -> None:
+        self.state.rte.abort(errorcode, f"abort on {self.name}")
+
+    # ------------------------------------------------------------------
+    # Public MPI API (mpi4py-flavored buffer methods).  Buffer specs:
+    # a numpy array (count/datatype inferred), or (buf, datatype), or
+    # (buf, count, datatype).  Mirrors the 385-binding C surface
+    # (ref: ompi/mpi/c/*.c) at Python altitude; the flat MPI_* names
+    # live in ompi_tpu.mpi.
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _spec(spec):
+        from ompi_tpu.coll.buffers import IN_PLACE
+        if spec is IN_PLACE:
+            return IN_PLACE, 0, None
+        if isinstance(spec, tuple):
+            if len(spec) == 3:
+                return spec
+            if len(spec) == 2:
+                buf, dt = spec
+                n = np.asarray(buf).nbytes // dt.size if dt.size else 0
+                return buf, n, dt
+        arr = spec
+        dt = dtmod.from_numpy_dtype(arr.dtype)
+        return arr, arr.size, dt
+
+    @staticmethod
+    def _check_tag(tag: int, recv: bool = False) -> None:
+        """User tags must be >= 0 (negative space is reserved for comm
+        management/collective traffic); ANY_TAG legal on receives."""
+        if tag < 0 and not (recv and tag == -1):
+            raise ValueError(
+                f"invalid tag {tag}: user tags must be >= 0 (MPI_ERR_TAG)")
+
+    # -- p2p ------------------------------------------------------------
+    def Send(self, spec, dest: int, tag: int = 0) -> None:
+        self._check_tag(tag)
+        buf, count, dt = self._spec(spec)
+        self.state.pml.send(buf, count, dt, dest, tag, self)
+
+    def Ssend(self, spec, dest: int, tag: int = 0) -> None:
+        from ompi_tpu.pml.ob1 import MODE_SYNC
+        self._check_tag(tag)
+        buf, count, dt = self._spec(spec)
+        self.state.pml.send(buf, count, dt, dest, tag, self, MODE_SYNC)
+
+    def Recv(self, spec, source: int = -1, tag: int = -1) -> Status:
+        self._check_tag(tag, recv=True)
+        buf, count, dt = self._spec(spec)
+        return self.state.pml.recv(buf, count, dt, source, tag, self)
+
+    def Isend(self, spec, dest: int, tag: int = 0):
+        self._check_tag(tag)
+        buf, count, dt = self._spec(spec)
+        return self.state.pml.isend(buf, count, dt, dest, tag, self)
+
+    def Issend(self, spec, dest: int, tag: int = 0):
+        from ompi_tpu.pml.ob1 import MODE_SYNC
+        self._check_tag(tag)
+        buf, count, dt = self._spec(spec)
+        return self.state.pml.isend(buf, count, dt, dest, tag, self,
+                                    MODE_SYNC)
+
+    def Irecv(self, spec, source: int = -1, tag: int = -1):
+        self._check_tag(tag, recv=True)
+        buf, count, dt = self._spec(spec)
+        return self.state.pml.irecv(buf, count, dt, source, tag, self)
+
+    def Sendrecv(self, sspec, dest: int, stag: int, rspec, source: int,
+                 rtag: int = -1) -> Status:
+        rreq = self.Irecv(rspec, source, rtag)
+        self.Send(sspec, dest, stag)
+        return rreq.wait()
+
+    def Probe(self, source: int = -1, tag: int = -1) -> Status:
+        return self.state.pml.probe(source, tag, self)
+
+    def Iprobe(self, source: int = -1, tag: int = -1) -> Optional[Status]:
+        return self.state.pml.iprobe(source, tag, self)
+
+    def Mprobe(self, source: int = -1, tag: int = -1):
+        while True:
+            m = self.state.pml.improbe(source, tag, self)
+            if m is not None:
+                return m
+
+    def Mrecv(self, spec, message) -> Status:
+        buf, count, dt = self._spec(spec)
+        return self.state.pml.mrecv(buf, count, dt, message, self)
+
+    # -- collectives ----------------------------------------------------
+    def Barrier(self) -> None:
+        self.coll.barrier(self)
+
+    barrier = Barrier
+
+    def Bcast(self, spec, root: int = 0) -> None:
+        buf, count, dt = self._spec(spec)
+        self.coll.bcast(self, buf, count, dt, root)
+
+    def Reduce(self, sspec, rspec, op, root: int = 0) -> None:
+        from ompi_tpu.coll.buffers import IN_PLACE
+        sbuf, scount, sdt = self._spec(sspec)
+        if rspec is None:
+            self.coll.reduce(self, sbuf, None, scount, sdt, op, root)
+            return
+        rbuf, rcount, rdt = self._spec(rspec)
+        if sbuf is IN_PLACE:
+            scount, sdt = rcount, rdt
+        self.coll.reduce(self, sbuf, rbuf, rcount if rcount else scount,
+                         rdt or sdt, op, root)
+
+    def Allreduce(self, sspec, rspec, op) -> None:
+        from ompi_tpu.coll.buffers import IN_PLACE
+        sbuf, scount, sdt = self._spec(sspec)
+        rbuf, rcount, rdt = self._spec(rspec)
+        self.coll.allreduce(self, sbuf, rbuf, rcount, rdt, op)
+
+    def Allgather(self, sspec, rspec) -> None:
+        sbuf, scount, sdt = self._spec(sspec)
+        rbuf, rcount, rdt = self._spec(rspec)
+        self.coll.allgather(self, sbuf, scount, sdt, rbuf,
+                            rcount // self.size, rdt)
+
+    def Allgatherv(self, sspec, rspec, rcounts, displs) -> None:
+        sbuf, scount, sdt = self._spec(sspec)
+        rbuf, _, rdt = self._spec(rspec)
+        self.coll.allgatherv(self, sbuf, scount, sdt, rbuf, rcounts,
+                             displs, rdt)
+
+    def Gather(self, sspec, rspec, root: int = 0) -> None:
+        sbuf, scount, sdt = self._spec(sspec)
+        if self.rank == root:
+            rbuf, rcount, rdt = self._spec(rspec)
+            self.coll.gather(self, sbuf, scount, sdt, rbuf,
+                             rcount // self.size, rdt, root)
+        else:
+            self.coll.gather(self, sbuf, scount, sdt, None, 0, sdt, root)
+
+    def Gatherv(self, sspec, rspec, rcounts, displs, root: int = 0) -> None:
+        sbuf, scount, sdt = self._spec(sspec)
+        if self.rank == root:
+            rbuf, _, rdt = self._spec(rspec)
+        else:
+            rbuf, rdt = None, sdt
+        self.coll.gatherv(self, sbuf, scount, sdt, rbuf, rcounts, displs,
+                          rdt, root)
+
+    def Scatter(self, sspec, rspec, root: int = 0) -> None:
+        rbuf, rcount, rdt = self._spec(rspec)
+        if self.rank == root:
+            sbuf, scount, sdt = self._spec(sspec)
+            self.coll.scatter(self, sbuf, scount // self.size, sdt, rbuf,
+                              rcount, rdt, root)
+        else:
+            self.coll.scatter(self, None, 0, rdt, rbuf, rcount, rdt, root)
+
+    def Scatterv(self, sspec, scounts, displs, rspec, root: int = 0) -> None:
+        rbuf, rcount, rdt = self._spec(rspec)
+        if self.rank == root:
+            sbuf, _, sdt = self._spec(sspec)
+        else:
+            sbuf, sdt = None, rdt
+        self.coll.scatterv(self, sbuf, scounts, displs, sdt, rbuf, rcount,
+                           rdt, root)
+
+    def Alltoall(self, sspec, rspec) -> None:
+        sbuf, scount, sdt = self._spec(sspec)
+        rbuf, rcount, rdt = self._spec(rspec)
+        self.coll.alltoall(self, sbuf, scount // self.size, sdt, rbuf,
+                           rcount // self.size, rdt)
+
+    def Alltoallv(self, sspec, scounts, sdispls, rspec, rcounts,
+                  rdispls) -> None:
+        sbuf, _, sdt = self._spec(sspec)
+        rbuf, _, rdt = self._spec(rspec)
+        self.coll.alltoallv(self, sbuf, scounts, sdispls, sdt, rbuf,
+                            rcounts, rdispls, rdt)
+
+    def Reduce_scatter(self, sspec, rspec, rcounts, op) -> None:
+        sbuf, _, sdt = self._spec(sspec)
+        rbuf, _, rdt = self._spec(rspec)
+        self.coll.reduce_scatter(self, sbuf, rbuf, rcounts, rdt, op,
+                                 sdtype=sdt)
+
+    def Reduce_scatter_block(self, sspec, rspec, op) -> None:
+        sbuf, scount, sdt = self._spec(sspec)
+        rbuf, rcount, rdt = self._spec(rspec)
+        self.coll.reduce_scatter_block(self, sbuf, rbuf, rcount, rdt, op)
+
+    def Scan(self, sspec, rspec, op) -> None:
+        sbuf, scount, sdt = self._spec(sspec)
+        rbuf, rcount, rdt = self._spec(rspec)
+        self.coll.scan(self, sbuf, rbuf, rcount, rdt, op)
+
+    def Exscan(self, sspec, rspec, op) -> None:
+        sbuf, scount, sdt = self._spec(sspec)
+        rbuf, rcount, rdt = self._spec(rspec)
+        self.coll.exscan(self, sbuf, rbuf, rcount, rdt, op)
+
+    # -- management shorthands -----------------------------------------
+    def Get_rank(self) -> int:
+        return self.rank
+
+    def Get_size(self) -> int:
+        return self.size
+
+    def Dup(self) -> "Communicator":
+        return self.dup()
+
+    def Split(self, color: int, key: int = 0):
+        return self.split(color, key)
+
+    def Free(self) -> None:
+        self.free()
+
+    def __repr__(self) -> str:
+        return (f"Communicator({self.name}, cid={self.cid}, "
+                f"rank={self.rank}/{self.size})")

@@ -1,0 +1,124 @@
+"""Request lifecycle + completion (ref: ompi/request/request.h:381-432
+— wait blocks on wait_sync, completion via atomic state transition;
+test/wait{,all,any,some} in ompi/mpi/c/).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, List, Optional
+
+from ompi_tpu.runtime.progress import Progress, WaitSync
+
+ANY_SOURCE = -1
+ANY_TAG = -1
+PROC_NULL = -2
+
+SUCCESS = 0
+ERR_TRUNCATE = 15
+ERR_PENDING = 19
+
+
+class Status:
+    __slots__ = ("source", "tag", "error", "count", "cancelled")
+
+    def __init__(self) -> None:
+        self.source = ANY_SOURCE
+        self.tag = ANY_TAG
+        self.error = SUCCESS
+        self.count = 0
+        self.cancelled = False
+
+    def get_count(self, datatype) -> int:
+        if datatype.size == 0:
+            return 0
+        if self.count % datatype.size:
+            return -1  # MPI_UNDEFINED
+        return self.count // datatype.size
+
+    def __repr__(self) -> str:
+        return (f"Status(src={self.source}, tag={self.tag}, "
+                f"err={self.error}, count={self.count})")
+
+
+class Request:
+    """Base request; owned (progressed) by the rank that created it."""
+
+    def __init__(self, progress: Progress) -> None:
+        self._progress = progress
+        self._sync = WaitSync(1)
+        self.status = Status()
+        self.complete = False
+        self.cancelled = False
+        self.persistent = False
+        self.active = True
+
+    def _complete(self) -> None:
+        self.complete = True
+        self._sync.signal()
+
+    def test(self) -> bool:
+        if not self.complete:
+            self._progress.progress()
+        return self.complete
+
+    def wait(self, timeout: Optional[float] = None) -> Status:
+        if not self.complete:
+            self._sync.wait(self._progress, timeout)
+        if not self.complete:
+            raise TimeoutError("request wait timed out")
+        return self.status
+
+    def cancel(self) -> None:
+        """Best-effort MPI_Cancel (only unmatched receives succeed;
+        matched/sent requests run to normal completion, per MPI)."""
+        canceller = getattr(self, "_canceller", None)
+        if canceller is not None and not self.complete:
+            canceller(self)
+
+    def free(self) -> None:
+        pass
+
+
+class CompletedRequest(Request):
+    """Immediately-complete request (send-to-PROC_NULL etc.)."""
+
+    def __init__(self, progress: Progress, count: int = 0) -> None:
+        super().__init__(progress)
+        self.status.count = count
+        self._complete()
+
+
+def wait_all(reqs: List[Request], timeout: Optional[float] = None
+             ) -> List[Status]:
+    deadline = None if timeout is None else time.monotonic() + timeout
+    for r in reqs:
+        t = None if deadline is None else max(0.0, deadline - time.monotonic())
+        r.wait(t)
+    return [r.status for r in reqs]
+
+
+def wait_any(reqs: List[Request]) -> int:
+    if not reqs:
+        return -1
+    while True:
+        for i, r in enumerate(reqs):
+            if r.complete:
+                return i
+        reqs[0]._progress.progress()
+
+
+def wait_some(reqs: List[Request]) -> List[int]:
+    while True:
+        done = [i for i, r in enumerate(reqs) if r.complete]
+        if done:
+            return done
+        reqs[0]._progress.progress()
+
+
+def test_all(reqs: List[Request]) -> bool:
+    for r in reqs:
+        if not r.complete:
+            r._progress.progress()
+            break
+    return all(r.complete for r in reqs)

@@ -1,0 +1,61 @@
+"""MPI bring-up / teardown for one rank.
+
+Mirrors the ompi_mpi_init sequence (ref: ompi/runtime/ompi_mpi_init.c:
+rte init → frameworks open → pml select → modex fence → add_procs →
+comm_world/self → coll select → final fence) and ompi_mpi_finalize.c's
+reverse teardown.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ompi_tpu.btl import base as btl_base
+from ompi_tpu.btl import inproc as _btl_inproc  # noqa: F401 (registers)
+from ompi_tpu.comm.communicator import Communicator, Group
+from ompi_tpu.pml import ob1 as _pml_ob1
+from .state import ProcState, set_current
+
+
+def mpi_init(state: ProcState, device=None) -> ProcState:
+    set_current(state)
+    state.device = device
+    # 1. select the single pml engine (ref: ompi_mpi_init.c:640)
+    comp, pml_cls = _pml_ob1.pml_framework.select_one(state)
+    state.pml = pml_cls(state)
+    # 2. btl modules + endpoint wiring (modex happens inside init)
+    modules = []
+    for c in btl_base.btl_framework.components():
+        modules += c.init_modules(state)
+    state.btls = modules
+    # publish our state for inproc peers, then fence (modex sync #1,
+    # ref: ompi_mpi_init.c:654-661)
+    world = getattr(state.rte, "world", None)
+    if world is not None:
+        world.states[state.rank] = state
+    state.rte.fence()
+    endpoints = btl_base.wire_endpoints(state, modules)
+    state.pml.add_procs(endpoints)
+    # 3. predefined communicators: world cid 0, self cid 1
+    state.comm_world = Communicator(state, 0, Group(range(state.size)),
+                                    name="MPI_COMM_WORLD")
+    state.comm_self = Communicator(state, 1, Group([state.rank]),
+                                   name="MPI_COMM_SELF")
+    # 4. collective module stacks are installed by Communicator
+    # construction itself (coll_base_comm_select analog)
+    # 5. final fence before returning (sync #2, ref: :833-838)
+    state.rte.fence()
+    state.initialized = True
+    return state
+
+
+def mpi_finalize(state: ProcState) -> None:
+    if state.finalized:
+        return
+    # barrier, then teardown in reverse (ref: ompi_mpi_finalize.c:101)
+    state.rte.fence()
+    for m in state.btls:
+        m.finalize()
+    state.rte.finalize()
+    state.finalized = True
+    set_current(None)

@@ -35,6 +35,13 @@ class Progress:
         self._lp_callbacks: List[Callable[[], int]] = []
         self._counter = 0
         self._lock = threading.Lock()
+        # Doorbell peers ring when they enqueue work for this rank, so
+        # a rank parked in WaitSync wakes immediately instead of
+        # polling (the wait_sync condvar signal in the reference).
+        self.doorbell = threading.Event()
+
+    def wakeup(self) -> None:
+        self.doorbell.set()
 
     def register(self, cb: Callable[[], int], low_priority: bool = False) -> None:
         with self._lock:
@@ -96,9 +103,13 @@ class WaitSync:
         while not self._event.is_set():
             if progress.progress() == 0:
                 spins += 1
-                if spins > 1000:
-                    # Park briefly; remote completions set the event.
-                    self._event.wait(0.0005)
+                if spins > 200:
+                    # Park on the doorbell; peers ring it when they
+                    # enqueue frags for us (cross-thread wakeup).
+                    progress.doorbell.clear()
+                    if progress.progress() == 0 and not self._event.is_set():
+                        progress.doorbell.wait(0.01)
+                    spins = 0
             else:
                 spins = 0
             if deadline is not None and time.monotonic() > deadline:

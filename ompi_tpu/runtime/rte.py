@@ -1,0 +1,88 @@
+"""Runtime-environment abstraction: the contract the MPI layer needs
+from any runtime.
+
+This mirrors the reference's rte interface spec exactly
+(ref: ompi/mca/rte/rte.h:35-145): process naming, modex put/get
+(business-card exchange), barrier/fence, abort, and init/finalize.
+Implementations:
+
+  * InprocRTE — thread-ranks inside one host process (the TPU-host
+    model; also the fast test harness).  Modex is a shared dict,
+    fence a threading.Barrier.
+  * EnvRTE — process-ranks launched by ompi_tpu.tools.launch; modex
+    and fence go through the launcher's KV store over TCP (the
+    PMIx-like put/commit/fence, ref: opal/mca/pmix usage in
+    ompi_mpi_init.c:654-661).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, List, Optional
+
+
+class RTE:
+    rank: int
+    size: int
+
+    def modex_put(self, key: str, value: Any) -> None:
+        raise NotImplementedError
+
+    def modex_get(self, peer: int, key: str) -> Any:
+        raise NotImplementedError
+
+    def fence(self) -> None:
+        raise NotImplementedError
+
+    def abort(self, code: int, msg: str = "") -> None:
+        raise NotImplementedError
+
+    def finalize(self) -> None:
+        pass
+
+
+class InprocWorld:
+    """Shared state for an N-thread-rank world on one host."""
+
+    def __init__(self, size: int) -> None:
+        self.size = size
+        self.modex: Dict[tuple, Any] = {}
+        self.modex_cv = threading.Condition()
+        self.barrier = threading.Barrier(size)
+        self.states: List[Any] = [None] * size  # ProcState per rank
+        self.aborted: Optional[tuple] = None
+
+    def make_rte(self, rank: int) -> "InprocRTE":
+        return InprocRTE(self, rank)
+
+
+class InprocRTE(RTE):
+    def __init__(self, world: InprocWorld, rank: int) -> None:
+        self.world = world
+        self.rank = rank
+        self.size = world.size
+
+    def modex_put(self, key: str, value: Any) -> None:
+        with self.world.modex_cv:
+            self.world.modex[(self.rank, key)] = value
+            self.world.modex_cv.notify_all()
+
+    def modex_get(self, peer: int, key: str) -> Any:
+        with self.world.modex_cv:
+            while (peer, key) not in self.world.modex:
+                if self.world.aborted:
+                    raise RuntimeError(f"job aborted: {self.world.aborted}")
+                if not self.world.modex_cv.wait(timeout=30):
+                    raise TimeoutError(
+                        f"modex_get({peer},{key}) timed out")
+            return self.world.modex[(peer, key)]
+
+    def fence(self) -> None:
+        self.world.barrier.wait(timeout=60)
+
+    def abort(self, code: int, msg: str = "") -> None:
+        self.world.aborted = (self.rank, code, msg)
+        with self.world.modex_cv:
+            self.world.modex_cv.notify_all()
+        raise SystemExit(code)

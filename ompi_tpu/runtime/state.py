@@ -1,0 +1,67 @@
+"""Per-rank process state and the thread-local current() accessor.
+
+The TPU-native execution model (see docs/DESIGN.md): on a TPU host a
+single OS process drives every local chip, so MPI ranks are *threads
+mapped to devices* inside the host process, and *processes across
+hosts*.  Either way each rank owns one ProcState carrying its
+identity, progress engine, pml, btl endpoints and communicator table
+— the analog of the per-process globals ompi_mpi_init.c sets up.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+from .progress import Progress
+
+
+class ProcState:
+    def __init__(self, rank: int, size: int, rte: Any) -> None:
+        self.rank = rank
+        self.size = size
+        self.rte = rte
+        self.progress = Progress()
+        self.pml: Any = None
+        self.btls: list = []
+        self.comms: Dict[int, Any] = {}  # cid -> Communicator
+        self.comm_world: Any = None
+        self.comm_self: Any = None
+        self.device: Any = None  # jax device owned by this rank (may be None)
+        self.finalized = False
+        self.initialized = False
+        self.extra: Dict[str, Any] = {}
+
+    def next_cid_local(self) -> int:
+        """Lower bound for CID agreement: smallest unused local cid."""
+        cid = 0
+        while cid in self.comms:
+            cid += 1
+        return cid
+
+
+_tls = threading.local()
+_process_state: Optional[ProcState] = None
+
+
+def set_current(state: Optional[ProcState], process_wide: bool = False) -> None:
+    global _process_state
+    if process_wide:
+        _process_state = state
+    else:
+        _tls.state = state
+
+
+def current() -> ProcState:
+    st = getattr(_tls, "state", None)
+    if st is None:
+        st = _process_state
+    if st is None:
+        raise RuntimeError(
+            "MPI is not initialized in this thread (no ProcState)")
+    return st
+
+
+def maybe_current() -> Optional[ProcState]:
+    st = getattr(_tls, "state", None)
+    return st if st is not None else _process_state

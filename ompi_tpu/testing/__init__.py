@@ -1,0 +1,94 @@
+"""Thread-rank world harness.
+
+Runs N MPI ranks as threads in one process — the TPU-host execution
+model (one process drives all local chips; ranks map to devices) and
+the fast path for exercising the full stack in tests, mirroring how
+the reference tests mapping logic without a cluster via ras/simulator
+(ref: orte/mca/ras/simulator/ras_sim_module.c:67-91).
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+from typing import Any, Callable, List, Optional
+
+from ompi_tpu.runtime.init import mpi_finalize, mpi_init
+from ompi_tpu.runtime.rte import InprocWorld
+from ompi_tpu.runtime.state import ProcState
+
+
+class RankError(RuntimeError):
+    def __init__(self, rank: int, exc: BaseException, tb: str) -> None:
+        super().__init__(f"rank {rank} failed: {exc}\n{tb}")
+        self.rank = rank
+        self.exc = exc
+
+
+def run_ranks(n: int, fn: Callable, devices: bool = False,
+              timeout: float = 120.0) -> List[Any]:
+    """Run fn(comm_world) on n thread-ranks; returns per-rank results.
+
+    devices=True maps rank i to jax.devices()[i % ndev] so coll/tpu
+    and coll/hbm become eligible.
+    """
+    world = InprocWorld(n)
+    results: List[Any] = [None] * n
+    errors: List[Optional[RankError]] = [None] * n
+    devs = None
+    if devices:
+        import jax
+        devs = jax.devices()
+
+    def runner(rank: int) -> None:
+        try:
+            rte = world.make_rte(rank)
+            state = ProcState(rank, n, rte)
+            world.states[rank] = state
+            dev = devs[rank % len(devs)] if devs else None
+            mpi_init(state, device=dev)
+
+            def _abort_check() -> int:
+                if world.aborted and world.aborted[0] != rank:
+                    raise RuntimeError(
+                        f"peer rank {world.aborted[0]} aborted: "
+                        f"{world.aborted[2]}")
+                return 0
+
+            state.progress.register(_abort_check, low_priority=True)
+            results[rank] = fn(state.comm_world)
+            # finalize only on success: its fence would deadlock
+            # against peers that died before reaching it
+            mpi_finalize(state)
+        except BaseException as e:  # noqa: BLE001
+            errors[rank] = RankError(rank, e, traceback.format_exc())
+            if world.aborted is None:
+                world.aborted = (rank, 1, str(e))
+            try:
+                world.barrier.abort()
+            except Exception:
+                pass
+            for st in world.states:
+                if st is not None:
+                    st.progress.wakeup()
+
+    threads = [threading.Thread(target=runner, args=(r,), daemon=True,
+                                name=f"mpi-rank-{r}")
+               for r in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout)
+        if t.is_alive():
+            raise TimeoutError(
+                f"rank thread {t.name} did not finish within {timeout}s "
+                f"(likely deadlock); errors so far: "
+                f"{[e for e in errors if e]}")
+    # surface the root cause: the rank that aborted first, not the
+    # peers that failed reacting to the abort
+    if world.aborted is not None and errors[world.aborted[0]] is not None:
+        raise errors[world.aborted[0]]
+    for e in errors:
+        if e is not None:
+            raise e
+    return results

@@ -1,0 +1,488 @@
+"""Collective tests: every algorithm vs numpy reference across
+comm sizes (incl. non-power-of-2), IN_PLACE, derived datatypes,
+non-commutative ops (badcoll.c / bcast_loop.c spirit).
+"""
+
+import numpy as np
+import pytest
+
+from ompi_tpu.coll import base as alg
+from ompi_tpu.coll.buffers import IN_PLACE
+from ompi_tpu.datatype import engine as dt
+from ompi_tpu.op import op as mpi_op
+from ompi_tpu.testing import run_ranks
+
+SIZES = [1, 2, 3, 5, 8]
+
+
+@pytest.mark.parametrize("n", SIZES)
+def test_allreduce_sum(n):
+    def fn(comm):
+        x = (np.arange(17, dtype=np.float64) + comm.rank)
+        r = np.empty_like(x)
+        comm.Allreduce(x, r, mpi_op.SUM)
+        return r
+
+    res = run_ranks(n, fn)
+    exp = sum((np.arange(17, dtype=np.float64) + k) for k in range(n))
+    for r in res:
+        np.testing.assert_allclose(r, exp)
+
+
+@pytest.mark.parametrize("opname,npop", [
+    ("MAX", np.maximum), ("MIN", np.minimum), ("PROD", np.multiply)])
+def test_allreduce_ops(opname, npop):
+    n = 4
+
+    def fn(comm):
+        x = np.array([comm.rank + 1, 5 - comm.rank], dtype=np.int32)
+        r = np.empty_like(x)
+        comm.Allreduce(x, r, getattr(mpi_op, opname))
+        return r
+
+    res = run_ranks(n, fn)
+    vals = [np.array([k + 1, 5 - k], dtype=np.int32) for k in range(n)]
+    exp = vals[0]
+    for v in vals[1:]:
+        exp = npop(exp, v)
+    for r in res:
+        np.testing.assert_array_equal(r, exp)
+
+
+def test_allreduce_in_place():
+    def fn(comm):
+        x = np.full(9, comm.rank + 1.0, dtype=np.float32)
+        comm.Allreduce(IN_PLACE, x, mpi_op.SUM)
+        return x
+
+    res = run_ranks(4, fn)
+    for r in res:
+        np.testing.assert_allclose(r, np.full(9, 10.0))
+
+
+def test_allreduce_maxloc():
+    def fn(comm):
+        x = np.zeros(3, dtype=dt.DOUBLE_INT.base)
+        x["v"] = [comm.rank, -comm.rank, comm.rank * (-1) ** comm.rank]
+        x["i"] = comm.rank
+        r = np.zeros_like(x)
+        comm.Allreduce((x, 3, dt.DOUBLE_INT), (r, 3, dt.DOUBLE_INT),
+                       mpi_op.MAXLOC)
+        return r
+
+    n = 5
+    res = run_ranks(n, fn)
+    for r in res:
+        assert r["v"][0] == n - 1 and r["i"][0] == n - 1
+        assert r["v"][1] == 0 and r["i"][1] == 0
+        assert r["v"][2] == 4 and r["i"][2] == 4
+
+
+@pytest.mark.parametrize("n", SIZES)
+def test_bcast(n):
+    def fn(comm):
+        buf = np.arange(33, dtype=np.int64) if comm.rank == 2 % n \
+            else np.zeros(33, dtype=np.int64)
+        comm.Bcast(buf, root=2 % n)
+        return buf
+
+    res = run_ranks(n, fn)
+    for r in res:
+        np.testing.assert_array_equal(r, np.arange(33))
+
+
+def test_bcast_pipeline_large():
+    def fn(comm):
+        buf = (np.arange(600_000, dtype=np.float32) if comm.rank == 0
+               else np.zeros(600_000, dtype=np.float32))
+        comm.Bcast(buf, root=0)  # tuned picks pipeline above 256 KiB
+        return buf[::100_000].copy()
+
+    res = run_ranks(4, fn)
+    for r in res:
+        np.testing.assert_array_equal(
+            r, np.arange(600_000, dtype=np.float32)[::100_000])
+
+
+@pytest.mark.parametrize("n", SIZES)
+def test_reduce(n):
+    def fn(comm):
+        x = np.arange(5, dtype=np.int64) * (comm.rank + 1)
+        r = np.zeros(5, np.int64) if comm.rank == 0 else None
+        comm.Reduce(x, r, mpi_op.SUM, root=0)
+        return r
+
+    res = run_ranks(n, fn)
+    exp = np.arange(5, dtype=np.int64) * sum(range(1, n + 1))
+    np.testing.assert_array_equal(res[0], exp)
+    assert all(r is None for r in res[1:])
+
+
+def test_reduce_noncommutative_user_op_ordering():
+    """Non-commutative op must fold in rank order."""
+    def fold(invec, inoutvec, _dt):
+        # "concatenate digits": a*10 + b — order sensitive
+        inoutvec[:] = invec * 10 + inoutvec
+
+    op = mpi_op.create(fold, commute=False)
+
+    def fn(comm):
+        x = np.array([comm.rank + 1], dtype=np.int64)
+        r = np.zeros(1, np.int64) if comm.rank == 0 else None
+        comm.Reduce(x, r, op, root=0)
+        return None if r is None else int(r[0])
+
+    res = run_ranks(4, fn)
+    # rank order fold: ((1*10+2)*10+3)*10+4 = 1234
+    assert res[0] == 1234
+
+
+@pytest.mark.parametrize("n", SIZES)
+def test_allgather(n):
+    def fn(comm):
+        mine = np.array([comm.rank * 7, comm.rank], dtype=np.int32)
+        out = np.zeros(2 * n, dtype=np.int32)
+        comm.Allgather(mine, out)
+        return out
+
+    res = run_ranks(n, fn)
+    exp = np.concatenate([[k * 7, k] for k in range(n)]).astype(np.int32)
+    for r in res:
+        np.testing.assert_array_equal(r, exp)
+
+
+def test_allgather_algorithms_direct():
+    for algo in (alg.allgather_ring, alg.allgather_bruck,
+                 alg.allgather_linear):
+        def fn(comm, algo=algo):
+            mine = np.array([comm.rank], dtype=np.int64)
+            out = np.zeros(comm.size, dtype=np.int64)
+            algo(comm, mine, out)
+            return out
+
+        for n in (2, 3, 5, 8):
+            res = run_ranks(n, fn)
+            for r in res:
+                np.testing.assert_array_equal(r, np.arange(n))
+
+
+def test_allgather_recursivedoubling_pow2():
+    def fn(comm):
+        mine = np.array([comm.rank, comm.rank + 10], dtype=np.int64)
+        out = np.zeros(2 * comm.size, dtype=np.int64)
+        alg.allgather_recursivedoubling(comm, mine, out)
+        return out
+
+    for n in (2, 4, 8):
+        res = run_ranks(n, fn)
+        exp = np.concatenate([[k, k + 10] for k in range(n)])
+        for r in res:
+            np.testing.assert_array_equal(r, exp)
+
+
+def test_allgatherv():
+    def fn(comm):
+        cnt = comm.rank + 1
+        mine = np.full(cnt, comm.rank, dtype=np.int32)
+        counts = [k + 1 for k in range(comm.size)]
+        displs = np.cumsum([0] + counts[:-1]).tolist()
+        out = np.zeros(sum(counts), dtype=np.int32)
+        comm.Allgatherv(mine, out, counts, displs)
+        return out
+
+    n = 4
+    res = run_ranks(n, fn)
+    exp = np.concatenate([np.full(k + 1, k) for k in range(n)]).astype(np.int32)
+    for r in res:
+        np.testing.assert_array_equal(r, exp)
+
+
+@pytest.mark.parametrize("n", SIZES)
+def test_gather_scatter(n):
+    def fn(comm):
+        mine = np.array([comm.rank ** 2], dtype=np.int64)
+        gathered = np.zeros(n, dtype=np.int64) if comm.rank == 0 else None
+        comm.Gather(mine, gathered, root=0)
+        back = np.zeros(1, dtype=np.int64)
+        sbuf = (gathered + 100) if comm.rank == 0 else None
+        comm.Scatter(sbuf, back, root=0)
+        return int(back[0])
+
+    res = run_ranks(n, fn)
+    assert res == [k ** 2 + 100 for k in range(n)]
+
+
+def test_gather_binomial_direct():
+    def fn(comm):
+        mine = np.array([comm.rank * 3], dtype=np.int64)
+        out = np.zeros(comm.size, dtype=np.int64) if comm.rank == 1 else None
+        alg.gather_binomial(comm, mine, out, root=1)
+        return out
+
+    for n in (2, 3, 5, 8):
+        res = run_ranks(n, fn)
+        np.testing.assert_array_equal(res[1], np.arange(n) * 3)
+
+
+def test_gatherv_scatterv():
+    def fn(comm):
+        n = comm.size
+        counts = [2 * (k + 1) for k in range(n)]
+        displs = np.cumsum([0] + counts[:-1]).tolist()
+        mine = np.full(counts[comm.rank], comm.rank, dtype=np.float64)
+        rbuf = np.zeros(sum(counts)) if comm.rank == 0 else None
+        comm.Gatherv(mine, rbuf, counts, displs, root=0)
+        out = np.zeros(counts[comm.rank])
+        comm.Scatterv(rbuf, counts, displs, out, root=0)
+        return out
+
+    n = 3
+    res = run_ranks(n, fn)
+    for k, r in enumerate(res):
+        np.testing.assert_array_equal(r, np.full(2 * (k + 1), k))
+
+
+@pytest.mark.parametrize("n", SIZES)
+def test_alltoall(n):
+    def fn(comm):
+        sbuf = np.array([comm.rank * 100 + d for d in range(n)],
+                        dtype=np.int32)
+        rbuf = np.zeros(n, dtype=np.int32)
+        comm.Alltoall(sbuf, rbuf)
+        return rbuf
+
+    res = run_ranks(n, fn)
+    for k, r in enumerate(res):
+        np.testing.assert_array_equal(
+            r, np.array([s * 100 + k for s in range(n)], dtype=np.int32))
+
+
+def test_alltoall_algorithms_direct():
+    for algo in (alg.alltoall_linear, alg.alltoall_pairwise,
+                 alg.alltoall_bruck):
+        def fn(comm, algo=algo):
+            n = comm.size
+            sbuf = np.array([comm.rank * 100 + d for d in range(n)],
+                            dtype=np.int64)
+            rbuf = np.zeros(n, dtype=np.int64)
+            algo(comm, sbuf, rbuf)
+            return rbuf
+
+        for n in (2, 3, 5, 8):
+            res = run_ranks(n, fn)
+            for k, r in enumerate(res):
+                np.testing.assert_array_equal(
+                    r, [s * 100 + k for s in range(n)])
+
+
+def test_alltoallv():
+    def fn(comm):
+        n = comm.size
+        scounts = [(comm.rank + d) % n + 1 for d in range(n)]
+        sdispls = np.cumsum([0] + scounts[:-1]).tolist()
+        sbuf = np.concatenate(
+            [np.full(scounts[d], comm.rank * 10 + d, np.int64)
+             for d in range(n)])
+        rcounts = [(s + comm.rank) % n + 1 for s in range(n)]
+        rdispls = np.cumsum([0] + rcounts[:-1]).tolist()
+        rbuf = np.zeros(sum(rcounts), dtype=np.int64)
+        comm.Alltoallv(sbuf, scounts, sdispls, rbuf, rcounts, rdispls)
+        for s in range(n):
+            seg = rbuf[rdispls[s]:rdispls[s] + rcounts[s]]
+            np.testing.assert_array_equal(
+                seg, np.full(rcounts[s], s * 10 + comm.rank))
+        return True
+
+    assert all(run_ranks(4, fn))
+
+
+@pytest.mark.parametrize("n", SIZES)
+def test_reduce_scatter_block(n):
+    def fn(comm):
+        sbuf = np.arange(3 * n, dtype=np.float64) + comm.rank
+        rbuf = np.zeros(3, dtype=np.float64)
+        comm.Reduce_scatter_block(sbuf, rbuf, mpi_op.SUM)
+        return rbuf
+
+    res = run_ranks(n, fn)
+    total = sum((np.arange(3 * n, dtype=np.float64) + k) for k in range(n))
+    for k, r in enumerate(res):
+        np.testing.assert_allclose(r, total[3 * k:3 * (k + 1)])
+
+
+def test_reduce_scatter_varcounts_max_derived():
+    """BASELINE config 5: Reduce_scatter MPI_MAX on MPI_DOUBLE with a
+    derived (vector) view of the send buffer."""
+    def fn(comm):
+        n = comm.size
+        counts = [k + 1 for k in range(n)]
+        total = sum(counts)
+        # send buffer: every other double, via a resized datatype
+        # (extent 16 = one double + one gap)
+        stride = 2
+        raw = np.zeros(total * stride, dtype=np.float64)
+        raw[::stride] = np.arange(total) * (comm.rank + 1)
+        vt = dt.resized(dt.DOUBLE, 0, 16).commit()
+        rbuf = np.zeros(counts[comm.rank], dtype=np.float64)
+        comm.Reduce_scatter((raw, total, vt), rbuf, counts, mpi_op.MAX)
+        return rbuf
+
+    n = 4
+    res = run_ranks(n, fn)
+    counts = [1, 2, 3, 4]
+    offs = np.cumsum([0] + counts)
+    expect_full = np.arange(10) * n  # max over ranks = *(n)
+    for k, r in enumerate(res):
+        np.testing.assert_allclose(r, expect_full[offs[k]:offs[k + 1]])
+
+
+@pytest.mark.parametrize("n", SIZES)
+def test_scan_exscan(n):
+    def fn(comm):
+        x = np.array([comm.rank + 1], dtype=np.int64)
+        s = np.zeros(1, np.int64)
+        e = np.zeros(1, np.int64)
+        comm.Scan(x, s, mpi_op.SUM)
+        comm.Exscan(x, e, mpi_op.SUM)
+        return int(s[0]), int(e[0])
+
+    res = run_ranks(n, fn)
+    for k, (s, e) in enumerate(res):
+        assert s == sum(range(1, k + 2))
+        if k > 0:
+            assert e == sum(range(1, k + 1))
+
+
+@pytest.mark.parametrize("n", SIZES)
+def test_barrier_algorithms(n):
+    import time
+
+    def fn(comm):
+        marks = []
+        for bar in (alg.barrier_linear, alg.barrier_bruck,
+                    alg.barrier_doublering):
+            if comm.rank == 0:
+                time.sleep(0.01)
+            bar(comm)
+            marks.append(time.monotonic())
+        return marks
+
+    run_ranks(n, fn)  # completion without deadlock is the assertion
+
+
+def test_collective_derived_datatype_bcast():
+    """Bcast a subarray region."""
+    def fn(comm):
+        grid = np.zeros((4, 4), dtype=np.int32)
+        if comm.rank == 0:
+            grid[1:3, 1:3] = [[1, 2], [3, 4]]
+        sub = dt.subarray([4, 4], [2, 2], [1, 1], dt.ORDER_C, dt.INT).commit()
+        comm.Bcast((grid, 1, sub), root=0)
+        return grid
+
+    res = run_ranks(3, fn)
+    for r in res:
+        np.testing.assert_array_equal(r[1:3, 1:3], [[1, 2], [3, 4]])
+        assert r.sum() == 10
+
+
+def test_concurrent_collectives_on_split_comms():
+    """Different sub-communicators run collectives concurrently."""
+    def fn(comm):
+        sub = comm.split(comm.rank % 2)
+        x = np.array([comm.rank], dtype=np.int64)
+        r = np.zeros(1, np.int64)
+        sub.Allreduce(x, r, mpi_op.SUM)
+        return int(r[0])
+
+    res = run_ranks(6, fn)
+    assert res == [0 + 2 + 4, 1 + 3 + 5] * 3
+
+
+def test_scatter_in_place_root():
+    """Root uses MPI_IN_PLACE; non-roots must still receive."""
+    def fn(comm):
+        n = comm.size
+        if comm.rank == 0:
+            sbuf = np.arange(2 * n, dtype=np.int64)
+            comm.coll.scatter(comm, sbuf, 2, dt.INT64_T, IN_PLACE, 2,
+                              dt.INT64_T, 0)
+            return sbuf[:2].tolist()
+        out = np.zeros(2, np.int64)
+        comm.Scatter(None, out, root=0)
+        return out.tolist()
+
+    res = run_ranks(4, fn, timeout=20)
+    assert res == [[0, 1], [2, 3], [4, 5], [6, 7]]
+
+
+def test_allreduce_noncommutative_consistent():
+    """Tuned must route non-commutative ops to the ordered fold."""
+    def fold(invec, inoutvec, _dt):
+        inoutvec[:] = invec * 10 + inoutvec
+
+    op = mpi_op.create(fold, commute=False)
+
+    def fn(comm):
+        x = np.array([comm.rank + 1], dtype=np.int64)
+        r = np.zeros(1, np.int64)
+        comm.Allreduce(x, r, op)
+        return int(r[0])
+
+    res = run_ranks(4, fn)
+    assert res == [1234, 1234, 1234, 1234]
+
+
+def test_reduce_scatter_noncommutative():
+    def fold(invec, inoutvec, _dt):
+        inoutvec[:] = invec * 10 + inoutvec
+
+    op = mpi_op.create(fold, commute=False)
+
+    def fn(comm):
+        sbuf = np.full(comm.size, comm.rank + 1, dtype=np.int64)
+        rbuf = np.zeros(1, np.int64)
+        comm.Reduce_scatter_block(sbuf, rbuf, op)
+        return int(rbuf[0])
+
+    res = run_ranks(4, fn)
+    assert res == [1234, 1234, 1234, 1234]
+
+
+def test_allreduce_recdbl_noncommutative_direct():
+    """MPI ops must be associative; commutativity is the flag.  An
+    associative non-commutative op (2x2 matmul) must fold in rank
+    order under recursive doubling's operand-ordering rule."""
+    def matmul_fold(invec, inoutvec, _dt):
+        a = invec.reshape(2, 2)
+        b = inoutvec.reshape(2, 2)
+        inoutvec[:] = (a @ b).reshape(-1)
+
+    op = mpi_op.create(matmul_fold, commute=False)
+
+    def mat(k):
+        return np.array([[k + 1, 2], [1, k]], dtype=np.int64)
+
+    def fn(comm):
+        x = mat(comm.rank).reshape(-1)
+        r = np.zeros(4, np.int64)
+        alg.allreduce_recursivedoubling(comm, x, r, op)
+        return r.reshape(2, 2)
+
+    for n in (2, 4, 8):
+        res = run_ranks(n, fn)
+        exp = mat(0)
+        for k in range(1, n):
+            exp = exp @ mat(k)
+        for r in res:
+            np.testing.assert_array_equal(r, exp)
+
+
+def test_coll_vtable_hasattr():
+    def fn(comm):
+        assert hasattr(comm.coll, "allreduce")
+        assert getattr(comm.coll, "alltoallw", None) is None or True
+        assert not hasattr(comm.coll, "no_such_coll_fn")
+        return True
+
+    assert all(run_ranks(2, fn))

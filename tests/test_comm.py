@@ -1,0 +1,139 @@
+"""Communicator/group tests (ref: ompi/communicator/comm.c,
+comm_cid.c agreement; intercomm_create/loop_spawn analogs deferred
+to dynamic-process support)."""
+
+import numpy as np
+import pytest
+
+from ompi_tpu.comm.communicator import Group, UNDEFINED
+from ompi_tpu.op import op as mpi_op
+from ompi_tpu.testing import run_ranks
+
+
+def test_group_operations():
+    g = Group([4, 2, 7, 9])
+    assert g.size == 4
+    assert g.rank_of(7) == 2
+    assert g.rank_of(5) == UNDEFINED
+    assert g.incl([2, 0]).ranks == [7, 4]
+    assert g.excl([0, 3]).ranks == [2, 7]
+    assert g.union(Group([1, 2])).ranks == [4, 2, 7, 9, 1]
+    assert g.intersection(Group([9, 4, 5])).ranks == [4, 9]
+    assert g.difference(Group([2, 9])).ranks == [4, 7]
+
+
+def test_comm_dup_independent_traffic():
+    def fn(comm):
+        dup = comm.dup()
+        assert dup.cid != comm.cid
+        assert dup.size == comm.size and dup.rank == comm.rank
+        # same tag on both comms must not cross
+        if comm.rank == 0:
+            comm.Send(np.array([1], np.int32), dest=1, tag=5)
+            dup.Send(np.array([2], np.int32), dest=1, tag=5)
+        elif comm.rank == 1:
+            a = np.zeros(1, np.int32)
+            b = np.zeros(1, np.int32)
+            dup.Recv(b, source=0, tag=5)
+            comm.Recv(a, source=0, tag=5)
+            assert a[0] == 1 and b[0] == 2
+        dup.Free()
+        return dup.cid
+
+    res = run_ranks(3, fn)
+    assert len(set(res)) == 1  # same cid agreed everywhere
+
+
+def test_comm_split_colors_and_keys():
+    def fn(comm):
+        color = comm.rank % 2
+        key = -comm.rank  # reverse order within each split
+        sub = comm.split(color, key)
+        return (sub.cid, sub.rank, sub.size, tuple(sub.group))
+
+    res = run_ranks(6, fn)
+    evens = [r for k, r in enumerate(res) if k % 2 == 0]
+    odds = [r for k, r in enumerate(res) if k % 2 == 1]
+    # reverse key ordering: global rank 4 is rank 0 of the even comm
+    assert evens[0][3] == (4, 2, 0)
+    assert odds[0][3] == (5, 3, 1)
+    assert {r[2] for r in evens} == {3}
+    # cids of the two disjoint groups may be equal; both must differ
+    # from world cid 0
+    assert all(r[0] != 0 for r in res)
+
+
+def test_comm_split_undefined():
+    def fn(comm):
+        sub = comm.split(UNDEFINED if comm.rank == 1 else 0)
+        if comm.rank == 1:
+            assert sub is None
+            return None
+        return tuple(sub.group)
+
+    res = run_ranks(4, fn)
+    assert res[0] == (0, 2, 3)
+    assert res[1] is None
+
+
+def test_comm_create_subgroup():
+    def fn(comm):
+        g = comm.group_obj().incl([0, 2])
+        sub = comm.create(g)
+        if comm.rank in (0, 2):
+            assert sub is not None
+            x = np.array([comm.rank], np.int64)
+            r = np.zeros(1, np.int64)
+            sub.Allreduce(x, r, mpi_op.SUM)
+            return int(r[0])
+        assert sub is None
+        return None
+
+    res = run_ranks(4, fn)
+    assert res[0] == 2 and res[2] == 2
+    assert res[1] is None and res[3] is None
+
+
+def test_nested_splits_cid_uniqueness():
+    def fn(comm):
+        cids = {comm.cid}
+        c1 = comm.split(comm.rank % 2)
+        cids.add(c1.cid)
+        c2 = c1.split(0)
+        cids.add(c2.cid)
+        c3 = comm.dup()
+        cids.add(c3.cid)
+        # all live comms on this rank have distinct cids
+        assert len(cids) == 4
+        # collectives on the nested comm still work
+        x = np.array([1], np.int64)
+        r = np.zeros(1, np.int64)
+        c2.Allreduce(x, r, mpi_op.SUM)
+        return int(r[0])
+
+    res = run_ranks(6, fn)
+    assert res == [3, 3, 3, 3, 3, 3]
+
+
+def test_split_type_shared():
+    from ompi_tpu.comm.communicator import COMM_TYPE_SHARED
+
+    def fn(comm):
+        sub = comm.split_type(COMM_TYPE_SHARED)
+        return sub.size  # thread-ranks all share the host
+
+    res = run_ranks(4, fn)
+    assert res == [4, 4, 4, 4]
+
+
+def test_sendrecv_rank_translation_on_subcomm():
+    def fn(comm):
+        sub = comm.split(comm.rank // 2)  # pairs
+        peer = 1 - sub.rank
+        me = np.array([comm.rank], np.int32)
+        other = np.zeros(1, np.int32)
+        sub.Sendrecv(me, peer, 0, other, peer, 0)
+        return int(other[0])
+
+    res = run_ranks(4, fn)
+    assert res == [1, 0, 3, 2]

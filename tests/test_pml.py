@@ -1,0 +1,292 @@
+"""P2P engine tests: matching, protocols, wildcards, ordering.
+
+Models the reference's p2p coverage (orte/test/mpi/hello.c,
+crisscross.c; matching subtleties ref pml_ob1_recvfrag.c:510-558).
+"""
+
+import numpy as np
+import pytest
+
+from ompi_tpu.datatype import engine as dt
+from ompi_tpu.op import op as mpi_op
+from ompi_tpu.pml.request import ANY_SOURCE, ANY_TAG, ERR_TRUNCATE
+from ompi_tpu.testing import run_ranks
+
+
+def test_ring_token():
+    def ring(comm):
+        token = np.array([0], dtype=np.int64)
+        if comm.rank == 0:
+            token[0] = 42
+            comm.Send(token, dest=1)
+            comm.Recv(token, source=comm.size - 1)
+        else:
+            comm.Recv(token, source=comm.rank - 1)
+            token += 1
+            comm.Send(token, dest=(comm.rank + 1) % comm.size)
+        return int(token[0])
+
+    res = run_ranks(4, ring)
+    assert res[0] == 42 + 3
+
+
+def test_eager_and_rendezvous_sizes():
+    """Cross the eager/rndv protocol boundary (512 KiB inproc)."""
+    sizes = [0, 1, 1024, 512 * 1024, 512 * 1024 + 1, 3 * 1024 * 1024]
+
+    def fn(comm):
+        out = []
+        for i, n in enumerate(sizes):
+            if comm.rank == 0:
+                data = np.arange(n, dtype=np.uint8)
+                comm.Send(data, dest=1, tag=i)
+            else:
+                buf = np.zeros(n, dtype=np.uint8)
+                st = comm.Recv(buf, source=0, tag=i)
+                assert st.count == n
+                np.testing.assert_array_equal(
+                    buf, np.arange(n, dtype=np.uint8))
+                out.append(n)
+        return out
+
+    res = run_ranks(2, fn)
+    assert res[1] == sizes
+
+
+def test_any_source_any_tag():
+    def fn(comm):
+        if comm.rank == 0:
+            seen = set()
+            buf = np.zeros(1, dtype=np.int32)
+            for _ in range(comm.size - 1):
+                st = comm.Recv(buf, source=ANY_SOURCE, tag=ANY_TAG)
+                assert st.source == buf[0]
+                assert st.tag == 10 + buf[0]
+                seen.add(int(buf[0]))
+            return seen
+        comm.Send(np.array([comm.rank], np.int32), dest=0,
+                  tag=10 + comm.rank)
+        return None
+
+    res = run_ranks(5, fn)
+    assert res[0] == {1, 2, 3, 4}
+
+
+def test_message_ordering_same_peer():
+    """MPI guarantees FIFO per (src, comm); mixed tags must not
+    reorder same-tag messages."""
+    N = 50
+
+    def fn(comm):
+        if comm.rank == 0:
+            for i in range(N):
+                comm.Send(np.array([i], np.int32), dest=1, tag=5)
+        else:
+            for i in range(N):
+                buf = np.zeros(1, np.int32)
+                comm.Recv(buf, source=0, tag=5)
+                assert buf[0] == i
+        return True
+
+    assert all(run_ranks(2, fn))
+
+
+def test_unexpected_before_post():
+    """Sender fires before receiver posts; message must buffer."""
+    import time
+
+    def fn(comm):
+        if comm.rank == 0:
+            comm.Send(np.array([7.5], np.float64), dest=1, tag=3)
+        else:
+            time.sleep(0.05)  # let it land in the unexpected queue
+            buf = np.zeros(1, np.float64)
+            comm.Recv(buf, source=0, tag=3)
+            assert buf[0] == 7.5
+        return True
+
+    assert all(run_ranks(2, fn))
+
+
+def test_ssend_blocks_until_matched():
+    import time
+
+    def fn(comm):
+        if comm.rank == 0:
+            t0 = time.monotonic()
+            comm.Ssend(np.zeros(4, np.int32), dest=1)
+            elapsed = time.monotonic() - t0
+            assert elapsed > 0.04, f"Ssend returned in {elapsed}s"
+        else:
+            time.sleep(0.06)
+            comm.Recv(np.zeros(4, np.int32), source=0)
+        return True
+
+    assert all(run_ranks(2, fn))
+
+
+def test_probe_and_mprobe():
+    def fn(comm):
+        if comm.rank == 0:
+            comm.Send(np.arange(10, dtype=np.int32), dest=1, tag=9)
+            comm.Send(np.arange(3, dtype=np.int32), dest=1, tag=11)
+        else:
+            st = comm.Probe(source=0, tag=9)
+            assert st.count == 40 and st.tag == 9
+            msg = comm.Mprobe(source=0, tag=11)
+            buf = np.zeros(3, np.int32)
+            comm.Mrecv(buf, msg)
+            np.testing.assert_array_equal(buf, [0, 1, 2])
+            buf10 = np.zeros(10, np.int32)
+            comm.Recv(buf10, source=0, tag=9)
+            np.testing.assert_array_equal(buf10, np.arange(10))
+        return True
+
+    assert all(run_ranks(2, fn))
+
+
+def test_truncation_error_flagged():
+    def fn(comm):
+        if comm.rank == 0:
+            comm.Send(np.arange(10, dtype=np.int32), dest=1, tag=0)
+        else:
+            buf = np.zeros(4, np.int32)
+            st = comm.Recv(buf, source=0, tag=0)
+            assert st.error == ERR_TRUNCATE
+            np.testing.assert_array_equal(buf, [0, 1, 2, 3])
+        return True
+
+    assert all(run_ranks(2, fn))
+
+
+def test_sendrecv_exchange():
+    def fn(comm):
+        me = np.array([comm.rank], np.int32)
+        other = np.zeros(1, np.int32)
+        peer = (comm.rank + 1) % comm.size
+        prev = (comm.rank - 1) % comm.size
+        comm.Sendrecv(me, peer, 1, other, prev, 1)
+        return int(other[0])
+
+    res = run_ranks(4, fn)
+    assert res == [3, 0, 1, 2]
+
+
+def test_derived_datatype_p2p():
+    """Send a matrix column (vector datatype) to a contiguous recv."""
+    def fn(comm):
+        if comm.rank == 0:
+            grid = np.arange(36, dtype=np.float64).reshape(6, 6)
+            col = dt.vector(6, 1, 6, dt.DOUBLE).commit()
+            comm.Send((grid, 1, col), dest=1, tag=2)
+        else:
+            buf = np.zeros(6, np.float64)
+            comm.Recv(buf, source=0, tag=2)
+            np.testing.assert_array_equal(buf, [0, 6, 12, 18, 24, 30])
+        return True
+
+    assert all(run_ranks(2, fn))
+
+
+def test_rendezvous_derived_large():
+    """Large strided send crossing the rndv path with pipelining."""
+    def fn(comm):
+        rows, cols = 1200, 1024
+        if comm.rank == 0:
+            m = np.arange(rows * cols, dtype=np.float32).reshape(rows, cols)
+            col_t = dt.vector(rows, 8, cols, dt.FLOAT).commit()
+            comm.Send((m, 1, col_t), dest=1, tag=0)
+        else:
+            buf = np.zeros(rows * 8, np.float32)
+            comm.Recv(buf, source=0, tag=0)
+            m = np.arange(rows * cols, dtype=np.float32).reshape(rows, cols)
+            np.testing.assert_array_equal(buf.reshape(rows, 8), m[:, :8])
+        return True
+
+    assert all(run_ranks(2, fn))
+
+
+def test_isend_irecv_waitall():
+    from ompi_tpu.pml.request import wait_all
+
+    def fn(comm):
+        peer = 1 - comm.rank
+        sends = [comm.Isend(np.full(4, i, np.int32), dest=peer, tag=i)
+                 for i in range(8)]
+        bufs = [np.zeros(4, np.int32) for _ in range(8)]
+        recvs = [comm.Irecv(bufs[i], source=peer, tag=i) for i in range(8)]
+        wait_all(sends + recvs)
+        for i, b in enumerate(bufs):
+            np.testing.assert_array_equal(b, np.full(4, i))
+        return True
+
+    assert all(run_ranks(2, fn))
+
+
+def test_cancel_unmatched_recv():
+    def fn(comm):
+        buf = np.zeros(1, np.int32)
+        req = comm.Irecv(buf, source=0, tag=999)
+        if comm.rank == 1:
+            ok = comm.state.pml.cancel_recv(req)
+            assert ok
+            st = req.wait()
+            assert st.cancelled
+        else:
+            req2 = comm.Irecv(buf, source=1, tag=999)
+            comm.state.pml.cancel_recv(req2)
+            comm.state.pml.cancel_recv(req)
+        return True
+
+    assert all(run_ranks(2, fn))
+
+
+def test_send_to_self():
+    def fn(comm):
+        if comm.rank == 0:
+            req = comm.Isend(np.array([5], np.int32), dest=0, tag=1)
+            buf = np.zeros(1, np.int32)
+            comm.Recv(buf, source=0, tag=1)
+            req.wait()
+            assert buf[0] == 5
+        return True
+
+    assert all(run_ranks(2, fn))
+
+
+def test_crisscross_stress():
+    """Every pair exchanges (connectivity_c.c analog)."""
+    def fn(comm):
+        reqs = []
+        bufs = {}
+        for peer in range(comm.size):
+            if peer == comm.rank:
+                continue
+            bufs[peer] = np.zeros(16, np.int64)
+            reqs.append(comm.Irecv(bufs[peer], source=peer, tag=4))
+        for peer in range(comm.size):
+            if peer == comm.rank:
+                continue
+            reqs.append(comm.Isend(
+                np.full(16, comm.rank * 1000 + peer, np.int64),
+                dest=peer, tag=4))
+        for r in reqs:
+            r.wait()
+        for peer, b in bufs.items():
+            assert b[0] == peer * 1000 + comm.rank
+        return True
+
+    assert all(run_ranks(6, fn))
+
+
+def test_public_cancel_completes_request():
+    """MPI_Cancel on an unmatched recv must complete the request."""
+    def fn(comm):
+        buf = np.zeros(1, np.int32)
+        req = comm.Irecv(buf, source=1 - comm.rank, tag=321)
+        req.cancel()
+        st = req.wait(timeout=5)
+        assert st.cancelled
+        return True
+
+    assert all(run_ranks(2, fn))
