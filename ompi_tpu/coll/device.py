@@ -1,0 +1,703 @@
+"""Device collectives: coll/tpu (XLA collectives on the mesh) and
+coll/hbm (intra-chip stacked collectives).
+
+This is the north-star component (BASELINE.json): MPI blocking
+collectives on TPU-resident buffers lowered to XLA collectives —
+psum / psum_scatter / all_gather / all_to_all / ppermute — on the
+communicator's device mesh, with reduction ops mapped to XLA
+computations.  It replaces the reference's entire §3.4 pyramid
+(tuned decision → ring send/recv loops → op function table,
+ref: coll_tuned_decision_fixed.c:44-86 + coll_base_allreduce.c:343 +
+op_base_functions.c) with ONE compiled HLO collective over ICI.
+
+Execution model: MPI ranks on a TPU host are threads of one process,
+each owning a device (see docs/DESIGN.md).  A device collective is a
+**rendezvous**: every member thread deposits its shard; the last
+arriver zero-copy assembles the global jax.Array
+(make_array_from_single_device_arrays), runs the cached jitted
+shard_map collective, and hands each member its output shard.  The
+assembled op IS the communicator-wide collective — XLA sees the full
+mesh and schedules ICI transfers itself.
+
+coll/hbm is the co-located analog of the reference's coll/sm
+(ref: ompi/mca/coll/sm/coll_sm_module.c:102,167 — ranks on one node
+collect in a shared segment): ranks sharing ONE chip reduce through
+HBM with a single fused kernel, no ICI at all.
+
+Ineligible calls (host buffers, unsupported ops, pair dtypes) fall
+back to the p2p module stack — the same per-communicator, per-function
+fallback discipline as the reference's comm_select.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ompi_tpu.coll.framework import CollComponent, CollModule, coll_framework
+from ompi_tpu.coll.tuned import TunedModule
+from ompi_tpu.mca.params import registry
+from ompi_tpu.op.op import MAX, MIN, PROD, SUM, Op
+
+_prio_tpu = registry.register(
+    "coll", "tpu", "priority", 80, int,
+    help="Selection priority of the XLA-mesh collective component")
+_prio_hbm = registry.register(
+    "coll", "hbm", "priority", 70, int,
+    help="Selection priority of the intra-chip collective component")
+
+# ops with a native XLA cross-replica lowering
+_XLA_REDUCERS = {"MPI_SUM", "MPI_MAX", "MPI_MIN"}
+# commutative+associative ops lowered as all_gather + on-device fold
+_GATHER_FOLD = {"MPI_PROD", "MPI_LAND", "MPI_BAND", "MPI_LOR",
+                "MPI_BOR", "MPI_LXOR", "MPI_BXOR"}
+
+
+def _is_jax_array(x) -> bool:
+    import jax
+    return isinstance(x, jax.Array)
+
+
+def _fold_fn(opname: str):
+    import jax.numpy as jnp
+    return {
+        "MPI_PROD": lambda s: jnp.prod(s, axis=0),
+        "MPI_LAND": lambda s: jnp.all(s != 0, axis=0).astype(s.dtype),
+        "MPI_BAND": lambda s: functools.reduce(jnp.bitwise_and, s),
+        "MPI_LOR": lambda s: jnp.any(s != 0, axis=0).astype(s.dtype),
+        "MPI_BOR": lambda s: functools.reduce(jnp.bitwise_or, s),
+        "MPI_LXOR": lambda s: ((s != 0).sum(axis=0) % 2).astype(s.dtype),
+        "MPI_BXOR": lambda s: functools.reduce(jnp.bitwise_xor, s),
+    }[opname]
+
+
+class Rendezvous:
+    """Per-communicator meeting point for device collectives.
+
+    Generation-tracked so a fast rank may enter collective g+1 while
+    stragglers of generation g are still reading their outputs (MPI
+    permits ranks to leave a collective at different times)."""
+
+    _SENTINEL = object()  # a deposited value may legitimately be None
+
+    def __init__(self, size: int) -> None:
+        self.size = size
+        self.cv = threading.Condition()
+        self.slots: List[Any] = [self._SENTINEL] * size
+        self.count = 0
+        self.gen = 0
+        self.results: Dict[int, List[Any]] = {}
+        self.errors: Dict[int, BaseException] = {}
+        self.readers: Dict[int, int] = {}
+
+    def run(self, rank: int, value: Any, fn: Callable[[List[Any]], List[Any]],
+            abort_check: Optional[Callable[[], None]] = None) -> Any:
+        """Deposit `value`; last arriver runs fn(slots) -> outputs."""
+        with self.cv:
+            # wait until my slot from the previous generation is consumed
+            while self.slots[rank] is not self._SENTINEL:
+                if not self.cv.wait(timeout=1.0) and abort_check:
+                    abort_check()
+            gen = self.gen
+            self.slots[rank] = value
+            self.count += 1
+            if self.count == self.size:
+                try:
+                    self.results[gen] = fn(list(self.slots))
+                except BaseException as e:  # noqa: BLE001
+                    self.errors[gen] = e
+                    self.results[gen] = [None] * self.size
+                self.readers[gen] = self.size
+                self.count = 0
+                self.slots = [self._SENTINEL] * self.size
+                self.gen += 1
+                self.cv.notify_all()
+            else:
+                while gen not in self.results:
+                    if not self.cv.wait(timeout=1.0) and abort_check:
+                        abort_check()
+            err = self.errors.get(gen)
+            out = self.results[gen][rank]
+            self.readers[gen] -= 1
+            if self.readers[gen] == 0:
+                del self.results[gen], self.readers[gen]
+                self.errors.pop(gen, None)
+            if err is not None:
+                raise RuntimeError(
+                    f"device collective failed on a peer: {err}") from err
+            return out
+
+
+def _get_rendezvous(comm) -> Rendezvous:
+    world = comm.state.rte.world
+    # disjoint communicators may share a cid (uniqueness is
+    # per-process), so the group is part of the key
+    key = ("coll_rv", comm.cid, tuple(comm.group))
+    with world.shared_lock:
+        rv = world.shared.get(key)
+        if rv is None:
+            rv = Rendezvous(comm.size)
+            world.shared[key] = rv
+        return rv
+
+
+# ---------------------------------------------------------------------------
+# compiled-collective cache: (kind, mesh_key, shape, dtype, extra) -> fn
+# (the per-(op, dtype, shape, comm) caching from SURVEY.md §7.6)
+# ---------------------------------------------------------------------------
+
+_compiled: Dict[Tuple, Callable] = {}
+_compiled_lock = threading.Lock()
+
+
+def _mesh_collective(kind: str, mesh, shape, dtype, extra=None) -> Callable:
+    # keyed by device ids, NOT mesh identity: every rank builds its own
+    # (equal) Mesh object, and whichever thread is last-arriver must hit
+    # the same compiled executable (a miss costs a full XLA compile)
+    dev_key = tuple(d.id for d in mesh.devices.reshape(-1))
+    key = (kind, dev_key, tuple(shape), np.dtype(dtype).str, extra)
+    fn = _compiled.get(key)
+    if fn is not None:
+        return fn
+    import jax
+    import jax.numpy as jnp
+    from jax import lax, shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    size = mesh.devices.size
+
+    if kind == "allreduce":
+        opname = extra
+        if opname in _XLA_REDUCERS:
+            red = {"MPI_SUM": lax.psum, "MPI_MAX": lax.pmax,
+                   "MPI_MIN": lax.pmin}[opname]
+            body = lambda x: red(x, "r")  # noqa: E731
+        else:
+            fold = _fold_fn(opname)
+            body = lambda x: fold(  # noqa: E731
+                lax.all_gather(x, "r", tiled=False))
+        in_specs, out_specs = P("r"), P(None)
+    elif kind == "reduce_scatter":
+        body = lambda x: lax.psum_scatter(x, "r", tiled=True)  # noqa: E731
+        in_specs, out_specs = P("r"), P("r")
+    elif kind == "allgather":
+        body = lambda x: lax.all_gather(x, "r", tiled=True)  # noqa: E731
+        in_specs, out_specs = P("r"), P(None)
+    elif kind == "alltoall":
+        body = lambda x: lax.all_to_all(  # noqa: E731
+            x, "r", split_axis=0, concat_axis=0, tiled=True)
+        in_specs, out_specs = P("r"), P("r")
+    elif kind == "bcast":
+        root = extra
+
+        def body(x):  # bcast as masked psum (one AllReduce over ICI)
+            mask = (lax.axis_index("r") == root)
+            return lax.psum(jnp.where(mask, x, jnp.zeros_like(x)), "r")
+
+        in_specs, out_specs = P("r"), P(None)
+    elif kind == "ppermute":
+        perm = extra
+
+        def body(x):
+            return lax.ppermute(x, "r", perm=list(perm))
+
+        in_specs, out_specs = P("r"), P("r")
+    else:
+        raise KeyError(kind)
+
+    jfn = jax.jit(shard_map(body, mesh=mesh, in_specs=in_specs,
+                            out_specs=out_specs, check_vma=False))
+    with _compiled_lock:
+        _compiled[key] = jfn
+    return jfn
+
+
+def _assemble(mesh, shards: List):
+    """Zero-copy global array from per-rank single-device shards.
+    Shards already on rank i's mesh device are used in place; stray
+    shards (created on the default device) are moved first."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    devs = list(mesh.devices.reshape(-1))
+    placed = [s if getattr(s, "device", None) == devs[i]
+              else jax.device_put(s, devs[i])
+              for i, s in enumerate(shards)]
+    n = placed[0].shape[0]
+    global_shape = (n * len(placed),) + tuple(placed[0].shape[1:])
+    sharding = NamedSharding(mesh, P("r"))
+    return jax.make_array_from_single_device_arrays(
+        global_shape, sharding, placed)
+
+
+def _scatter_out(out, mesh, size: int) -> List:
+    """Split a collective output back into per-rank arrays, indexed by
+    comm rank (mesh device order == comm rank order)."""
+    dev_order = {d.id: i for i, d in enumerate(mesh.devices.reshape(-1))}
+    parts: List[Any] = [None] * size
+    if len(out.addressable_shards) == size:
+        for sh in out.addressable_shards:
+            parts[dev_order[sh.device.id]] = sh.data
+        return parts
+    # replicated output: every rank reads the same array
+    return [out] * size
+
+
+class TpuCollModule(CollModule):
+    """XLA-mesh collectives for comms whose ranks own distinct devices."""
+
+    name = "tpu"
+
+    def __init__(self, fallback: "HostArrModule") -> None:
+        self.fallback = fallback
+        self.pvar_offload = registry.register_pvar(
+            "coll", "tpu", "offloaded_collectives",
+            help="Number of collectives executed as XLA mesh ops")
+
+    # -- helpers ---------------------------------------------------------
+    def _eligible(self, comm, *arrays) -> bool:
+        """Must be comm-consistent: every member reaches the same
+        verdict, else some ranks enter the rendezvous while others take
+        the p2p fallback — a silent deadlock.  Depends only on comm
+        properties and dtype/op/shape, which MPI requires to match
+        across ranks; local buffer residency does NOT matter (stray
+        host buffers are moved in _assemble)."""
+        if comm.size == 1:
+            return False
+        if comm.mesh() is None:
+            return False
+        return all(getattr(np.asarray(a).dtype, "fields", None) is None
+                   for a in arrays)
+
+    @staticmethod
+    def _norm(x):
+        """Normalize scalars/0-d arrays to rank-1 for sharding."""
+        if getattr(x, "ndim", None) == 0:
+            return x.reshape(1), True
+        return x, False
+
+    def _abort_check(self, comm):
+        world = getattr(comm.state.rte, "world", None)
+
+        def check():
+            if world is not None and world.aborted and \
+                    world.aborted[0] != comm.state.rank:
+                raise RuntimeError(
+                    f"peer rank {world.aborted[0]} aborted during "
+                    "device collective")
+        return check
+
+    def _run(self, comm, value, fn):
+        rv = _get_rendezvous(comm)
+        out = rv.run(comm.rank, value, fn, self._abort_check(comm))
+        self.pvar_offload.add(1)
+        return out
+
+    # -- device-array collectives (the *_arr vtable surface) -------------
+    def allreduce_arr(self, comm, x, op: Op):
+        if not self._eligible(comm, x) or (
+                op.name not in _XLA_REDUCERS and op.name not in _GATHER_FOLD):
+            return self.fallback.allreduce_arr(comm, x, op)
+        mesh = comm.mesh()
+        x, was_scalar = self._norm(x)
+
+        def fn(shards):
+            g = _assemble(mesh, shards)
+            jfn = _mesh_collective("allreduce", mesh, g.shape, g.dtype,
+                                   op.name)
+            return _scatter_out(jfn(g), mesh, comm.size)
+
+        out = self._run(comm, x, fn)
+        return out.reshape(()) if was_scalar else out
+
+    def reduce_scatter_block_arr(self, comm, x, op: Op):
+        if not self._eligible(comm, x) or op.name != "MPI_SUM" \
+                or np.asarray(x).ndim == 0 \
+                or x.shape[0] % comm.size != 0:
+            return self.fallback.reduce_scatter_block_arr(comm, x, op)
+        mesh = comm.mesh()
+
+        def fn(shards):
+            g = _assemble(mesh, shards)
+            jfn = _mesh_collective("reduce_scatter", mesh, g.shape, g.dtype)
+            return _scatter_out(jfn(g), mesh, comm.size)
+
+        return self._run(comm, x, fn)
+
+    def allgather_arr(self, comm, x):
+        if not self._eligible(comm, x):
+            return self.fallback.allgather_arr(comm, x)
+        mesh = comm.mesh()
+        x, _ = self._norm(x)
+
+        def fn(shards):
+            g = _assemble(mesh, shards)
+            jfn = _mesh_collective("allgather", mesh, g.shape, g.dtype)
+            return _scatter_out(jfn(g), mesh, comm.size)
+
+        return self._run(comm, x, fn)
+
+    def alltoall_arr(self, comm, x):
+        if not self._eligible(comm, x) or np.asarray(x).ndim == 0 \
+                or x.shape[0] % comm.size != 0:
+            return self.fallback.alltoall_arr(comm, x)
+        mesh = comm.mesh()
+
+        def fn(shards):
+            g = _assemble(mesh, shards)
+            jfn = _mesh_collective("alltoall", mesh, g.shape, g.dtype)
+            return _scatter_out(jfn(g), mesh, comm.size)
+
+        return self._run(comm, x, fn)
+
+    def bcast_arr(self, comm, x, root: int):
+        if not self._eligible(comm, x):
+            return self.fallback.bcast_arr(comm, x, root)
+        mesh = comm.mesh()
+        x, was_scalar = self._norm(x)
+
+        def fn(shards):
+            g = _assemble(mesh, shards)
+            jfn = _mesh_collective("bcast", mesh, g.shape, g.dtype, root)
+            return _scatter_out(jfn(g), mesh, comm.size)
+
+        out = self._run(comm, x, fn)
+        return out.reshape(()) if was_scalar else out
+
+    def reduce_arr(self, comm, x, op: Op, root: int):
+        # SPMD style: compute everywhere, deliver at root (XLA would
+        # schedule the same AllReduce for CollectiveReduce anyway)
+        out = self.allreduce_arr(comm, x, op)
+        return out if comm.rank == root else None
+
+    def ppermute_arr(self, comm, x, perm):
+        """Neighbor shift — the ring-attention / pipeline primitive
+        (SURVEY.md §2.8: mesh-axis neighbor ppermute)."""
+        if not self._eligible(comm, x):
+            return self.fallback.ppermute_arr(comm, x, perm)
+        mesh = comm.mesh()
+        x, _ = self._norm(x)
+        perm_t = tuple(sorted((int(a), int(b)) for a, b in perm))
+
+        def fn(shards):
+            g = _assemble(mesh, shards)
+            jfn = _mesh_collective("ppermute", mesh, g.shape, g.dtype,
+                                   perm_t)
+            return _scatter_out(jfn(g), mesh, comm.size)
+
+        return self._run(comm, x, fn)
+
+
+class HbmCollModule(CollModule):
+    """Intra-chip collectives: every member rank shares one device, so
+    the collective is a single fused on-chip kernel through HBM
+    (coll/sm analog — the 'node' is the chip)."""
+
+    name = "hbm"
+
+    # process-global compile cache: every rank has its own module
+    # instance, but the last-arriver thread rotates — a per-instance
+    # cache would recompile once per distinct executing thread
+    _jit_cache: Dict[Tuple, Callable] = {}
+    _jit_lock = threading.Lock()
+
+    def __init__(self, fallback: "HostArrModule") -> None:
+        self.fallback = fallback
+
+    def _eligible(self, comm, *arrays) -> bool:
+        # comm-consistent only (see TpuCollModule._eligible)
+        if comm.size == 1:
+            return False
+        devs = set()
+        for g in comm.group:
+            st = comm._peer_state(g)
+            if st is None or st.device is None:
+                return False
+            devs.add(st.device.id)
+        return len(devs) == 1 and all(
+            getattr(np.asarray(a).dtype, "fields", None) is None
+            for a in arrays)
+
+    _abort_check = TpuCollModule._abort_check
+    _norm = staticmethod(TpuCollModule._norm)
+
+    def _deposit(self, comm, x):
+        """Ensure the deposited value lives on the shared device."""
+        if _is_jax_array(x):
+            return x
+        import jax
+        return jax.device_put(np.asarray(x), comm.state.device)
+
+    def _stacked(self, kind: str, opname: str, nshards: int, shape, dtype,
+                 extra=None) -> Callable:
+        key = (kind, opname, nshards, tuple(shape), np.dtype(dtype).str,
+               extra)
+        fn = self._jit_cache.get(key)
+        if fn is not None:
+            return fn
+        import jax
+        import jax.numpy as jnp
+
+        if kind == "allreduce":
+            if opname == "MPI_SUM":
+                body = lambda *s: jnp.sum(jnp.stack(s), axis=0)  # noqa: E731
+            elif opname == "MPI_MAX":
+                body = lambda *s: jnp.max(jnp.stack(s), axis=0)  # noqa: E731
+            elif opname == "MPI_MIN":
+                body = lambda *s: jnp.min(jnp.stack(s), axis=0)  # noqa: E731
+            else:
+                fold = _fold_fn(opname)
+                body = lambda *s: fold(jnp.stack(s))  # noqa: E731
+            out = lambda r, n: [r] * n  # noqa: E731
+        elif kind == "reduce_scatter":
+            def body(*s):
+                return jnp.sum(jnp.stack(s), axis=0)
+
+            def out(r, n):
+                m = r.shape[0] // n
+                return [r[i * m:(i + 1) * m] for i in range(n)]
+        elif kind == "allgather":
+            body = lambda *s: jnp.concatenate(s, axis=0)  # noqa: E731
+            out = lambda r, n: [r] * n  # noqa: E731
+        elif kind == "alltoall":
+            def body(*s):
+                n = len(s)
+                m = s[0].shape[0] // n
+                trail = s[0].shape[1:]
+                stk = jnp.stack([x.reshape((n, m) + trail) for x in s])
+                return jnp.swapaxes(stk, 0, 1).reshape((n, n * m) + trail)
+
+            def out(r, n):
+                return [r[i] for i in range(n)]
+        else:
+            raise KeyError(kind)
+
+        jbody = jax.jit(body)
+        fn = (jbody, out)
+        with HbmCollModule._jit_lock:
+            HbmCollModule._jit_cache[key] = fn
+        return fn
+
+    def _run(self, comm, kind, opname, x, extra=None):
+        x = self._deposit(comm, x)
+        jbody, out = self._stacked(kind, opname, comm.size, x.shape,
+                                   x.dtype, extra)
+
+        def fn(shards):
+            r = jbody(*shards)
+            return out(r, comm.size)
+
+        rv = _get_rendezvous(comm)
+        return rv.run(comm.rank, x, fn, self._abort_check(comm))
+
+    def allreduce_arr(self, comm, x, op: Op):
+        if not self._eligible(comm, x) or (
+                op.name not in _XLA_REDUCERS and op.name not in _GATHER_FOLD):
+            return self.fallback.allreduce_arr(comm, x, op)
+        x, was_scalar = self._norm(x)
+        out = self._run(comm, "allreduce", op.name, x)
+        return out.reshape(()) if was_scalar else out
+
+    def reduce_scatter_block_arr(self, comm, x, op: Op):
+        if not self._eligible(comm, x) or op.name != "MPI_SUM" \
+                or np.asarray(x).ndim == 0 \
+                or x.shape[0] % comm.size != 0:
+            return self.fallback.reduce_scatter_block_arr(comm, x, op)
+        return self._run(comm, "reduce_scatter", op.name, x)
+
+    def allgather_arr(self, comm, x):
+        if not self._eligible(comm, x):
+            return self.fallback.allgather_arr(comm, x)
+        return self._run(comm, "allgather", "", x)
+
+    def alltoall_arr(self, comm, x):
+        if not self._eligible(comm, x) or np.asarray(x).ndim == 0 \
+                or x.shape[0] % comm.size != 0:
+            return self.fallback.alltoall_arr(comm, x)
+        return self._run(comm, "alltoall", "", x)
+
+    def bcast_arr(self, comm, x, root: int):
+        if not self._eligible(comm, x):
+            return self.fallback.bcast_arr(comm, x, root)
+
+        x = self._deposit(comm, x)
+
+        def fn(shards):
+            return [shards[root]] * comm.size
+
+        rv = _get_rendezvous(comm)
+        return rv.run(comm.rank, x, fn, self._abort_check(comm))
+
+    def reduce_arr(self, comm, x, op: Op, root: int):
+        out = self.allreduce_arr(comm, x, op)
+        return out if comm.rank == root else None
+
+    def ppermute_arr(self, comm, x, perm):
+        if not self._eligible(comm, x):
+            return self.fallback.ppermute_arr(comm, x, perm)
+        x = self._deposit(comm, x)
+        pmap = {int(a): int(b) for a, b in perm}
+
+        def fn(shards):
+            import jax.numpy as jnp
+            outs = [None] * comm.size
+            for src, dst in pmap.items():
+                outs[dst] = shards[src]
+            z = None
+            for i in range(comm.size):
+                if outs[i] is None:
+                    if z is None:
+                        z = jnp.zeros_like(shards[0])
+                    outs[i] = z
+            return outs
+
+        rv = _get_rendezvous(comm)
+        return rv.run(comm.rank, x, fn, self._abort_check(comm))
+
+
+class HostArrModule(CollModule):
+    """Always-eligible *_arr fallback: stage device arrays through the
+    host and run the p2p collective stack (the 'coll/cuda staging
+    wrapper' analog, ref: ompi/mca/coll/cuda)."""
+
+    name = "arr_host"
+
+    def __init__(self) -> None:
+        self.p2p = TunedModule()
+        from ompi_tpu.datatype import engine as dtmod
+        self._dt = dtmod
+
+    def _np(self, x) -> np.ndarray:
+        return np.asarray(x)
+
+    def _back(self, comm, arr: np.ndarray):
+        dev = comm.state.device
+        if dev is not None:
+            import jax
+            return jax.device_put(arr, dev)
+        return arr
+
+    def _dtype_of(self, arr):
+        return self._dt.from_numpy_dtype(arr.dtype)
+
+    def allreduce_arr(self, comm, x, op: Op):
+        a = self._np(x).reshape(-1)
+        r = np.empty_like(a)
+        self.p2p.allreduce(comm, a, r, a.size, self._dtype_of(a), op)
+        return self._back(comm, r.reshape(np.asarray(x).shape))
+
+    def bcast_arr(self, comm, x, root: int):
+        a = self._np(x).reshape(-1).copy()
+        self.p2p.bcast(comm, a, a.size, self._dtype_of(a), root)
+        return self._back(comm, a.reshape(np.asarray(x).shape))
+
+    def reduce_arr(self, comm, x, op: Op, root: int):
+        a = self._np(x).reshape(-1)
+        r = np.empty_like(a) if comm.rank == root else None
+        self.p2p.reduce(comm, a, r, a.size, self._dtype_of(a), op, root)
+        return self._back(comm, r.reshape(np.asarray(x).shape)) \
+            if comm.rank == root else None
+
+    def allgather_arr(self, comm, x):
+        shp = np.asarray(x).shape
+        a = self._np(x).reshape(-1)
+        r = np.empty(a.size * comm.size, dtype=a.dtype)
+        self.p2p.allgather(comm, a, a.size, self._dtype_of(a), r, a.size,
+                           self._dtype_of(a))
+        out_shape = (comm.size,) if not shp else \
+            (comm.size * shp[0],) + tuple(shp[1:])
+        return self._back(comm, r.reshape(out_shape))
+
+    def alltoall_arr(self, comm, x):
+        shp = np.asarray(x).shape
+        a = self._np(x).reshape(-1)
+        n = a.size // comm.size
+        r = np.empty_like(a)
+        self.p2p.alltoall(comm, a, n, self._dtype_of(a), r, n,
+                          self._dtype_of(a))
+        return self._back(comm, r.reshape(shp))
+
+    def reduce_scatter_block_arr(self, comm, x, op: Op):
+        shp = np.asarray(x).shape
+        a = self._np(x).reshape(-1)
+        n = a.size // comm.size
+        r = np.empty(n, dtype=a.dtype)
+        self.p2p.reduce_scatter_block(comm, a, r, n, self._dtype_of(a), op)
+        out_shape = (shp[0] // comm.size,) + tuple(shp[1:]) if shp else (n,)
+        return self._back(comm, r.reshape(out_shape))
+
+    def ppermute_arr(self, comm, x, perm):
+        from ompi_tpu.coll.base import _irecv_into, _isend
+        a = np.ascontiguousarray(self._np(x))
+        out = np.zeros_like(a)
+        reqs = []
+        for src, dst in perm:
+            if int(dst) == comm.rank:
+                reqs.append(_irecv_into(comm, out.reshape(-1), int(src),
+                                        -115))
+        for src, dst in perm:
+            if int(src) == comm.rank:
+                reqs.append(_isend(comm, a.reshape(-1), int(dst), -115))
+        for q in reqs:
+            q.wait()
+        return self._back(comm, out)
+
+
+class TpuComponent(CollComponent):
+    name = "tpu"
+
+    @property
+    def priority(self):
+        return _prio_tpu.value
+
+    def comm_query(self, comm):
+        if comm.mesh() is None:
+            return None
+        return (self.priority, TpuCollModule(_host_arr_fallback()))
+
+
+class HbmComponent(CollComponent):
+    name = "hbm"
+
+    @property
+    def priority(self):
+        return _prio_hbm.value
+
+    def comm_query(self, comm):
+        devs = set()
+        for g in comm.group:
+            st = comm._peer_state(g)
+            if st is None or st.device is None:
+                return None
+            devs.add(st.device.id)
+        if len(devs) != 1 or comm.size == 1:
+            return None
+        return (self.priority, HbmCollModule(_host_arr_fallback()))
+
+
+class ArrHostComponent(CollComponent):
+    name = "arr_host"
+    priority = 5
+
+    def comm_query(self, comm):
+        return (self.priority, HostArrModule())
+
+
+_host_fallback_singleton: Optional[HostArrModule] = None
+
+
+def _host_arr_fallback() -> HostArrModule:
+    """Process-wide host-staged *_arr fallback shared by every device
+    module (stateless beyond its decision hooks)."""
+    global _host_fallback_singleton
+    if _host_fallback_singleton is None:
+        _host_fallback_singleton = HostArrModule()
+    return _host_fallback_singleton
+
+
+coll_framework.add_component(TpuComponent())
+coll_framework.add_component(HbmComponent())
+coll_framework.add_component(ArrHostComponent())

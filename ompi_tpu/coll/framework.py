@@ -31,6 +31,11 @@ COLL_FUNCS = (
     # nonblocking
     "ibarrier", "ibcast", "ireduce", "iallreduce", "iallgather",
     "iallgatherv", "igather", "iscatter", "ialltoall", "ireduce_scatter",
+    # device-array collectives (jax arrays in, jax arrays out) — the
+    # coll/tpu + coll/hbm surface; ppermute is the mesh-neighbor
+    # primitive (ring attention / pipeline parallelism)
+    "allreduce_arr", "bcast_arr", "reduce_arr", "allgather_arr",
+    "alltoall_arr", "reduce_scatter_block_arr", "ppermute_arr",
 )
 
 

@@ -216,6 +216,13 @@ class Communicator:
         self.state.comms.pop(self.cid, None)
         # keep the cid burned so in-flight traffic can't alias it
         self.state.comms.setdefault(self.cid, None)
+        # drop this comm's device-collective rendezvous (one entry per
+        # (cid, group) in the world's shared dict)
+        world = getattr(self.state.rte, "world", None)
+        if world is not None:
+            with world.shared_lock:
+                world.shared.pop(("coll_rv", self.cid, tuple(self.group)),
+                                 None)
 
     # -- TPU mesh mapping (SURVEY.md §2.8) -------------------------------
     def mesh(self):
@@ -442,6 +449,38 @@ class Communicator:
         sbuf, scount, sdt = self._spec(sspec)
         rbuf, rcount, rdt = self._spec(rspec)
         self.coll.exscan(self, sbuf, rbuf, rcount, rdt, op)
+
+    @property
+    def device(self):
+        """The jax device this rank owns (None in host-only worlds)."""
+        return self.state.device
+
+    # -- device-array collectives (jax in, jax out) ---------------------
+    # The coll/tpu surface: collectives on TPU-resident buffers return
+    # new arrays (jax arrays are immutable); lowered to XLA collectives
+    # on the comm's mesh when eligible, host-staged otherwise.
+
+    def allreduce_arr(self, x, op):
+        return self.coll.allreduce_arr(self, x, op)
+
+    def bcast_arr(self, x, root: int = 0):
+        return self.coll.bcast_arr(self, x, root)
+
+    def reduce_arr(self, x, op, root: int = 0):
+        return self.coll.reduce_arr(self, x, op, root)
+
+    def allgather_arr(self, x):
+        return self.coll.allgather_arr(self, x)
+
+    def alltoall_arr(self, x):
+        return self.coll.alltoall_arr(self, x)
+
+    def reduce_scatter_arr(self, x, op):
+        return self.coll.reduce_scatter_block_arr(self, x, op)
+
+    def ppermute_arr(self, x, perm):
+        """perm: [(src_rank, dst_rank), ...] — mesh-neighbor shift."""
+        return self.coll.ppermute_arr(self, x, perm)
 
     # -- management shorthands -----------------------------------------
     def Get_rank(self) -> int:

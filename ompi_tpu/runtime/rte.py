@@ -52,6 +52,10 @@ class InprocWorld:
         self.barrier = threading.Barrier(size)
         self.states: List[Any] = [None] * size  # ProcState per rank
         self.aborted: Optional[tuple] = None
+        # shared rendezvous objects for device collectives (coll/tpu,
+        # coll/hbm), keyed by communicator cid
+        self.shared: Dict[Any, Any] = {}
+        self.shared_lock = threading.Lock()
 
     def make_rte(self, rank: int) -> "InprocRTE":
         return InprocRTE(self, rank)

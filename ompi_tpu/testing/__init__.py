@@ -26,17 +26,19 @@ class RankError(RuntimeError):
 
 
 def run_ranks(n: int, fn: Callable, devices: bool = False,
-              timeout: float = 120.0) -> List[Any]:
+              timeout: float = 120.0, device_map=None) -> List[Any]:
     """Run fn(comm_world) on n thread-ranks; returns per-rank results.
 
     devices=True maps rank i to jax.devices()[i % ndev] so coll/tpu
-    and coll/hbm become eligible.
+    and coll/hbm become eligible.  device_map overrides: a callable
+    rank -> jax device (e.g. lambda r: jax.devices()[0] to co-locate
+    every rank on one chip and exercise coll/hbm).
     """
     world = InprocWorld(n)
     results: List[Any] = [None] * n
     errors: List[Optional[RankError]] = [None] * n
     devs = None
-    if devices:
+    if devices or device_map is not None:
         import jax
         devs = jax.devices()
 
@@ -45,7 +47,10 @@ def run_ranks(n: int, fn: Callable, devices: bool = False,
             rte = world.make_rte(rank)
             state = ProcState(rank, n, rte)
             world.states[rank] = state
-            dev = devs[rank % len(devs)] if devs else None
+            if device_map is not None:
+                dev = device_map(rank)
+            else:
+                dev = devs[rank % len(devs)] if devs else None
             mpi_init(state, device=dev)
 
             def _abort_check() -> int:

@@ -1,0 +1,336 @@
+"""Device collective tests: coll/tpu (XLA mesh) on the 8-device
+virtual CPU mesh, coll/hbm (co-located ranks, one chip), and the
+host-staged fallback.  This is the north-star path (BASELINE.json):
+MPI collectives on device-resident buffers lowered to
+psum/psum_scatter/all_gather/all_to_all/ppermute.
+"""
+
+import numpy as np
+import pytest
+
+from ompi_tpu.op import op as mpi_op
+from ompi_tpu.testing import run_ranks
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+
+def _put(comm, a):
+    return jax.device_put(a, comm.device)
+
+
+# ---------------------------------------------------------------------------
+# coll/tpu: one rank per device
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("n", [2, 4, 8])
+def test_tpu_allreduce_sum(n):
+    def fn(comm):
+        assert comm.coll.providers["allreduce_arr"] == "tpu"
+        x = _put(comm, jnp.arange(32, dtype=jnp.float32) + comm.rank)
+        r = comm.allreduce_arr(x, mpi_op.SUM)
+        return np.asarray(r)
+
+    res = run_ranks(n, fn, devices=True)
+    exp = sum(np.arange(32, dtype=np.float32) + k for k in range(n))
+    for r in res:
+        np.testing.assert_allclose(r, exp)
+
+
+@pytest.mark.parametrize("opname", ["MAX", "MIN", "PROD", "BXOR"])
+def test_tpu_allreduce_ops(opname):
+    n = 4
+    op = getattr(mpi_op, opname)
+    dtype = jnp.int32 if not op.float_ok else jnp.float32
+
+    def fn(comm):
+        x = _put(comm, jnp.array([comm.rank + 1, 7 - comm.rank],
+                                 dtype=dtype))
+        return np.asarray(comm.allreduce_arr(x, op))
+
+    res = run_ranks(n, fn, devices=True)
+    vals = [np.array([k + 1, 7 - k]) for k in range(n)]
+    npop = {"MAX": np.maximum, "MIN": np.minimum,
+            "PROD": np.multiply, "BXOR": np.bitwise_xor}[opname]
+    exp = vals[0]
+    for v in vals[1:]:
+        exp = npop(exp, v)
+    for r in res:
+        np.testing.assert_array_equal(r, exp)
+
+
+def test_tpu_bcast():
+    def fn(comm):
+        val = comm.rank * 100.0 if comm.rank == 3 else 0.0
+        x = _put(comm, jnp.full((8,), val, dtype=jnp.float32))
+        return float(np.asarray(comm.bcast_arr(x, root=3))[0])
+
+    res = run_ranks(8, fn, devices=True)
+    assert res == [300.0] * 8
+
+
+def test_tpu_reduce_scatter():
+    n = 4
+
+    def fn(comm):
+        x = _put(comm, jnp.arange(n * 3, dtype=jnp.float32) * (comm.rank + 1))
+        return np.asarray(comm.reduce_scatter_arr(x, mpi_op.SUM))
+
+    res = run_ranks(n, fn, devices=True)
+    total = np.arange(n * 3, dtype=np.float32) * sum(range(1, n + 1))
+    for k, r in enumerate(res):
+        np.testing.assert_allclose(r, total[3 * k:3 * (k + 1)])
+
+
+def test_tpu_allgather_alltoall():
+    n = 8
+
+    def fn(comm):
+        ag = comm.allgather_arr(_put(comm, jnp.array([comm.rank * 2],
+                                                     jnp.int32)))
+        a2a = comm.alltoall_arr(_put(
+            comm, jnp.arange(n, dtype=jnp.int32) + comm.rank * 10))
+        return np.asarray(ag).tolist(), np.asarray(a2a).tolist()
+
+    res = run_ranks(n, fn, devices=True)
+    for k, (ag, a2a) in enumerate(res):
+        assert ag == [2 * i for i in range(n)]
+        assert a2a == [i * 10 + k for i in range(n)]
+
+
+def test_tpu_ppermute_ring():
+    """The ring-attention primitive: shift along the mesh axis."""
+    n = 8
+
+    def fn(comm):
+        x = _put(comm, jnp.array([comm.rank], jnp.int32))
+        fwd = comm.ppermute_arr(
+            x, [(i, (i + 1) % n) for i in range(n)])
+        return int(np.asarray(fwd)[0])
+
+    res = run_ranks(n, fn, devices=True)
+    assert res == [(k - 1) % n for k in range(n)]
+
+
+def test_tpu_subcomm_mesh():
+    """Split comm maps onto a sub-mesh; collectives stay on-device."""
+    def fn(comm):
+        sub = comm.split(comm.rank % 2)
+        assert sub.coll.providers["allreduce_arr"] == "tpu"
+        x = _put(comm, jnp.array([float(comm.rank)], jnp.float32))
+        r = sub.allreduce_arr(x, mpi_op.SUM)
+        return float(np.asarray(r)[0])
+
+    res = run_ranks(8, fn, devices=True)
+    assert res == [12.0, 16.0] * 4  # 0+2+4+6, 1+3+5+7
+
+
+def test_tpu_unsupported_op_falls_back():
+    """MAXLOC (pair type) is not XLA-lowered; falls back through the
+    host path and still returns correct results."""
+    def fn(comm):
+        x = _put(comm, jnp.full((4,), float(comm.rank), jnp.float32))
+        # user op → host fallback
+        fold = mpi_op.create(
+            lambda a, b, _: np.copyto(b, np.maximum(a, b)), commute=True)
+        r = comm.allreduce_arr(x, fold)
+        return float(np.asarray(r)[0])
+
+    res = run_ranks(4, fn, devices=True)
+    assert res == [3.0] * 4
+
+
+def test_tpu_bf16():
+    """bf16 allreduce — the MXU-native dtype."""
+    def fn(comm):
+        x = _put(comm, jnp.full((16,), comm.rank + 1, dtype=jnp.bfloat16))
+        r = comm.allreduce_arr(x, mpi_op.SUM)
+        return float(np.asarray(r, dtype=np.float32)[0])
+
+    res = run_ranks(4, fn, devices=True)
+    assert res == [10.0] * 4
+
+
+# ---------------------------------------------------------------------------
+# coll/hbm: all ranks co-located on one device
+# ---------------------------------------------------------------------------
+
+def _one_dev(rank):
+    return jax.devices()[0]
+
+
+def test_hbm_selected_and_allreduce():
+    def fn(comm):
+        assert comm.coll.providers["allreduce_arr"] == "hbm"
+        x = _put(comm, jnp.arange(8, dtype=jnp.float32) * (comm.rank + 1))
+        r = comm.allreduce_arr(x, mpi_op.SUM)
+        return np.asarray(r)
+
+    res = run_ranks(4, fn, device_map=_one_dev)
+    exp = np.arange(8, dtype=np.float32) * 10
+    for r in res:
+        np.testing.assert_allclose(r, exp)
+
+
+def test_hbm_alltoall_allgather_bcast():
+    n = 4
+
+    def fn(comm):
+        a2a = comm.alltoall_arr(_put(
+            comm, jnp.arange(n, dtype=jnp.int32) + comm.rank * 10))
+        ag = comm.allgather_arr(_put(comm, jnp.array([comm.rank],
+                                                     jnp.int32)))
+        b = comm.bcast_arr(_put(comm, jnp.array(
+            [comm.rank * 5.0], jnp.float32)), root=2)
+        rs = comm.reduce_scatter_arr(_put(
+            comm, jnp.ones(n * 2, jnp.float32)), mpi_op.SUM)
+        return (np.asarray(a2a).tolist(), np.asarray(ag).tolist(),
+                float(np.asarray(b)[0]), np.asarray(rs).tolist())
+
+    res = run_ranks(n, fn, device_map=_one_dev)
+    for k, (a2a, ag, b, rs) in enumerate(res):
+        assert a2a == [i * 10 + k for i in range(n)]
+        assert ag == list(range(n))
+        assert b == 10.0
+        assert rs == [float(n)] * 2
+
+
+def test_hbm_ppermute():
+    n = 4
+
+    def fn(comm):
+        x = _put(comm, jnp.array([comm.rank], jnp.int32))
+        y = comm.ppermute_arr(x, [(i, (i + 1) % n) for i in range(n)])
+        return int(np.asarray(y)[0])
+
+    res = run_ranks(n, fn, device_map=_one_dev)
+    assert res == [(k - 1) % n for k in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# host-staged fallback (no devices assigned)
+# ---------------------------------------------------------------------------
+
+def test_arr_host_fallback():
+    def fn(comm):
+        assert comm.coll.providers["allreduce_arr"] == "arr_host"
+        x = jnp.full((4,), float(comm.rank + 1))
+        r = comm.allreduce_arr(x, mpi_op.SUM)
+        return float(np.asarray(r)[0])
+
+    res = run_ranks(3, fn)  # no devices => host staging
+    assert res == [6.0] * 3
+
+
+def test_tpu_numpy_input_falls_back():
+    """numpy buffers through the _arr surface still work."""
+    def fn(comm):
+        x = np.full(4, comm.rank + 1.0)
+        r = comm.allreduce_arr(x, mpi_op.SUM)
+        return float(np.asarray(r)[0])
+
+    res = run_ranks(4, fn, devices=True)
+    assert res == [10.0] * 4
+
+
+# ---------------------------------------------------------------------------
+# review-finding regressions
+# ---------------------------------------------------------------------------
+
+def test_tpu_scalar_allreduce():
+    """0-d arrays (a loss value) must work on the device path."""
+    def fn(comm):
+        x = jax.device_put(jnp.float32(comm.rank + 1.0), comm.device)
+        r = comm.allreduce_arr(x, mpi_op.SUM)
+        assert np.asarray(r).shape == ()
+        return float(r)
+
+    res = run_ranks(4, fn, devices=True)
+    assert res == [10.0] * 4
+
+
+def test_hbm_alltoall_2d():
+    """Multi-dimensional alltoall through the stacked path."""
+    n = 4
+
+    def fn(comm):
+        x = _put(comm, jnp.arange(n * 3, dtype=jnp.float32).reshape(n, 3)
+                 + comm.rank * 100)
+        r = comm.alltoall_arr(x)
+        return np.asarray(r)
+
+    res = run_ranks(n, fn, device_map=_one_dev)
+    for k, r in enumerate(res):
+        assert r.shape == (n, 3)
+        for src in range(n):
+            np.testing.assert_allclose(
+                r[src], np.arange(n * 3, dtype=np.float32).reshape(n, 3)[k]
+                + src * 100)
+
+
+def test_arr_shapes_consistent_across_providers():
+    """allgather/alltoall/reduce_scatter must return identical shapes
+    whether served by tpu, hbm, or the host fallback."""
+    def fn(comm):
+        x = _put(comm, jnp.ones((comm.size * 2, 3), jnp.float32))
+        ag = comm.allgather_arr(x)
+        a2a = comm.alltoall_arr(x)
+        rs = comm.reduce_scatter_arr(x, mpi_op.SUM)
+        return (comm.coll.providers["allgather_arr"],
+                np.asarray(ag).shape, np.asarray(a2a).shape,
+                np.asarray(rs).shape)
+
+    n = 4
+    tpu_res = run_ranks(n, fn, devices=True)
+    hbm_res = run_ranks(n, fn, device_map=_one_dev)
+    host_res = run_ranks(n, fn)
+    shapes = {r[1:] for r in tpu_res + hbm_res + host_res}
+    assert len(shapes) == 1, shapes
+    assert {r[0] for r in tpu_res} == {"tpu"}
+    assert {r[0] for r in host_res} == {"arr_host"}
+
+
+def test_mixed_residency_no_deadlock():
+    """One rank passes numpy, the rest jax arrays — eligibility must
+    not diverge (the device path moves stray buffers)."""
+    def fn(comm):
+        if comm.rank == 0:
+            x = np.full(8, 1.0, dtype=np.float32)  # forgot device_put
+        else:
+            x = _put(comm, jnp.full((8,), 1.0, jnp.float32))
+        r = comm.allreduce_arr(x, mpi_op.SUM)
+        return float(np.asarray(r)[0])
+
+    res = run_ranks(4, fn, devices=True, timeout=60)
+    assert res == [4.0] * 4
+
+
+def test_hbm_peer_abort_unblocks_rendezvous():
+    """A rank dying before the rendezvous must not hang the others."""
+    def fn(comm):
+        if comm.rank == 1:
+            raise ValueError("dead rank")
+        x = _put(comm, jnp.ones((4,), jnp.float32))
+        comm.allreduce_arr(x, mpi_op.SUM)
+        return True
+
+    with pytest.raises(Exception, match="dead rank|aborted"):
+        run_ranks(3, fn, device_map=_one_dev, timeout=30)
+
+
+def test_comm_free_drops_rendezvous():
+    def fn(comm):
+        sub = comm.dup()
+        x = _put(comm, jnp.ones((4,), jnp.float32))
+        sub.allreduce_arr(x, mpi_op.SUM)
+        key = ("coll_rv", sub.cid, tuple(sub.group))
+        world = comm.state.rte.world
+        comm.Barrier()
+        had = key in world.shared
+        comm.Barrier()
+        sub.Free()
+        comm.Barrier()
+        return had, key in world.shared
+
+    res = run_ranks(2, fn, devices=True)
+    assert res[0][0] is True and res[0][1] is False
