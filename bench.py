@@ -1,0 +1,121 @@
+"""Benchmark entry: OSU-style MPI_Allreduce bus bandwidth.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "GB/s", "vs_baseline": N}
+
+Path selection mirrors the deployment reality (BASELINE.md):
+  * >= 2 accelerator devices: coll/tpu — one XLA AllReduce over ICI.
+  * 1 device (the CI chip): coll/hbm — 8 ranks co-located on the
+    chip, allreduce as one fused HBM kernel (the coll/sm analog).
+  * no accelerator: host path only.
+
+vs_baseline compares against the software baseline the north star
+names (coll/tuned's ring over a byte transport): the same 8-rank
+allreduce run through our tuned p2p ring on host buffers.  Values
+> 1.0 mean the device path beats the software path.
+
+busbw uses the OSU/NCCL convention: algbw * 2*(n-1)/n with
+algbw = bytes_per_rank / time.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+NRANKS = 8
+MIB = 1024 * 1024
+SIZE_BYTES = 8 * MIB  # per-rank buffer
+ITERS = 20
+WARMUP = 3
+
+
+def _bench_device() -> float:
+    """Seconds per allreduce through the device coll path."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from ompi_tpu.op import op as mpi_op
+    from ompi_tpu.testing import run_ranks
+
+    ndev = len(jax.devices())
+    if ndev >= NRANKS:
+        device_map = None
+        devices = True
+    else:
+        dev0 = jax.devices()[0]
+        device_map = lambda r: jax.devices()[r % ndev]  # noqa: E731
+        devices = False
+
+    n_elems = SIZE_BYTES // 4
+
+    def fn(comm):
+        x = jax.device_put(
+            jnp.full((n_elems,), comm.rank + 1.0, jnp.float32),
+            comm.device)
+        r = comm.allreduce_arr(x, mpi_op.SUM)
+        r.block_until_ready()
+        t0 = time.perf_counter()
+        for _ in range(ITERS):
+            r = comm.allreduce_arr(x, mpi_op.SUM)
+        r.block_until_ready()
+        dt = (time.perf_counter() - t0) / ITERS
+        # correctness guard: a fast-but-wrong bench is worthless
+        assert abs(float(np.asarray(r)[0]) - sum(range(1, NRANKS + 1))) < 1e-3
+        return dt
+
+    res = run_ranks(NRANKS, fn, devices=devices, device_map=device_map,
+                    timeout=600)
+    return max(res)
+
+
+def _bench_host() -> float:
+    """Seconds per allreduce through the tuned p2p ring (the software
+    baseline: coll/tuned over a byte transport)."""
+    import numpy as np
+    from ompi_tpu.op import op as mpi_op
+    from ompi_tpu.testing import run_ranks
+
+    n_elems = SIZE_BYTES // 4
+    iters = 5
+
+    def fn(comm):
+        x = np.full(n_elems, comm.rank + 1.0, dtype=np.float32)
+        r = np.empty_like(x)
+        comm.Allreduce(x, r, mpi_op.SUM)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            comm.Allreduce(x, r, mpi_op.SUM)
+        dt = (time.perf_counter() - t0) / iters
+        assert abs(r[0] - sum(range(1, NRANKS + 1))) < 1e-3
+        return dt
+
+    res = run_ranks(NRANKS, fn, timeout=600)
+    return max(res)
+
+
+def main() -> None:
+    result = {
+        "metric": f"osu_allreduce busbw {NRANKS} ranks x "
+                  f"{SIZE_BYTES // MIB} MiB float32",
+        "value": 0.0,
+        "unit": "GB/s",
+        "vs_baseline": 0.0,
+    }
+    try:
+        t_dev = _bench_device()
+        busbw = 2 * (NRANKS - 1) / NRANKS * SIZE_BYTES / t_dev / 1e9
+        result["value"] = round(busbw, 3)
+        try:
+            t_host = _bench_host()
+            result["vs_baseline"] = round(t_host / t_dev, 3)
+        except Exception:  # noqa: BLE001
+            result["vs_baseline"] = 0.0
+    except Exception as e:  # noqa: BLE001
+        result["error"] = str(e)[:200]
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -61,6 +61,23 @@ def _is_jax_array(x) -> bool:
     return isinstance(x, jax.Array)
 
 
+def _dtype_of(x) -> np.dtype:
+    """dtype without materializing device arrays on the host —
+    np.asarray on a jax.Array is a full device-to-host transfer."""
+    dt = getattr(x, "dtype", None)
+    return np.dtype(dt) if dt is not None else np.asarray(x).dtype
+
+
+def _ndim_of(x) -> int:
+    nd = getattr(x, "ndim", None)
+    return nd if nd is not None else np.asarray(x).ndim
+
+
+def _shape_of(x):
+    sh = getattr(x, "shape", None)
+    return sh if sh is not None else np.asarray(x).shape
+
+
 def _fold_fn(opname: str):
     import jax.numpy as jnp
     return {
@@ -269,8 +286,7 @@ class TpuCollModule(CollModule):
             return False
         if comm.mesh() is None:
             return False
-        return all(getattr(np.asarray(a).dtype, "fields", None) is None
-                   for a in arrays)
+        return all(_dtype_of(a).fields is None for a in arrays)
 
     @staticmethod
     def _norm(x):
@@ -315,7 +331,7 @@ class TpuCollModule(CollModule):
 
     def reduce_scatter_block_arr(self, comm, x, op: Op):
         if not self._eligible(comm, x) or op.name != "MPI_SUM" \
-                or np.asarray(x).ndim == 0 \
+                or _ndim_of(x) == 0 \
                 or x.shape[0] % comm.size != 0:
             return self.fallback.reduce_scatter_block_arr(comm, x, op)
         mesh = comm.mesh()
@@ -341,7 +357,7 @@ class TpuCollModule(CollModule):
         return self._run(comm, x, fn)
 
     def alltoall_arr(self, comm, x):
-        if not self._eligible(comm, x) or np.asarray(x).ndim == 0 \
+        if not self._eligible(comm, x) or _ndim_of(x) == 0 \
                 or x.shape[0] % comm.size != 0:
             return self.fallback.alltoall_arr(comm, x)
         mesh = comm.mesh()
@@ -418,8 +434,7 @@ class HbmCollModule(CollModule):
                 return False
             devs.add(st.device.id)
         return len(devs) == 1 and all(
-            getattr(np.asarray(a).dtype, "fields", None) is None
-            for a in arrays)
+            _dtype_of(a).fields is None for a in arrays)
 
     _abort_check = TpuCollModule._abort_check
     _norm = staticmethod(TpuCollModule._norm)
@@ -503,7 +518,7 @@ class HbmCollModule(CollModule):
 
     def reduce_scatter_block_arr(self, comm, x, op: Op):
         if not self._eligible(comm, x) or op.name != "MPI_SUM" \
-                or np.asarray(x).ndim == 0 \
+                or _ndim_of(x) == 0 \
                 or x.shape[0] % comm.size != 0:
             return self.fallback.reduce_scatter_block_arr(comm, x, op)
         return self._run(comm, "reduce_scatter", op.name, x)
@@ -514,7 +529,7 @@ class HbmCollModule(CollModule):
         return self._run(comm, "allgather", "", x)
 
     def alltoall_arr(self, comm, x):
-        if not self._eligible(comm, x) or np.asarray(x).ndim == 0 \
+        if not self._eligible(comm, x) or _ndim_of(x) == 0 \
                 or x.shape[0] % comm.size != 0:
             return self.fallback.alltoall_arr(comm, x)
         return self._run(comm, "alltoall", "", x)
@@ -587,22 +602,22 @@ class HostArrModule(CollModule):
         a = self._np(x).reshape(-1)
         r = np.empty_like(a)
         self.p2p.allreduce(comm, a, r, a.size, self._dtype_of(a), op)
-        return self._back(comm, r.reshape(np.asarray(x).shape))
+        return self._back(comm, r.reshape(_shape_of(x)))
 
     def bcast_arr(self, comm, x, root: int):
         a = self._np(x).reshape(-1).copy()
         self.p2p.bcast(comm, a, a.size, self._dtype_of(a), root)
-        return self._back(comm, a.reshape(np.asarray(x).shape))
+        return self._back(comm, a.reshape(_shape_of(x)))
 
     def reduce_arr(self, comm, x, op: Op, root: int):
         a = self._np(x).reshape(-1)
         r = np.empty_like(a) if comm.rank == root else None
         self.p2p.reduce(comm, a, r, a.size, self._dtype_of(a), op, root)
-        return self._back(comm, r.reshape(np.asarray(x).shape)) \
+        return self._back(comm, r.reshape(_shape_of(x))) \
             if comm.rank == root else None
 
     def allgather_arr(self, comm, x):
-        shp = np.asarray(x).shape
+        shp = _shape_of(x)
         a = self._np(x).reshape(-1)
         r = np.empty(a.size * comm.size, dtype=a.dtype)
         self.p2p.allgather(comm, a, a.size, self._dtype_of(a), r, a.size,
@@ -612,7 +627,7 @@ class HostArrModule(CollModule):
         return self._back(comm, r.reshape(out_shape))
 
     def alltoall_arr(self, comm, x):
-        shp = np.asarray(x).shape
+        shp = _shape_of(x)
         a = self._np(x).reshape(-1)
         n = a.size // comm.size
         r = np.empty_like(a)
@@ -621,7 +636,7 @@ class HostArrModule(CollModule):
         return self._back(comm, r.reshape(shp))
 
     def reduce_scatter_block_arr(self, comm, x, op: Op):
-        shp = np.asarray(x).shape
+        shp = _shape_of(x)
         a = self._np(x).reshape(-1)
         n = a.size // comm.size
         r = np.empty(n, dtype=a.dtype)
