@@ -1,0 +1,5 @@
+"""ref: examples/hello_c.c"""
+import ompi_tpu
+comm = ompi_tpu.init()
+print(f"Hello, world, I am {comm.rank} of {comm.size}", flush=True)
+ompi_tpu.finalize()

@@ -1,0 +1,67 @@
+"""ompi_tpu: a TPU-native message-passing framework with the
+capabilities of Open MPI (see SURVEY.md for the reference map and
+docs/DESIGN.md for the architecture).
+
+Quick start (process-ranks, launched by our mpirun):
+
+    # prog.py
+    import ompi_tpu
+    comm = ompi_tpu.init()
+    ...
+    ompi_tpu.finalize()
+
+    $ python -m ompi_tpu.tools.mpirun -np 4 prog.py
+
+or thread-ranks mapped onto local accelerator devices:
+
+    from ompi_tpu.testing import run_ranks
+    run_ranks(8, fn, devices=True)
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+__version__ = "0.1.0"
+
+
+def init(device=None):
+    """MPI_Init analog: bootstrap this process's rank and return
+    COMM_WORLD (ref: ompi/mpi/c/init.c → ompi_mpi_init.c)."""
+    from ompi_tpu.runtime import state as statemod
+    from ompi_tpu.runtime.init import mpi_init
+    from ompi_tpu.runtime.rte import make_rte
+
+    existing = statemod.maybe_current()
+    if existing is not None and existing.initialized \
+            and not existing.finalized:
+        return existing.comm_world
+    rte = make_rte()
+    st = statemod.ProcState(rte.rank, rte.size, rte)
+    mpi_init(st, device=device)  # publishes into rte.world itself
+    statemod.set_current(st, process_wide=True)
+    return st.comm_world
+
+
+def finalize() -> None:
+    """MPI_Finalize analog (ref: ompi_mpi_finalize.c:101)."""
+    from ompi_tpu.runtime import state as statemod
+    from ompi_tpu.runtime.init import mpi_finalize
+
+    st = statemod.maybe_current()
+    if st is not None and st.initialized and not st.finalized:
+        mpi_finalize(st)
+
+
+def initialized() -> bool:
+    from ompi_tpu.runtime import state as statemod
+
+    st = statemod.maybe_current()
+    return st is not None and st.initialized
+
+
+def finalized() -> bool:
+    from ompi_tpu.runtime import state as statemod
+
+    st = statemod.maybe_current()
+    return st is not None and st.finalized

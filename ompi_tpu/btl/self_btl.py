@@ -1,0 +1,38 @@
+"""Self BTL: loopback to this rank's own inbox
+(ref: opal/mca/btl/self)."""
+
+from __future__ import annotations
+
+from typing import List
+
+from .base import BTLComponent, BTLModule, btl_framework
+
+
+class SelfModule(BTLModule):
+    name = "self"
+    exclusivity = 200
+    eager_limit = 16 * 1024 * 1024
+    max_send_size = 64 * 1024 * 1024
+
+    def __init__(self, state) -> None:
+        self.state = state
+
+    def reaches(self, peer: int) -> bool:
+        return peer == self.state.rank
+
+    def send(self, peer: int, frag) -> None:
+        self.state.pml.inbox.append(frag)
+
+
+class SelfComponent(BTLComponent):
+    name = "self"
+    priority = 200
+
+    def init_modules(self, state) -> List[BTLModule]:
+        # thread-rank worlds route self through inproc already
+        if hasattr(state.rte, "world"):
+            return []
+        return [SelfModule(state)]
+
+
+btl_framework.add_component(SelfComponent())

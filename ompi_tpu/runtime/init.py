@@ -12,9 +12,12 @@ from typing import Optional
 
 from ompi_tpu.btl import base as btl_base
 from ompi_tpu.btl import inproc as _btl_inproc  # noqa: F401 (registers)
+from ompi_tpu.btl import self_btl as _btl_self  # noqa: F401
+from ompi_tpu.btl import shm as _btl_shm  # noqa: F401
+from ompi_tpu.btl import tcp as _btl_tcp  # noqa: F401
 from ompi_tpu.comm.communicator import Communicator, Group
 from ompi_tpu.pml import ob1 as _pml_ob1
-from .state import ProcState, set_current
+from .state import ProcState, clear_current, set_current
 
 
 def mpi_init(state: ProcState, device=None) -> ProcState:
@@ -58,4 +61,4 @@ def mpi_finalize(state: ProcState) -> None:
         m.finalize()
     state.rte.finalize()
     state.finalized = True
-    set_current(None)
+    clear_current(state)

@@ -1,0 +1,201 @@
+"""KV rendezvous store: the PMIx analog.
+
+The launcher hosts one TCP server; ranks connect as clients and use
+put / blocking-get (modex business-card exchange) / fence (barrier)
+/ abort — the exact contract ompi_mpi_init needs from its runtime
+(ref: opal/mca/pmix usage at ompi/runtime/ompi_mpi_init.c:654-661;
+the modex OPAL_MODEX_SEND/RECV pattern of btl_tcp_component.c:1128).
+
+Wire format: 4-byte big-endian length + JSON object.  Values are
+JSON-serializable (byte payloads go hex-encoded; modex values are
+small address blobs, never data-plane traffic).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+
+def _send_msg(sock: socket.socket, obj: dict) -> None:
+    data = json.dumps(obj).encode()
+    sock.sendall(struct.pack(">I", len(data)) + data)
+
+
+def _recv_msg(sock: socket.socket) -> Optional[dict]:
+    hdr = b""
+    while len(hdr) < 4:
+        chunk = sock.recv(4 - len(hdr))
+        if not chunk:
+            return None
+        hdr += chunk
+    (n,) = struct.unpack(">I", hdr)
+    data = b""
+    while len(data) < n:
+        chunk = sock.recv(n - len(data))
+        if not chunk:
+            return None
+        data += chunk
+    return json.loads(data)
+
+
+class KVServer:
+    """Runs inside the launcher (the HNP role)."""
+
+    def __init__(self, nprocs: int, host: str = "127.0.0.1") -> None:
+        self.nprocs = nprocs
+        self.data: Dict[str, Any] = {}
+        self.lock = threading.Lock()
+        self.cv = threading.Condition(self.lock)
+        self.fences: Dict[str, int] = {}
+        self.fence_waiters: Dict[str, List[socket.socket]] = {}
+        self.aborted: Optional[Tuple[int, int, str]] = None
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind((host, 0))
+        self.sock.listen(nprocs * 4)
+        self.addr = f"{host}:{self.sock.getsockname()[1]}"
+        self._threads: List[threading.Thread] = []
+        self._stop = False
+        self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               daemon=True)
+        self._accept_thread.start()
+
+    def _accept_loop(self) -> None:
+        while not self._stop:
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._serve, args=(conn,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _serve(self, conn: socket.socket) -> None:
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            while True:
+                msg = _recv_msg(conn)
+                if msg is None:
+                    return
+                op = msg.get("op")
+                if op == "put":
+                    with self.cv:
+                        self.data[msg["key"]] = msg["value"]
+                        self.cv.notify_all()
+                    _send_msg(conn, {"ok": True})
+                elif op == "get":
+                    timeout = msg.get("timeout", 60.0)
+                    with self.cv:
+                        deadline_hit = not self.cv.wait_for(
+                            lambda: msg["key"] in self.data
+                            or self.aborted is not None,
+                            timeout=timeout)
+                        if self.aborted is not None:
+                            _send_msg(conn, {"abort": list(self.aborted)})
+                        elif deadline_hit:
+                            _send_msg(conn, {"timeout": True})
+                        else:
+                            _send_msg(conn, {"value": self.data[msg["key"]]})
+                elif op == "fence":
+                    fid = msg["id"]
+                    with self.cv:
+                        self.fences[fid] = self.fences.get(fid, 0) + 1
+                        self.fence_waiters.setdefault(fid, []).append(conn)
+                        if self.fences[fid] == self.nprocs:
+                            for c in self.fence_waiters[fid]:
+                                try:
+                                    _send_msg(c, {"fence_done": fid})
+                                except OSError:
+                                    pass
+                            del self.fences[fid]
+                            del self.fence_waiters[fid]
+                            self.cv.notify_all()
+                    # reply sent when fence completes (above)
+                elif op == "abort":
+                    with self.cv:
+                        if self.aborted is None:
+                            self.aborted = (msg["rank"], msg["code"],
+                                            msg.get("msg", ""))
+                        self.cv.notify_all()
+                    _send_msg(conn, {"ok": True})
+                elif op == "poll_abort":
+                    with self.cv:
+                        _send_msg(conn, {"abort": list(self.aborted)
+                                         if self.aborted else None})
+        except OSError:
+            return
+
+    def close(self) -> None:
+        self._stop = True
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class KVClient:
+    """One per rank process.  A dedicated socket per client; fence
+    uses a second socket so a blocking fence can't starve gets."""
+
+    def __init__(self, addr: str) -> None:
+        host, port = addr.rsplit(":", 1)
+        self.addr = (host, int(port))
+        self._lock = threading.Lock()
+        self._sock = self._connect()
+
+    def _connect(self) -> socket.socket:
+        s = socket.create_connection(self.addr, timeout=60)
+        # connect timeout only: blocking ops (fence with rank skew,
+        # modex gets) must not inherit a 60s socket timeout — hang
+        # protection is the server-side get timeout + mpirun --timeout
+        s.settimeout(None)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return s
+
+    def put(self, key: str, value: Any) -> None:
+        with self._lock:
+            _send_msg(self._sock, {"op": "put", "key": key, "value": value})
+            _recv_msg(self._sock)
+
+    def get(self, key: str, timeout: float = 60.0) -> Any:
+        with self._lock:
+            _send_msg(self._sock, {"op": "get", "key": key,
+                                   "timeout": timeout})
+            resp = _recv_msg(self._sock)
+        if resp is None:
+            raise ConnectionError("kv server closed")
+        if "abort" in resp:
+            raise RuntimeError(f"job aborted: {resp['abort']}")
+        if resp.get("timeout"):
+            raise TimeoutError(f"kv get({key}) timed out")
+        return resp["value"]
+
+    def fence(self, fence_id: str) -> None:
+        with self._lock:
+            _send_msg(self._sock, {"op": "fence", "id": fence_id})
+            resp = _recv_msg(self._sock)
+        if resp is None or "fence_done" not in resp:
+            raise RuntimeError(f"fence {fence_id} failed: {resp}")
+
+    def abort(self, rank: int, code: int, msg: str = "") -> None:
+        with self._lock:
+            _send_msg(self._sock, {"op": "abort", "rank": rank,
+                                   "code": code, "msg": msg})
+            _recv_msg(self._sock)
+
+    def poll_abort(self):
+        with self._lock:
+            _send_msg(self._sock, {"op": "poll_abort"})
+            resp = _recv_msg(self._sock)
+        return resp.get("abort") if resp else None
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass

@@ -28,6 +28,15 @@ _lp_ratio_var = registry.register(
     "opal", "progress", "lp_call_ratio", 8, int,
     help="Low-priority callbacks run every Nth progress call")
 
+import os as _os
+
+# local ranks on THIS host vs local cores (multi-host jobs export
+# TPUMPI_LOCAL_SIZE per node; fall back to the world size single-host)
+_OVERSUBSCRIBED = (
+    int(_os.environ.get("TPUMPI_LOCAL_SIZE",
+                        _os.environ.get("TPUMPI_SIZE", "1")))
+    > (_os.cpu_count() or 1))
+
 
 class Progress:
     def __init__(self) -> None:
@@ -39,6 +48,11 @@ class Progress:
         # a rank parked in WaitSync wakes immediately instead of
         # polling (the wait_sync condvar signal in the reference).
         self.doorbell = threading.Event()
+        # poll_mode: at least one transport is poll-only (shm rings,
+        # tcp sockets across processes) — nobody can ring the
+        # doorbell, so blocked waits must keep polling with short
+        # backoff instead of parking.
+        self.poll_mode = False
 
     def wakeup(self) -> None:
         self.doorbell.set()
@@ -103,7 +117,19 @@ class WaitSync:
         while not self._event.is_set():
             if progress.progress() == 0:
                 spins += 1
-                if spins > 200:
+                if progress.poll_mode:
+                    # poll-only transports.  Oversubscribed hosts
+                    # (ranks > cores) need aggressive yielding or every
+                    # blocked rank burns a scheduler timeslice before
+                    # the rank holding our message runs (the reference
+                    # auto-sets yield_when_idle for oversubscription).
+                    if _OVERSUBSCRIBED:
+                        if spins > 4:
+                            time.sleep(0)  # sched_yield to peers
+                    elif spins > 5000:
+                        time.sleep(0.0002)
+                        spins = 0
+                elif spins > 200:
                     # Park on the doorbell; peers ring it when they
                     # enqueue frags for us (cross-thread wakeup).
                     progress.doorbell.clear()

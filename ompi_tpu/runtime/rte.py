@@ -9,7 +9,7 @@ Implementations:
   * InprocRTE — thread-ranks inside one host process (the TPU-host
     model; also the fast test harness).  Modex is a shared dict,
     fence a threading.Barrier.
-  * EnvRTE — process-ranks launched by ompi_tpu.tools.launch; modex
+  * EnvRTE — process-ranks launched by ompi_tpu.tools.mpirun; modex
     and fence go through the launcher's KV store over TCP (the
     PMIx-like put/commit/fence, ref: opal/mca/pmix usage in
     ompi_mpi_init.c:654-661).
@@ -90,3 +90,56 @@ class InprocRTE(RTE):
         with self.world.modex_cv:
             self.world.modex_cv.notify_all()
         raise SystemExit(code)
+
+
+class EnvRTE(RTE):
+    """Process-rank runtime: identity from the environment set by the
+    launcher (ompi_tpu.tools.mpirun), modex/fence through its KV
+    server (ref: orte/mca/ess env component + pmix client)."""
+
+    def __init__(self) -> None:
+        import os
+
+        from .kvstore import KVClient  # noqa: PLC0415
+
+        self.rank = int(os.environ["TPUMPI_RANK"])
+        self.size = int(os.environ["TPUMPI_SIZE"])
+        self.jobid = os.environ.get("TPUMPI_JOBID", "job0")
+        self.node_id = int(os.environ.get("TPUMPI_NODE", "0"))
+        self.session_dir = os.environ.get("TPUMPI_SESSION_DIR", "/tmp")
+        self.kv = KVClient(os.environ["TPUMPI_KV_ADDR"])
+        self._fence_count = 0
+
+    def modex_put(self, key: str, value: Any) -> None:
+        self.kv.put(f"modex:{self.rank}:{key}", value)
+
+    def modex_get(self, peer: int, key: str) -> Any:
+        return self.kv.get(f"modex:{peer}:{key}")
+
+    def fence(self) -> None:
+        self._fence_count += 1
+        self.kv.fence(f"f{self._fence_count}")
+
+    def abort(self, code: int, msg: str = "") -> None:
+        import os
+        import sys
+
+        self.kv.abort(self.rank, code, msg)
+        sys.stderr.write(f"[rank {self.rank}] MPI_Abort({code}): {msg}\n")
+        sys.stderr.flush()
+        os._exit(code)
+
+    def finalize(self) -> None:
+        self.kv.close()
+
+
+def make_rte() -> RTE:
+    """Bootstrap this process's runtime (ess component selection
+    analog, ref: orte/mca/ess): launched by our mpirun → EnvRTE;
+    standalone → singleton world of size 1."""
+    import os
+
+    if "TPUMPI_KV_ADDR" in os.environ:
+        return EnvRTE()
+    world = InprocWorld(1)
+    return world.make_rte(0)

@@ -52,6 +52,17 @@ def set_current(state: Optional[ProcState], process_wide: bool = False) -> None:
         _tls.state = state
 
 
+def clear_current(state: ProcState) -> None:
+    """Drop `state` from both the thread-local and process-wide
+    slots (finalize path): later current() calls must raise the
+    clean not-initialized error, not hand out a dead state."""
+    global _process_state
+    if getattr(_tls, "state", None) is state:
+        _tls.state = None
+    if _process_state is state:
+        _process_state = None
+
+
 def current() -> ProcState:
     st = getattr(_tls, "state", None)
     if st is None:

@@ -1,0 +1,160 @@
+"""mpirun: launch N process-ranks with KV wireup, IO forwarding and
+failure propagation.
+
+Re-design of orterun/HNP (ref: orte/tools/orterun/main.c:13,
+orted_submit.c job construction; odls fork/exec
+ref: odls_default_module.c:338-437; IOF ref: orte/mca/iof; errmgr
+default-HNP kill-job-on-proc-death policy ref:
+orte/mca/errmgr/default_hnp).  Single-host for now: the launcher IS
+the daemon (fork/exec local); the KV server it hosts is the PMIx
+server role.  Multi-host ssh tree launch is the next stage of the
+plm analog.
+
+Usage:
+    python -m ompi_tpu.tools.mpirun -np 4 [--mca k v] [--tag-output]
+        [--timeout SEC] prog [args...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from typing import List, Optional
+
+from ompi_tpu.runtime.kvstore import KVServer
+
+
+def _forward(stream, out, tag: str, tag_output: bool) -> None:
+    """IOF: line-buffered forwarding with optional rank tags
+    (ref: orte/mca/iof flow)."""
+    try:
+        for line in iter(stream.readline, b""):
+            if tag_output:
+                out.write(f"[{tag}]".encode() + line)
+            else:
+                out.write(line)
+            out.flush()
+    except (OSError, ValueError):
+        pass
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(prog="mpirun")
+    ap.add_argument("-np", "-n", type=int, required=True, dest="np")
+    ap.add_argument("--mca", nargs=2, action="append", default=[],
+                    metavar=("KEY", "VALUE"))
+    ap.add_argument("--tag-output", action="store_true")
+    ap.add_argument("--timeout", type=float, default=0.0,
+                    help="Kill the job after SEC seconds")
+    ap.add_argument("--wdir", default=None)
+    ap.add_argument("prog")
+    ap.add_argument("args", nargs=argparse.REMAINDER)
+    opts = ap.parse_args(argv)
+
+    session = tempfile.mkdtemp(prefix="tpumpi-session-")
+    server = KVServer(opts.np)
+    procs: List[subprocess.Popen] = []
+    fwd_threads: List[threading.Thread] = []
+    exit_code = 0
+
+    if opts.prog.endswith(".py"):
+        base_cmd = [sys.executable, opts.prog] + opts.args
+    else:
+        base_cmd = [opts.prog] + opts.args
+
+    env_base = dict(os.environ)
+    # children must see the ompi_tpu package regardless of their cwd
+    import ompi_tpu as _pkg
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(
+        _pkg.__file__)))
+    env_base["PYTHONPATH"] = pkg_root + (
+        os.pathsep + env_base["PYTHONPATH"]
+        if env_base.get("PYTHONPATH") else "")
+    env_base.update({
+        "TPUMPI_SIZE": str(opts.np),
+        "TPUMPI_LOCAL_SIZE": str(opts.np),  # single-host launch
+        "TPUMPI_KV_ADDR": server.addr,
+        "TPUMPI_SESSION_DIR": session,
+        "TPUMPI_JOBID": f"job-{os.getpid()}",
+    })
+    for key, value in opts.mca:
+        env_base[f"TPUMPI_MCA_{key}"] = value
+
+    try:
+        for rank in range(opts.np):
+            env = dict(env_base)
+            env["TPUMPI_RANK"] = str(rank)
+            p = subprocess.Popen(
+                base_cmd, env=env, cwd=opts.wdir,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+            procs.append(p)
+            for stream, out in ((p.stdout, sys.stdout.buffer),
+                                (p.stderr, sys.stderr.buffer)):
+                t = threading.Thread(
+                    target=_forward,
+                    args=(stream, out, f"{rank}", opts.tag_output),
+                    daemon=True)
+                t.start()
+                fwd_threads.append(t)
+
+        deadline = time.monotonic() + opts.timeout if opts.timeout else None
+        # errmgr default-HNP policy: first abnormal exit (or KV abort)
+        # kills the job and its code is the job's code
+        while True:
+            alive = [p for p in procs if p.poll() is None]
+            failed = [p for p in procs
+                      if p.returncode not in (None, 0)]
+            if server.aborted is not None:
+                exit_code = server.aborted[1] or 1
+                sys.stderr.write(
+                    f"mpirun: rank {server.aborted[0]} called "
+                    f"MPI_Abort({exit_code}): {server.aborted[2]}\n")
+                break
+            if failed:
+                p = failed[0]
+                exit_code = p.returncode if p.returncode > 0 else 1
+                rank = procs.index(p)
+                sys.stderr.write(
+                    f"mpirun: rank {rank} exited with status "
+                    f"{p.returncode}; terminating remaining "
+                    f"{len(alive)} processes\n")
+                break
+            if not alive:
+                break
+            if deadline is not None and time.monotonic() > deadline:
+                sys.stderr.write(
+                    f"mpirun: job exceeded --timeout "
+                    f"{opts.timeout}s; killing\n")
+                exit_code = 124
+                break
+            time.sleep(0.02)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        t_end = time.monotonic() + 2.0
+        for p in procs:
+            if p.poll() is None and time.monotonic() < t_end:
+                try:
+                    p.wait(timeout=max(0.1, t_end - time.monotonic()))
+                except subprocess.TimeoutExpired:
+                    pass
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for t in fwd_threads:
+            t.join(timeout=1.0)
+        server.close()
+        shutil.rmtree(session, ignore_errors=True)
+    return exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

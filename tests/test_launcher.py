@@ -1,0 +1,110 @@
+"""Launcher + process-rank integration tests (ref: the reference's
+orte/test/mpi programs run under mpirun: hello, ring, connectivity,
+abort/exit-code propagation)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def mpirun(np, prog, *args, mca=(), timeout=90):
+    cmd = [sys.executable, "-m", "ompi_tpu.tools.mpirun", "-np", str(np)]
+    for k, v in mca:
+        cmd += ["--mca", k, v]
+    cmd += [os.path.join(REPO, "examples", prog), *args]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # keep subprocess JAX off the TPU: examples never touch devices
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.run(cmd, capture_output=True, timeout=timeout,
+                          env=env, cwd=REPO)
+
+
+def test_hello():
+    r = mpirun(3, "hello.py")
+    assert r.returncode == 0, r.stderr.decode()
+    out = r.stdout.decode()
+    for k in range(3):
+        assert f"I am {k} of 3" in out
+
+
+def test_ring():
+    r = mpirun(4, "ring.py")
+    assert r.returncode == 0, r.stderr.decode()
+    assert "received token 7 from 3" in r.stdout.decode()
+
+
+def test_connectivity_shm():
+    r = mpirun(3, "connectivity.py")
+    assert r.returncode == 0, r.stderr.decode()
+    assert "PASSED" in r.stdout.decode()
+
+
+def test_connectivity_tcp_only():
+    r = mpirun(3, "connectivity.py", mca=(("btl", "self,tcp"),))
+    assert r.returncode == 0, r.stderr.decode()
+    assert "PASSED" in r.stdout.decode()
+
+
+def test_abort_propagates_exit_code():
+    r = mpirun(3, "abort_test.py")
+    assert r.returncode == 42
+    assert "MPI_Abort" in r.stderr.decode()
+    assert "should not reach here" not in r.stdout.decode()
+
+
+def test_osu_allreduce_runs():
+    r = mpirun(2, "osu_allreduce.py", "4,65536")
+    assert r.returncode == 0, r.stderr.decode()
+    assert "bytes" in r.stdout.decode()
+
+
+def test_mca_param_flows_to_children():
+    # ring still works when forced into tiny rendezvous segments
+    r = mpirun(2, "osu_allreduce.py", "65536",
+               mca=(("btl_shm_eager_limit", "1024"),
+                    ("btl_shm_max_send_size", "4096")))
+    assert r.returncode == 0, r.stderr.decode()
+
+
+def test_singleton_init():
+    """ompi_tpu.init() without a launcher = 1-rank world."""
+    code = ("import ompi_tpu, numpy as np\n"
+            "from ompi_tpu.op import op\n"
+            "c = ompi_tpu.init()\n"
+            "assert c.size == 1 and c.rank == 0\n"
+            "x = np.ones(4, np.float32); r = np.empty_like(x)\n"
+            "c.Allreduce(x, r, op.SUM)\n"
+            "assert r[0] == 1.0\n"
+            "c.Barrier()\n"
+            "ompi_tpu.finalize()\n"
+            "print('singleton ok')\n")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       timeout=60, env=env)
+    assert r.returncode == 0, r.stderr.decode()
+    assert b"singleton ok" in r.stdout
+
+
+def test_job_timeout():
+    """--timeout kills a hung job with exit 124."""
+    code = "import time; time.sleep(60)"
+    import tempfile
+    with tempfile.NamedTemporaryFile("w", suffix=".py", dir="/tmp",
+                                     delete=False) as f:
+        f.write(code)
+        path = f.name
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [sys.executable, "-m", "ompi_tpu.tools.mpirun", "-np", "2",
+         "--timeout", "3", path],
+        capture_output=True, timeout=60, env=env, cwd=REPO)
+    os.unlink(path)
+    assert r.returncode == 124
