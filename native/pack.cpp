@@ -1,0 +1,31 @@
+// Strided pack/unpack kernels: the native hot path of the datatype
+// convertor (ref: opal/datatype pack/unpack loops; our descriptor
+// model collapses the reference's loop/element bytecode to strided
+// runs, see ompi_tpu/datatype/engine.py).
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// Gather nblocks of block_bytes each, stride apart, into dst.
+void tpumpi_pack_strided(const uint8_t* src, uint8_t* dst,
+                         uint64_t block_bytes, int64_t stride,
+                         uint64_t nblocks) {
+    for (uint64_t b = 0; b < nblocks; ++b) {
+        std::memcpy(dst + b * block_bytes,
+                    src + static_cast<int64_t>(b) * stride, block_bytes);
+    }
+}
+
+// Scatter packed src back into strided dst blocks.
+void tpumpi_unpack_strided(uint8_t* dst, const uint8_t* src,
+                           uint64_t block_bytes, int64_t stride,
+                           uint64_t nblocks) {
+    for (uint64_t b = 0; b < nblocks; ++b) {
+        std::memcpy(dst + static_cast<int64_t>(b) * stride,
+                    src + b * block_bytes, block_bytes);
+    }
+}
+
+}  // extern "C"

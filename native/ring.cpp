@@ -1,0 +1,108 @@
+// SPSC shared-memory ring: the native data plane of the shm BTL.
+//
+// TPU-native re-design of the vader btl's fast-box transfer path
+// (ref: opal/mca/btl/vader/btl_vader_module.c) with the reference's
+// per-arch asm atomics (ref: opal/include/opal/sys/atomic.h:40-308)
+// replaced by C++11 std::atomic acquire/release — the layout matches
+// ompi_tpu/btl/shm.py exactly:
+//
+//   [0:8)   head  (producer cursor, monotonic bytes)
+//   [8:16)  tail  (consumer cursor, monotonic bytes)
+//   [16:)   data  (capacity ring; frames = u32-be length + payload)
+//
+// Single producer / single consumer.  The producer publishes frames
+// with a release store on head; the consumer acquires head before
+// reading and releases tail after consuming, giving the cross-process
+// happens-before the pure-Python fallback only gets from x86 TSO.
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+constexpr uint64_t kHdr = 16;
+
+inline std::atomic<uint64_t>* head_of(uint8_t* base) {
+    return reinterpret_cast<std::atomic<uint64_t>*>(base);
+}
+inline std::atomic<uint64_t>* tail_of(uint8_t* base) {
+    return reinterpret_cast<std::atomic<uint64_t>*>(base + 8);
+}
+
+inline void copy_in(uint8_t* data, uint64_t cap, uint64_t pos,
+                    const uint8_t* src, uint64_t n) {
+    uint64_t off = pos % cap;
+    uint64_t first = n < cap - off ? n : cap - off;
+    std::memcpy(data + off, src, first);
+    if (first < n) std::memcpy(data, src + first, n - first);
+}
+
+inline void copy_out(const uint8_t* data, uint64_t cap, uint64_t pos,
+                     uint8_t* dst, uint64_t n) {
+    uint64_t off = pos % cap;
+    uint64_t first = n < cap - off ? n : cap - off;
+    std::memcpy(dst, data + off, first);
+    if (first < n) std::memcpy(dst + first, data, n - first);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns 1 on success, 0 when the ring lacks space.
+int tpumpi_ring_push(uint8_t* base, uint64_t cap, const uint8_t* frame,
+                     uint64_t len) {
+    auto* head = head_of(base);
+    auto* tail = tail_of(base);
+    uint64_t h = head->load(std::memory_order_relaxed);
+    uint64_t t = tail->load(std::memory_order_acquire);
+    uint64_t need = 4 + len;
+    if (need > cap - (h - t)) return 0;
+    uint8_t hdr[4] = {static_cast<uint8_t>(len >> 24),
+                      static_cast<uint8_t>(len >> 16),
+                      static_cast<uint8_t>(len >> 8),
+                      static_cast<uint8_t>(len)};
+    uint8_t* data = base + kHdr;
+    copy_in(data, cap, h, hdr, 4);
+    copy_in(data, cap, h + 4, frame, len);
+    head->store(h + need, std::memory_order_release);
+    return 1;
+}
+
+// Returns the length of the next frame, or -1 when the ring is empty.
+// Does not consume.
+int64_t tpumpi_ring_peek(uint8_t* base, uint64_t cap) {
+    auto* head = head_of(base);
+    auto* tail = tail_of(base);
+    uint64_t h = head->load(std::memory_order_acquire);
+    uint64_t t = tail->load(std::memory_order_relaxed);
+    if (h - t < 4) return -1;
+    uint8_t hdr[4];
+    copy_out(base + kHdr, cap, t, hdr, 4);
+    uint64_t len = (uint64_t(hdr[0]) << 24) | (uint64_t(hdr[1]) << 16) |
+                   (uint64_t(hdr[2]) << 8) | uint64_t(hdr[3]);
+    if (h - t < 4 + len) return -1;  // frame still being written
+    return static_cast<int64_t>(len);
+}
+
+// Consumes the next frame into out (must hold peek() bytes).
+// Returns 1 on success, 0 if empty/incomplete.
+int tpumpi_ring_pop(uint8_t* base, uint64_t cap, uint8_t* out,
+                    uint64_t out_cap) {
+    int64_t len = tpumpi_ring_peek(base, cap);
+    if (len < 0 || static_cast<uint64_t>(len) > out_cap) return 0;
+    auto* tail = tail_of(base);
+    uint64_t t = tail->load(std::memory_order_relaxed);
+    copy_out(base + kHdr, cap, t + 4, out, static_cast<uint64_t>(len));
+    tail->store(t + 4 + static_cast<uint64_t>(len),
+                std::memory_order_release);
+    return 1;
+}
+
+uint64_t tpumpi_ring_readable(uint8_t* base) {
+    return head_of(base)->load(std::memory_order_acquire) -
+           tail_of(base)->load(std::memory_order_relaxed);
+}
+
+}  // extern "C"

@@ -24,6 +24,8 @@ from typing import Optional
 
 __version__ = "0.1.0"
 
+_finalized_once = False
+
 
 def init(device=None):
     """MPI_Init analog: bootstrap this process's rank and return
@@ -48,9 +50,11 @@ def finalize() -> None:
     from ompi_tpu.runtime import state as statemod
     from ompi_tpu.runtime.init import mpi_finalize
 
+    global _finalized_once
     st = statemod.maybe_current()
     if st is not None and st.initialized and not st.finalized:
         mpi_finalize(st)
+        _finalized_once = True
 
 
 def initialized() -> bool:
@@ -61,7 +65,6 @@ def initialized() -> bool:
 
 
 def finalized() -> bool:
-    from ompi_tpu.runtime import state as statemod
-
-    st = statemod.maybe_current()
-    return st is not None and st.finalized
+    """MPI_Finalized: True once finalize() has completed (the state
+    itself is dropped from current() at finalize, so track it here)."""
+    return _finalized_once

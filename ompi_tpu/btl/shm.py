@@ -47,7 +47,11 @@ _HDR = 16
 
 
 class Ring:
-    """One direction of a pair; producer or consumer view."""
+    """One direction of a pair; producer or consumer view.
+
+    Uses the native C++ ring (ompi_tpu.native, real acquire/release
+    atomics) when built; byte layout is identical either way, so a
+    native producer interoperates with a Python consumer."""
 
     def __init__(self, path: str, create: bool) -> None:
         self.cap = _ring_var.value
@@ -61,6 +65,33 @@ class Ring:
         os.close(fd)
         self.idx = np.frombuffer(self.mm, dtype=np.uint64, count=2)
         self.data = np.frombuffer(self.mm, dtype=np.uint8, offset=_HDR)
+        from ompi_tpu import native as _native
+        self._lib = _native.load()
+        if self._lib is not None:
+            import ctypes
+            u8p = ctypes.POINTER(ctypes.c_uint8)
+            self._cbase = ctypes.cast(
+                ctypes.addressof(ctypes.c_uint8.from_buffer(self.mm)), u8p)
+            self._u8p = u8p
+            self._ctypes = ctypes
+
+    def push_native(self, frame: bytes) -> bool:
+        ct = self._ctypes
+        buf = ct.cast(ct.c_char_p(frame), self._u8p)
+        return bool(self._lib.tpumpi_ring_push(
+            self._cbase, self.cap, buf, len(frame)))
+
+    def pop_native(self) -> Optional[bytes]:
+        ln = self._lib.tpumpi_ring_peek(self._cbase, self.cap)
+        if ln < 0:
+            return None
+        out = bytearray(ln)
+        ct = self._ctypes
+        optr = ct.cast(ct.addressof(ct.c_uint8.from_buffer(out)),
+                       self._u8p) if ln else ct.cast(0, self._u8p)
+        if not self._lib.tpumpi_ring_pop(self._cbase, self.cap, optr, ln):
+            return None
+        return bytes(out)
 
     @property
     def head(self) -> int:
@@ -74,6 +105,13 @@ class Ring:
         return self.cap - (self.head - self.tail)
 
     def push(self, frame: bytes) -> bool:
+        if 4 + len(frame) > self.cap:
+            raise ValueError(
+                f"frame of {len(frame)} bytes can never fit the "
+                f"{self.cap}-byte shm ring; lower btl_shm_max_send_size "
+                "or raise btl_shm_ring_size")
+        if self._lib is not None:
+            return self.push_native(frame)
         need = 4 + len(frame)
         if need > self.free_space():
             return False
@@ -89,6 +127,8 @@ class Ring:
         return True
 
     def pop(self) -> Optional[bytes]:
+        if self._lib is not None:
+            return self.pop_native()
         avail = self.head - self.tail
         if avail < 4:
             return None
@@ -123,6 +163,7 @@ class ShmModule(BTLModule):
         self._tx: Dict[int, Ring] = {}
         self._rx: Dict[int, Ring] = {}
         self._pending: Dict[int, deque] = {}
+        self._peer_nodes: Dict[int, int] = {}
         # create my outbound rings up front (peers attach after fence)
         for peer in range(state.size):
             if peer != self.rank:
@@ -151,9 +192,13 @@ class ShmModule(BTLModule):
         return r
 
     def reaches(self, peer: int) -> bool:
-        peer_node = self.state.rte.modex_get(peer, "node_id") \
-            if peer != self.rank else self.node
-        return peer_node == self.node
+        if peer == self.rank:
+            return True
+        node = self._peer_nodes.get(peer)
+        if node is None:
+            node = self.state.rte.modex_get(peer, "node_id")
+            self._peer_nodes[peer] = node
+        return node == self.node
 
     def send(self, peer: int, frag) -> None:
         frame = pickle.dumps(frag, protocol=pickle.HIGHEST_PROTOCOL)

@@ -38,13 +38,14 @@ _max_send_var = registry.register(
 
 
 class _Conn:
-    __slots__ = ("sock", "rxbuf", "txq", "txoff")
+    __slots__ = ("sock", "rxbuf", "txq", "txoff", "wr_registered")
 
     def __init__(self, sock: socket.socket) -> None:
         self.sock = sock
         self.rxbuf = bytearray()
         self.txq: deque = deque()
         self.txoff = 0
+        self.wr_registered = False
 
 
 class TcpModule(BTLModule):
@@ -85,7 +86,6 @@ class TcpModule(BTLModule):
         s.setblocking(False)
         conn = _Conn(s)
         self._out[peer] = conn
-        self.sel.register(s, selectors.EVENT_WRITE, ("out", conn))
         return conn
 
     def send(self, peer: int, frag) -> None:
@@ -93,6 +93,22 @@ class TcpModule(BTLModule):
         conn = self._connect(peer)
         conn.txq.append(struct.pack(">I", len(frame)) + frame)
         self._drain(conn)
+
+    def _set_wr_interest(self, conn: _Conn) -> None:
+        """Write interest only while the queue is non-empty: idle
+        sockets must not wake every progress sweep (ref: the
+        reference's event-driven send_handler registration)."""
+        want = bool(conn.txq)
+        if want and not conn.wr_registered:
+            self.sel.register(conn.sock, selectors.EVENT_WRITE,
+                              ("out", conn))
+            conn.wr_registered = True
+        elif not want and conn.wr_registered:
+            try:
+                self.sel.unregister(conn.sock)
+            except (KeyError, ValueError):
+                pass
+            conn.wr_registered = False
 
     def _drain(self, conn: _Conn) -> int:
         sent = 0
@@ -104,30 +120,32 @@ class TcpModule(BTLModule):
                 break
             except OSError:
                 conn.txq.clear()
+                conn.txoff = 0
                 break
             conn.txoff += n
             sent += n
             if conn.txoff >= len(buf):
                 conn.txq.popleft()
                 conn.txoff = 0
+        self._set_wr_interest(conn)
         return sent
 
     def _pump_rx(self, conn: _Conn) -> int:
         events = 0
+        closed = False
         try:
             while True:
                 data = conn.sock.recv(1 << 20)
                 if not data:
-                    try:
-                        self.sel.unregister(conn.sock)
-                    except (KeyError, ValueError):
-                        pass
-                    return events
+                    closed = True
+                    break
                 conn.rxbuf += data
         except (BlockingIOError, InterruptedError):
             pass
         except OSError:
-            return events
+            closed = True
+        # parse everything buffered BEFORE dropping a closed socket —
+        # the peer's final frags often arrive with the FIN
         buf = conn.rxbuf
         off = 0
         while len(buf) - off >= 4:
@@ -140,6 +158,15 @@ class TcpModule(BTLModule):
             events += 1
         if off:
             del buf[:off]
+        if closed:
+            try:
+                self.sel.unregister(conn.sock)
+            except (KeyError, ValueError):
+                pass
+            try:
+                conn.sock.close()
+            except OSError:
+                pass
         return events
 
     def progress(self) -> int:

@@ -123,10 +123,6 @@ class KVServer:
                                             msg.get("msg", ""))
                         self.cv.notify_all()
                     _send_msg(conn, {"ok": True})
-                elif op == "poll_abort":
-                    with self.cv:
-                        _send_msg(conn, {"abort": list(self.aborted)
-                                         if self.aborted else None})
         except OSError:
             return
 
@@ -139,8 +135,10 @@ class KVServer:
 
 
 class KVClient:
-    """One per rank process.  A dedicated socket per client; fence
-    uses a second socket so a blocking fence can't starve gets."""
+    """One per rank process.  Single socket, single lock: rank
+    processes are single-threaded through the rte, and every op is
+    strictly request/reply.  A second thread must NOT share this
+    client (a blocking fence would starve it on the lock)."""
 
     def __init__(self, addr: str) -> None:
         host, port = addr.rsplit(":", 1)
@@ -187,12 +185,6 @@ class KVClient:
             _send_msg(self._sock, {"op": "abort", "rank": rank,
                                    "code": code, "msg": msg})
             _recv_msg(self._sock)
-
-    def poll_abort(self):
-        with self._lock:
-            _send_msg(self._sock, {"op": "poll_abort"})
-            resp = _recv_msg(self._sock)
-        return resp.get("abort") if resp else None
 
     def close(self) -> None:
         try:
