@@ -36,10 +36,18 @@ class TypedBuf:
             self.prim = prim_set.pop()
         self.nprim = (datatype.size * count) // self.prim.itemsize
         self._copied = False
+        self._strided = False
         if (isinstance(buf, np.ndarray) and datatype.is_contiguous
                 and buf.dtype == self.prim and buf.flags.c_contiguous
                 and buf.size >= self.nprim):
             self.arr = buf.reshape(-1)[: self.nprim]
+        elif (isinstance(buf, np.ndarray) and datatype.is_contiguous
+                and buf.dtype == self.prim and buf.size >= self.nprim):
+            # strided numpy view: ravel() of a non-contiguous array is
+            # already a fresh C-order copy; flush back via buf.flat
+            self.arr = buf.ravel()[: self.nprim]
+            self._copied = True
+            self._strided = True
         else:
             conv = Convertor(datatype, count, buf)
             data = conv.pack()
@@ -50,9 +58,14 @@ class TypedBuf:
     def flush(self) -> None:
         """Write the (possibly modified) flat array back to the user
         buffer when it was materialized by copy."""
-        if self._copied and self.writable:
-            conv = Convertor(self.datatype, self.count, self.buf)
-            conv.unpack(self.arr.tobytes())
+        if not (self._copied and self.writable):
+            return
+        if self._strided:
+            # flatiter assigns through the view's striding
+            self.buf.flat[: self.nprim] = self.arr
+            return
+        conv = Convertor(self.datatype, self.count, self.buf)
+        conv.unpack(self.arr.tobytes())
 
 
 def typed(buf, count, datatype, writable=False) -> TypedBuf:

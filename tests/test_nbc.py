@@ -1,0 +1,294 @@
+"""Nonblocking collectives (coll/nbc): schedule-based i-collectives
+vs numpy references, overlap of multiple in-flight instances, and
+flush-on-completion for strided buffers (ref: libnbc test spirit —
+ompi/mca/coll/libnbc progressed schedules)."""
+
+import numpy as np
+import pytest
+
+from ompi_tpu.coll.buffers import IN_PLACE
+from ompi_tpu.op import op as mpi_op
+from ompi_tpu.pml.request import wait_all
+from ompi_tpu.testing import run_ranks
+
+SIZES = [1, 2, 3, 5, 8]
+
+
+@pytest.mark.parametrize("n", SIZES)
+def test_iallreduce(n):
+    def fn(comm):
+        x = np.arange(33, dtype=np.float64) + comm.rank
+        r = np.empty_like(x)
+        comm.Iallreduce(x, r, mpi_op.SUM).wait()
+        return r
+
+    exp = sum(np.arange(33, dtype=np.float64) + k for k in range(n))
+    for r in run_ranks(n, fn):
+        np.testing.assert_allclose(r, exp)
+
+
+@pytest.mark.parametrize("n", SIZES)
+def test_ibcast(n):
+    def fn(comm):
+        x = np.arange(16, dtype=np.int64) * 3 if comm.rank == 1 % n \
+            else np.zeros(16, dtype=np.int64)
+        comm.Ibcast(x, root=1 % n).wait()
+        return x
+
+    for r in run_ranks(n, fn):
+        np.testing.assert_array_equal(r, np.arange(16, dtype=np.int64) * 3)
+
+
+@pytest.mark.parametrize("n", SIZES)
+def test_ireduce(n):
+    def fn(comm):
+        x = np.full(7, comm.rank + 1, dtype=np.int32)
+        if comm.rank == 0:
+            r = np.empty_like(x)
+            comm.Ireduce(x, r, mpi_op.PROD, root=0).wait()
+            return r
+        comm.Ireduce(x, None, mpi_op.PROD, root=0).wait()
+        return None
+
+    res = run_ranks(n, fn)
+    exp = np.full(7, np.prod(np.arange(1, n + 1)), dtype=np.int32)
+    np.testing.assert_array_equal(res[0], exp)
+
+
+def test_ireduce_noncommutative():
+    n = 5
+
+    def fn(comm):
+        x = np.array([comm.rank], dtype=np.int64)
+        def user(inv, inout, _dt):
+            inout[:] = 10 * inv + inout
+        op = mpi_op.create(user, commute=False)
+        if comm.rank == 0:
+            r = np.empty_like(x)
+            comm.Ireduce(x, r, op, root=0).wait()
+            return r
+        comm.Ireduce(x, None, op, root=0).wait()
+        return None
+
+    res = run_ranks(n, fn)
+    # canonical order: ((((0*10+1)*10+2)*10+3)*10+4 = 1234
+    np.testing.assert_array_equal(res[0], np.array([1234]))
+
+
+@pytest.mark.parametrize("n", SIZES)
+def test_ibarrier(n):
+    def fn(comm):
+        req = comm.Ibarrier()
+        req.wait()
+        return comm.rank
+
+    assert run_ranks(n, fn) == list(range(n))
+
+
+@pytest.mark.parametrize("n", SIZES)
+def test_iallgather(n):
+    def fn(comm):
+        x = np.array([comm.rank, comm.rank * 10], dtype=np.int64)
+        r = np.empty(2 * comm.size, dtype=np.int64)
+        comm.Iallgather(x, r).wait()
+        return r
+
+    exp = np.concatenate([[k, 10 * k] for k in range(n)])
+    for r in run_ranks(n, fn):
+        np.testing.assert_array_equal(r, exp)
+
+
+def test_iallgatherv():
+    n = 4
+
+    def fn(comm):
+        cnt = comm.rank + 1
+        x = np.full(cnt, comm.rank, dtype=np.int64)
+        rcounts = [k + 1 for k in range(comm.size)]
+        displs = np.concatenate([[0], np.cumsum(rcounts)[:-1]]).tolist()
+        r = np.empty(sum(rcounts), dtype=np.int64)
+        comm.Iallgatherv(x, r, rcounts, displs).wait()
+        return r
+
+    exp = np.concatenate([np.full(k + 1, k, dtype=np.int64)
+                          for k in range(n)])
+    for r in run_ranks(n, fn):
+        np.testing.assert_array_equal(r, exp)
+
+
+@pytest.mark.parametrize("n", SIZES)
+def test_igather_iscatter(n):
+    def fn(comm):
+        x = np.array([comm.rank * 2 + 1], dtype=np.int64)
+        g = np.empty(comm.size, dtype=np.int64) if comm.rank == 0 else None
+        comm.Igather(x, g, root=0).wait()
+        s = np.empty(1, dtype=np.int64)
+        src = g * 3 if comm.rank == 0 else None
+        comm.Iscatter(src, s, root=0).wait()
+        return s
+
+    for k, r in enumerate(run_ranks(n, fn)):
+        np.testing.assert_array_equal(r, np.array([(2 * k + 1) * 3]))
+
+
+def test_iscatter_in_place():
+    """Root receives IN_PLACE: keeps its own block, only sends."""
+    n = 4
+
+    def fn(comm):
+        if comm.rank == 0:
+            src = np.arange(comm.size, dtype=np.int64) * 5
+            comm.Iscatter(src, IN_PLACE, root=0).wait()
+            return src[0]
+        r = np.empty(1, dtype=np.int64)
+        comm.Iscatter(None, r, root=0).wait()
+        return int(r[0])
+
+    assert run_ranks(n, fn) == [0, 5, 10, 15]
+
+
+@pytest.mark.parametrize("n", SIZES)
+def test_ialltoall(n):
+    def fn(comm):
+        sz = comm.size
+        x = (np.arange(sz, dtype=np.int64) + 100 * comm.rank)
+        r = np.empty(sz, dtype=np.int64)
+        comm.Ialltoall(x, r).wait()
+        return r
+
+    for k, r in enumerate(run_ranks(n, fn)):
+        exp = np.array([k + 100 * j for j in range(n)], dtype=np.int64)
+        np.testing.assert_array_equal(r, exp)
+
+
+def test_ialltoallv():
+    n = 3
+
+    def fn(comm):
+        sz = comm.size
+        scounts = [(comm.rank + j) % sz + 1 for j in range(sz)]
+        sdispls = np.concatenate([[0], np.cumsum(scounts)[:-1]]).tolist()
+        sbuf = np.concatenate(
+            [np.full(scounts[j], 10 * comm.rank + j, dtype=np.int64)
+             for j in range(sz)])
+        rcounts = [(j + comm.rank) % sz + 1 for j in range(sz)]
+        rdispls = np.concatenate([[0], np.cumsum(rcounts)[:-1]]).tolist()
+        rbuf = np.empty(sum(rcounts), dtype=np.int64)
+        comm.Ialltoallv(sbuf, scounts, sdispls, rbuf, rcounts,
+                        rdispls).wait()
+        return rbuf
+
+    res = run_ranks(n, fn)
+    for k in range(n):
+        exp = np.concatenate(
+            [np.full((j + k) % n + 1, 10 * j + k, dtype=np.int64)
+             for j in range(n)])
+        np.testing.assert_array_equal(res[k], exp)
+
+
+@pytest.mark.parametrize("n", SIZES)
+def test_ireduce_scatter_block(n):
+    def fn(comm):
+        sz = comm.size
+        x = np.arange(2 * sz, dtype=np.float64) + comm.rank
+        r = np.empty(2, dtype=np.float64)
+        comm.Ireduce_scatter_block(x, r, mpi_op.SUM).wait()
+        return r
+
+    full = sum(np.arange(2 * n, dtype=np.float64) + k for k in range(n))
+    for k, r in enumerate(run_ranks(n, fn)):
+        np.testing.assert_allclose(r, full[2 * k: 2 * k + 2])
+
+
+def test_ireduce_scatter_varying():
+    n = 4
+
+    def fn(comm):
+        rcounts = [1, 2, 3, 4][: comm.size]
+        x = np.arange(sum(rcounts), dtype=np.int64) * (comm.rank + 1)
+        r = np.empty(rcounts[comm.rank], dtype=np.int64)
+        comm.Ireduce_scatter(x, r, rcounts, mpi_op.SUM).wait()
+        return r
+
+    rcounts = [1, 2, 3, 4]
+    full = sum(np.arange(10, dtype=np.int64) * (k + 1) for k in range(n))
+    displs = [0, 1, 3, 6]
+    for k, r in enumerate(run_ranks(n, fn)):
+        np.testing.assert_array_equal(
+            r, full[displs[k]: displs[k] + rcounts[k]])
+
+
+@pytest.mark.parametrize("n", SIZES)
+def test_iscan_iexscan(n):
+    def fn(comm):
+        x = np.array([comm.rank + 1], dtype=np.int64)
+        s = np.empty(1, dtype=np.int64)
+        comm.Iscan(x, s, mpi_op.SUM).wait()
+        e = np.full(1, -1, dtype=np.int64)
+        comm.Iexscan(x, e, mpi_op.SUM).wait()
+        return int(s[0]), int(e[0])
+
+    for k, (s, e) in enumerate(run_ranks(n, fn)):
+        assert s == sum(range(1, k + 2))
+        if k > 0:
+            assert e == sum(range(1, k + 1))
+
+
+def test_overlapping_instances():
+    """Several nonblocking collectives in flight on one comm at once —
+    per-instance tags must keep them from cross-matching."""
+    n = 4
+
+    def fn(comm):
+        xs = [np.full(5, comm.rank + i, dtype=np.int64) for i in range(6)]
+        rs = [np.empty_like(x) for x in xs]
+        reqs = [comm.Iallreduce(x, r, mpi_op.SUM)
+                for x, r in zip(xs, rs)]
+        b = comm.Ibarrier()
+        wait_all(reqs + [b])
+        return rs
+
+    for rs in run_ranks(n, fn):
+        for i, r in enumerate(rs):
+            np.testing.assert_array_equal(
+                r, np.full(5, sum(k + i for k in range(n))))
+
+
+def test_overlap_with_p2p():
+    """p2p traffic interleaved with a pending nonblocking collective."""
+    n = 3
+
+    def fn(comm):
+        x = np.full(4, comm.rank, dtype=np.int64)
+        r = np.empty_like(x)
+        req = comm.Iallreduce(x, r, mpi_op.SUM)
+        peer = (comm.rank + 1) % comm.size
+        src = (comm.rank - 1 + comm.size) % comm.size
+        sb = np.array([comm.rank * 7], dtype=np.int64)
+        rb = np.empty(1, dtype=np.int64)
+        comm.Sendrecv(sb, peer, 5, rb, src, 5)
+        req.wait()
+        return r, int(rb[0])
+
+    res = run_ranks(n, fn)
+    for k, (r, v) in enumerate(res):
+        np.testing.assert_array_equal(r, np.full(4, sum(range(n))))
+        assert v == ((k - 1 + n) % n) * 7
+
+
+def test_strided_buffer_flush():
+    """Copied-out (non-contiguous) buffers must be written back when
+    the schedule completes, not at post time."""
+    n = 2
+
+    def fn(comm):
+        big = np.zeros((8, 2), dtype=np.float64)
+        col = big[:, 0]  # strided view → convertor copy path
+        x = np.arange(8, dtype=np.float64) + comm.rank
+        comm.Iallreduce(x, col, mpi_op.SUM).wait()
+        return big.copy()
+
+    for big in run_ranks(n, fn):
+        np.testing.assert_allclose(
+            big[:, 0], 2 * np.arange(8, dtype=np.float64) + 1)
+        np.testing.assert_allclose(big[:, 1], 0)
