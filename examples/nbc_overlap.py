@@ -16,7 +16,7 @@ def main() -> None:
     r = np.empty_like(x)
     req1 = comm.Iallreduce(x, r, mpi_op.SUM)
 
-    b = np.full(8, rank, dtype=np.int64) if rank == 0 \
+    b = np.full(8, 4242, dtype=np.int64) if rank == 0 \
         else np.zeros(8, dtype=np.int64)
     req2 = comm.Ibcast(b, root=0)
 
@@ -32,7 +32,7 @@ def main() -> None:
 
     exp = sum(np.arange(1000, dtype=np.float64) + k for k in range(size))
     assert np.allclose(r, exp), "Iallreduce mismatch"
-    assert (b == 0).all(), "Ibcast mismatch"
+    assert (b == 4242).all(), "Ibcast mismatch"
     assert rb[0] == src * 11, "Sendrecv mismatch"
 
     g = np.empty(size, dtype=np.int64) if rank == 0 else None

@@ -546,12 +546,18 @@ class NbcModule(CollModule):
                    rdispls, rdt):
         total = max(d + c for d, c in zip(rdispls, rcounts))
         rb = typed(rbuf, total, rdt, writable=True)
-        stotal = max(d + c for d, c in zip(sdispls, scounts))
-        sb = typed(sbuf, stotal, sdt)
-        ss = sdt.size // sb.prim.itemsize
         rs = rdt.size // rb.prim.itemsize
+        if sbuf is IN_PLACE:
+            # send data and layout come from the receive buffer
+            sarr = rb.arr.copy()
+            scounts, sdispls, ss = rcounts, rdispls, rs
+        else:
+            stotal = max(d + c for d, c in zip(sdispls, scounts))
+            sb = typed(sbuf, stotal, sdt)
+            sarr = sb.arr
+            ss = sdt.size // sb.prim.itemsize
         rounds = sched_alltoallv(
-            comm, sb.arr, [c * ss for c in scounts],
+            comm, sarr, [c * ss for c in scounts],
             [d * ss for d in sdispls], rb.arr, [c * rs for c in rcounts],
             [d * rs for d in rdispls], _nbc_tag(comm))
         return NBCRequest(comm, rounds, self._finish(rb))

@@ -1,0 +1,7 @@
+"""One-sided RMA (MPI-3 windows) — see window.py."""
+
+from .window import (LOCK_EXCLUSIVE, LOCK_SHARED, Window, allocate,
+                     create)
+
+__all__ = ["Window", "create", "allocate", "LOCK_SHARED",
+           "LOCK_EXCLUSIVE"]

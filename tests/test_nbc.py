@@ -186,6 +186,24 @@ def test_ialltoallv():
         np.testing.assert_array_equal(res[k], exp)
 
 
+def test_ialltoallv_in_place():
+    n = 3
+
+    def fn(comm):
+        sz = comm.size
+        counts = [1] * sz
+        displs = list(range(sz))
+        buf = np.array([100 * comm.rank + j for j in range(sz)],
+                       dtype=np.int64)
+        comm.Ialltoallv(IN_PLACE, None, None, buf, counts, displs).wait()
+        return buf
+
+    res = run_ranks(n, fn)
+    for k in range(n):
+        exp = np.array([100 * j + k for j in range(n)], dtype=np.int64)
+        np.testing.assert_array_equal(res[k], exp)
+
+
 @pytest.mark.parametrize("n", SIZES)
 def test_ireduce_scatter_block(n):
     def fn(comm):

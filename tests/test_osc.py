@@ -1,0 +1,349 @@
+"""One-sided RMA tests (osc): put/get/accumulate under fence,
+passive-target lock/unlock atomic counters, PSCW neighbor exchange,
+compare-and-swap, flush semantics (ref: ompi/mca/osc tests and
+MPI-3 RMA examples)."""
+
+import numpy as np
+import pytest
+
+from ompi_tpu import osc
+from ompi_tpu.op import op as mpi_op
+from ompi_tpu.testing import run_ranks
+
+SIZES = [2, 3, 4, 8]
+
+
+@pytest.mark.parametrize("n", SIZES)
+def test_put_fence(n):
+    """Each rank puts its rank id into the right neighbor's window."""
+    def fn(comm):
+        mem = np.full(4, -1, dtype=np.int64)
+        win = osc.create(comm, mem)
+        win.fence()
+        right = (comm.rank + 1) % comm.size
+        win.put(np.full(4, comm.rank, dtype=np.int64), right)
+        win.fence()
+        out = mem.copy()
+        win.free()
+        return out
+
+    res = run_ranks(n, fn)
+    for k, r in enumerate(res):
+        np.testing.assert_array_equal(r, np.full(4, (k - 1 + n) % n))
+
+
+@pytest.mark.parametrize("n", SIZES)
+def test_get_fence(n):
+    def fn(comm):
+        mem = np.arange(3, dtype=np.float64) * (comm.rank + 1)
+        win = osc.create(comm, mem)
+        win.fence()
+        left = (comm.rank - 1 + comm.size) % comm.size
+        out = np.empty(3, dtype=np.float64)
+        win.get(out, left)
+        win.fence()
+        win.free()
+        return out
+
+    res = run_ranks(n, fn)
+    for k, r in enumerate(res):
+        left = (k - 1 + n) % n
+        np.testing.assert_allclose(r, np.arange(3, dtype=np.float64)
+                                   * (left + 1))
+
+
+def test_put_disp_and_subrange(n=4):
+    """Puts at different displacements land at the right offsets."""
+    def fn(comm):
+        mem = np.zeros(comm.size, dtype=np.int64)
+        win = osc.create(comm, mem)  # disp_unit = 8 (itemsize)
+        win.fence()
+        for t in range(comm.size):
+            win.put(np.array([comm.rank + 1], dtype=np.int64), t,
+                    disp=comm.rank)
+        win.fence()
+        out = mem.copy()
+        win.free()
+        return out
+
+    res = run_ranks(n, fn)
+    exp = np.arange(1, n + 1, dtype=np.int64)
+    for r in res:
+        np.testing.assert_array_equal(r, exp)
+
+
+@pytest.mark.parametrize("n", SIZES)
+def test_accumulate_sum(n):
+    """All ranks accumulate into rank 0 under one fence epoch —
+    serial application in the AM handler makes this atomic."""
+    def fn(comm):
+        mem = np.zeros(5, dtype=np.int64)
+        win = osc.create(comm, mem)
+        win.fence()
+        win.accumulate(np.arange(5, dtype=np.int64) + comm.rank, 0,
+                       op=mpi_op.SUM)
+        win.fence()
+        out = mem.copy()
+        win.free()
+        return out
+
+    res = run_ranks(n, fn)
+    exp = sum(np.arange(5, dtype=np.int64) + k for k in range(n))
+    np.testing.assert_array_equal(res[0], exp)
+
+
+def test_lock_unlock_counter():
+    """Passive-target atomic counter: every rank increments rank 0's
+    counter under an exclusive lock; total must be exact."""
+    n = 6
+    incs = 10
+
+    def fn(comm):
+        mem = np.zeros(1, dtype=np.int64)
+        win = osc.create(comm, mem)
+        for _ in range(incs):
+            win.lock(0, osc.LOCK_EXCLUSIVE)
+            old = np.empty(1, dtype=np.int64)
+            win.get(old, 0)
+            win.put(old + 1, 0)
+            win.unlock(0)
+        # counter is complete only after everyone unlocked
+        comm.Barrier()
+        out = int(mem[0])
+        win.free()
+        return out
+
+    res = run_ranks(n, fn)
+    assert res[0] == n * incs
+
+
+def test_fetch_and_op():
+    """fetch_and_op is atomic without any user lock."""
+    n = 5
+    incs = 7
+
+    def fn(comm):
+        mem = np.zeros(1, dtype=np.int64)
+        win = osc.create(comm, mem)
+        olds = []
+        for _ in range(incs):
+            old = np.empty(1, dtype=np.int64)
+            win.fetch_and_op(1, old, 0, op=mpi_op.SUM)
+            olds.append(int(old[0]))
+        comm.Barrier()
+        out = int(mem[0])
+        win.free()
+        return out, olds
+
+    res = run_ranks(n, fn)
+    assert res[0][0] == n * incs
+    # every fetched old value must be unique (atomicity proof)
+    seen = [v for (_, olds) in res for v in olds]
+    assert len(set(seen)) == n * incs
+
+
+def test_compare_and_swap_election():
+    """Only one rank wins CAS(-1 -> rank)."""
+    n = 6
+
+    def fn(comm):
+        mem = np.full(1, -1, dtype=np.int64)
+        win = osc.create(comm, mem)
+        win.fence()
+        old = np.empty(1, dtype=np.int64)
+        win.compare_and_swap(-1, comm.rank, old, 0)
+        win.fence()
+        final = np.empty(1, dtype=np.int64)
+        win.get(final, 0)
+        win.fence()
+        out = (int(old[0]), int(final[0]))
+        win.free()
+        return out
+
+    res = run_ranks(n, fn)
+    winners = [k for k, (old, _) in enumerate(res) if old == -1]
+    assert len(winners) == 1
+    assert all(final == winners[0] for (_, final) in res)
+
+
+def test_get_accumulate():
+    n = 4
+
+    def fn(comm):
+        mem = np.full(2, 100, dtype=np.int64)
+        win = osc.create(comm, mem)
+        win.fence()
+        old = np.empty(2, dtype=np.int64)
+        win.get_accumulate(np.full(2, 1, dtype=np.int64), old, 0,
+                           op=mpi_op.SUM)
+        win.fence()
+        out = (old.copy(), mem.copy())
+        win.free()
+        return out
+
+    res = run_ranks(n, fn)
+    np.testing.assert_array_equal(res[0][1], np.full(2, 100 + n))
+    olds = sorted(int(o[0]) for (o, _) in res)
+    assert olds == [100 + k for k in range(n)]
+
+
+def test_pscw():
+    """Post/Start/Complete/Wait: even ranks expose, odd ranks write."""
+    n = 4
+
+    def fn(comm):
+        mem = np.zeros(1, dtype=np.int64)
+        win = osc.create(comm, mem)
+        if comm.rank % 2 == 0:
+            origin = comm.rank + 1
+            if origin < comm.size:
+                win.post([origin])
+                win.wait()
+            out = int(mem[0])
+        else:
+            target = comm.rank - 1
+            win.start([target])
+            win.put(np.array([comm.rank * 100], dtype=np.int64), target)
+            win.complete()
+            out = -1
+        comm.Barrier()
+        win.free()
+        return out
+
+    res = run_ranks(n, fn)
+    assert res[0] == 100
+    assert res[2] == 300
+
+
+def test_flush_passive():
+    """lock_all + put + flush makes the value visible mid-epoch."""
+    n = 3
+
+    def fn(comm):
+        mem = np.zeros(1, dtype=np.int64)
+        win = osc.create(comm, mem)
+        if comm.rank == 1:
+            win.lock(0, osc.LOCK_SHARED)
+            win.put(np.array([42], dtype=np.int64), 0)
+            win.flush(0)  # applied at target NOW
+            got = np.empty(1, dtype=np.int64)
+            win.get(got, 0)
+            win.unlock(0)
+            assert got[0] == 42
+        comm.Barrier()
+        out = int(mem[0])
+        win.free()
+        return out
+
+    res = run_ranks(n, fn)
+    assert res[0] == 42
+
+
+def test_lock_shared_concurrent_readers():
+    n = 5
+
+    def fn(comm):
+        mem = np.array([comm.rank * 3], dtype=np.int64)
+        win = osc.create(comm, mem)
+        comm.Barrier()
+        vals = []
+        for t in range(comm.size):
+            win.lock(t, osc.LOCK_SHARED)
+            v = np.empty(1, dtype=np.int64)
+            win.get(v, t)
+            win.unlock(t)
+            vals.append(int(v[0]))
+        win.free()
+        return vals
+
+    for vals in run_ranks(n, fn):
+        assert vals == [k * 3 for k in range(n)]
+
+
+def test_two_windows_independent():
+    """Traffic on two windows over the same comm must not cross."""
+    n = 3
+
+    def fn(comm):
+        m1 = np.zeros(2, dtype=np.int64)
+        m2 = np.zeros(2, dtype=np.int64)
+        w1 = osc.create(comm, m1)
+        w2 = osc.create(comm, m2)
+        w1.fence()
+        w2.fence()
+        right = (comm.rank + 1) % comm.size
+        w1.put(np.full(2, 10 + comm.rank, dtype=np.int64), right)
+        w2.put(np.full(2, 20 + comm.rank, dtype=np.int64), right)
+        w1.fence()
+        w2.fence()
+        out = (m1.copy(), m2.copy())
+        w1.free()
+        w2.free()
+        return out
+
+    res = run_ranks(n, fn)
+    for k, (a, b) in enumerate(res):
+        left = (k - 1 + n) % n
+        np.testing.assert_array_equal(a, np.full(2, 10 + left))
+        np.testing.assert_array_equal(b, np.full(2, 20 + left))
+
+
+def test_passive_then_fence_epoch():
+    """fence counting must stay correct after a passive-target epoch
+    (regression: unlock used to drop its ops from the fence counts)."""
+    n = 3
+
+    def fn(comm):
+        mem = np.zeros(1, dtype=np.int64)
+        win = osc.create(comm, mem)
+        if comm.rank == 1:
+            win.lock(0, osc.LOCK_EXCLUSIVE)
+            win.put(np.array([7], dtype=np.int64), 0)
+            win.unlock(0)
+        comm.Barrier()
+        win.fence()
+        if comm.rank == 2:
+            win.put(np.array([9], dtype=np.int64), 0)
+        win.fence()
+        out = int(mem[0])
+        win.free()
+        return out
+
+    res = run_ranks(n, fn)
+    assert res[0] == 9
+
+
+def test_zero_count_put():
+    """Zero-count RMA ops are legal no-ops and must not crash the
+    target's progress loop."""
+    n = 2
+
+    def fn(comm):
+        mem = np.full(2, 5, dtype=np.int64)
+        win = osc.create(comm, mem)
+        win.fence()
+        win.put(np.empty(0, dtype=np.int64), (comm.rank + 1) % comm.size)
+        win.fence()
+        out = mem.copy()
+        win.free()
+        return out
+
+    for r in run_ranks(n, fn):
+        np.testing.assert_array_equal(r, np.full(2, 5))
+
+
+def test_win_allocate_and_float():
+    n = 2
+
+    def fn(comm):
+        win = osc.allocate(comm, 8 * 4)
+        win.fence()
+        if comm.rank == 0:
+            win.put(np.linspace(0, 1, 4, dtype=np.float64), 1)
+        win.fence()
+        out = win.memory.view(np.float64).copy() if comm.rank == 1 else None
+        win.free()
+        return out
+
+    res = run_ranks(n, fn)
+    np.testing.assert_allclose(res[1], np.linspace(0, 1, 4))
