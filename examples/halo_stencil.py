@@ -1,0 +1,46 @@
+"""2-D Jacobi halo exchange on a cartesian process grid — the classic
+topo + neighbor-collective workload (ref: the halo/CP pattern in
+SURVEY.md §2.8; run under our mpirun):
+
+    python -m ompi_tpu.tools.mpirun -np 4 examples/halo_stencil.py
+"""
+
+import numpy as np
+
+import ompi_tpu
+from ompi_tpu.topo import dims_create
+
+
+def main() -> None:
+    world = ompi_tpu.init()
+    dims = dims_create(world.size, 2)
+    cart = world.Create_cart(dims, periods=[True, True])
+    if cart is None:
+        ompi_tpu.finalize()
+        return
+
+    n = 8  # local tile edge
+    tile = np.full((n, n), float(cart.rank), dtype=np.float64)
+
+    # neighbor_alltoall: per dim, (source-dir block, dest-dir block)
+    sbuf = np.stack([
+        tile[0],        # north edge → row-source neighbor
+        tile[-1],       # south edge → row-dest neighbor
+        tile[:, 0],     # west edge
+        tile[:, -1],    # east edge
+    ]).ravel()
+    rbuf = np.zeros_like(sbuf)
+    cart.Neighbor_alltoall(sbuf, rbuf)
+    halo = rbuf.reshape(4, n)
+
+    interior = tile[1:-1, 1:-1]
+    north, south, west, east = halo
+    mean_halo = (north.sum() + south.sum() + west.sum() + east.sum()) / (4 * n)
+    print(f"rank {cart.rank} coords {cart.Get_coords()} "
+          f"halo-mean {mean_halo:.2f} interior-mean {interior.mean():.2f}",
+          flush=True)
+    ompi_tpu.finalize()
+
+
+if __name__ == "__main__":
+    main()

@@ -150,8 +150,10 @@ class Communicator:
     # -- management operations ------------------------------------------
     def dup(self, name: str = "") -> "Communicator":
         cid = self.next_cid()
-        return Communicator(self.state, cid, Group(self.group),
-                            name or f"{self.name}-dup")
+        new = Communicator(self.state, cid, Group(self.group),
+                           name or f"{self.name}-dup")
+        new.topo = self.topo  # MPI_Comm_dup carries the topology over
+        return new
 
     def create(self, group: Group) -> Optional["Communicator"]:
         """MPI_Comm_create: collective over the parent; ranks outside
@@ -566,6 +568,126 @@ class Communicator:
     def ppermute_arr(self, x, perm):
         """perm: [(src_rank, dst_rank), ...] — mesh-neighbor shift."""
         return self.coll.ppermute_arr(self, x, perm)
+
+    # -- topologies (ompi/mca/topo analog; ompi_tpu.topo) ---------------
+    def Create_cart(self, dims, periods=None, reorder: bool = False):
+        from ompi_tpu.topo import cart_create
+        return cart_create(self, dims, periods, reorder)
+
+    def Create_graph(self, index, edges, reorder: bool = False):
+        from ompi_tpu.topo import graph_create
+        return graph_create(self, index, edges, reorder)
+
+    def Create_dist_graph_adjacent(self, sources, destinations,
+                                   sourceweights=None, destweights=None,
+                                   reorder: bool = False):
+        from ompi_tpu.topo import dist_graph_create_adjacent
+        return dist_graph_create_adjacent(self, sources, destinations,
+                                          sourceweights, destweights,
+                                          reorder)
+
+    def Topo_test(self) -> int:
+        from ompi_tpu.topo import UNDEFINED_TOPO
+        return self.topo.kind if self.topo is not None else UNDEFINED_TOPO
+
+    def _require_topo(self, kind: Optional[int] = None):
+        """MPI_ERR_TOPOLOGY guard (cart-only accessors pass kind=CART)."""
+        t = self.topo
+        if t is None or (kind is not None and t.kind != kind):
+            raise ValueError(
+                f"{self.name} has no {'cartesian ' if kind == 1 else ''}"
+                f"topology (MPI_ERR_TOPOLOGY)")
+        return t
+
+    def Get_coords(self, rank: Optional[int] = None):
+        return self._require_topo(1).rank_to_coords(
+            self.rank if rank is None else rank)
+
+    def Get_cart_rank(self, coords) -> int:
+        return self._require_topo(1).coords_to_rank(coords)
+
+    def Shift(self, dim: int, disp: int = 1):
+        """MPI_Cart_shift → (rank_source, rank_dest)."""
+        return self._require_topo(1).shift(dim, disp, self.rank)
+
+    def Sub(self, remain_dims):
+        from ompi_tpu.topo import cart_sub
+        return cart_sub(self, remain_dims)
+
+    def Get_topo(self):
+        t = self.topo
+        if t is None:
+            return None
+        if t.kind == 1:   # CART
+            return (t.dims, t.periods, t.coords)
+        if t.kind == 2:   # GRAPH
+            return (t.index, t.edges)
+        return (t.sources, t.destinations)
+
+    # -- neighbor collectives (MPI-3 §7.6) ------------------------------
+    def Neighbor_allgather(self, sspec, rspec) -> None:
+        from ompi_tpu.topo import neighbor as nb
+        sbuf, scount, sdt = self._spec(sspec)
+        rbuf, rcount, rdt = self._spec(rspec)
+        nin = max(1, len(self._require_topo().in_neighbors(self.rank)))
+        nb.neighbor_allgather(self, sbuf, scount, sdt, rbuf,
+                              rcount // nin, rdt)
+
+    def Neighbor_allgatherv(self, sspec, rspec, rcounts, displs) -> None:
+        from ompi_tpu.topo import neighbor as nb
+        sbuf, scount, sdt = self._spec(sspec)
+        rbuf, _, rdt = self._spec(rspec)
+        nb.neighbor_allgatherv(self, sbuf, scount, sdt, rbuf, rcounts,
+                               displs, rdt)
+
+    def Neighbor_alltoall(self, sspec, rspec) -> None:
+        from ompi_tpu.topo import neighbor as nb
+        sbuf, scount, sdt = self._spec(sspec)
+        rbuf, rcount, rdt = self._spec(rspec)
+        nout = max(1, len(self._require_topo().out_neighbors(self.rank)))
+        nin = max(1, len(self._require_topo().in_neighbors(self.rank)))
+        nb.neighbor_alltoall(self, sbuf, scount // nout, sdt, rbuf,
+                             rcount // nin, rdt)
+
+    def Neighbor_alltoallv(self, sspec, scounts, sdispls, rspec, rcounts,
+                           rdispls) -> None:
+        from ompi_tpu.topo import neighbor as nb
+        sbuf, _, sdt = self._spec(sspec)
+        rbuf, _, rdt = self._spec(rspec)
+        nb.neighbor_alltoallv(self, sbuf, scounts, sdispls, sdt, rbuf,
+                              rcounts, rdispls, rdt)
+
+    def Ineighbor_allgather(self, sspec, rspec):
+        from ompi_tpu.topo import neighbor as nb
+        sbuf, scount, sdt = self._spec(sspec)
+        rbuf, rcount, rdt = self._spec(rspec)
+        nin = max(1, len(self._require_topo().in_neighbors(self.rank)))
+        return nb.ineighbor_allgather(self, sbuf, scount, sdt, rbuf,
+                                      rcount // nin, rdt)
+
+    def Ineighbor_alltoall(self, sspec, rspec):
+        from ompi_tpu.topo import neighbor as nb
+        sbuf, scount, sdt = self._spec(sspec)
+        rbuf, rcount, rdt = self._spec(rspec)
+        nout = max(1, len(self._require_topo().out_neighbors(self.rank)))
+        nin = max(1, len(self._require_topo().in_neighbors(self.rank)))
+        return nb.ineighbor_alltoall(self, sbuf, scount // nout, sdt,
+                                     rbuf, rcount // nin, rdt)
+
+    def Ineighbor_alltoallv(self, sspec, scounts, sdispls, rspec, rcounts,
+                            rdispls):
+        from ompi_tpu.topo import neighbor as nb
+        sbuf, _, sdt = self._spec(sspec)
+        rbuf, _, rdt = self._spec(rspec)
+        return nb.ineighbor_alltoallv(self, sbuf, scounts, sdispls, sdt,
+                                      rbuf, rcounts, rdispls, rdt)
+
+    def shift_arr(self, x, dim: int, disp: int = 1):
+        """Cartesian whole-grid shift of a device array along `dim` —
+        lax.ppermute over the comm mesh (the TPU halo-exchange path).
+        Ranks with no source neighbor (non-periodic edge) get zeros."""
+        return self.coll.ppermute_arr(
+            self, x, self._require_topo(1).shift_perm(dim, disp, self.size))
 
     # -- management shorthands -----------------------------------------
     def Get_rank(self) -> int:

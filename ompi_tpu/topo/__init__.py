@@ -1,0 +1,20 @@
+"""Process topologies (ref: ompi/mca/topo, ompi/mpi/c/cart_*.c,
+graph_*.c, dist_graph_*.c, neighbor_*.c).
+
+Cartesian, graph, and distributed-graph topologies attached to a
+communicator (`comm.topo`, like the reference's `comm->c_topo`), and
+the MPI-3 neighbor collectives defined over them.
+
+TPU mapping (SURVEY.md §2.8): a cartesian topology over device-owning
+ranks is the halo/CP substrate — `CartTopo.shift_arr` lowers a
+dimension shift to `lax.ppermute` over the comm's device mesh, so
+neighbor exchanges ride ICI instead of host sockets.
+"""
+
+from ompi_tpu.topo.topo import (  # noqa: F401
+    CART, GRAPH, DIST_GRAPH, UNDEFINED_TOPO,
+    CartTopo, GraphTopo, DistGraphTopo,
+    dims_create, cart_create, graph_create,
+    dist_graph_create_adjacent, cart_sub,
+)
+from ompi_tpu.topo import neighbor  # noqa: F401

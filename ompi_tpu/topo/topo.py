@@ -1,0 +1,252 @@
+"""Topology objects + constructors.
+
+Re-design of ompi/mca/topo/base (ref: topo_base_cart_create.c,
+topo_base_graph_create.c, topo_base_dist_graph_create.c,
+topo_base_cart_sub.c, ompi/mpi/c/dims_create.c).  The reference's
+topo component carries per-kind state on the communicator; here a
+small Topo object hangs off ``comm.topo`` and the creation functions
+return a new communicator (dup-cid collective over the parent).
+
+`reorder` is accepted and treated as identity, like the reference's
+default `topo/basic` component (only treematch reorders); on TPU the
+useful "reorder" is mesh-alignment, which `CartTopo.shift_arr` gets
+for free by building the ppermute over the comm's own device order.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+from ompi_tpu.pml.request import PROC_NULL
+
+CART = 1
+GRAPH = 2
+DIST_GRAPH = 3
+UNDEFINED_TOPO = -32766
+
+
+def dims_create(nnodes: int, ndims: int,
+                dims: Optional[Sequence[int]] = None) -> List[int]:
+    """MPI_Dims_create: balanced factorization of nnodes over ndims
+    (ref: ompi/mpi/c/dims_create.c).  Nonzero entries in `dims` are
+    fixed constraints."""
+    out = [0] * ndims if dims is None else list(dims)
+    fixed = 1
+    for d in out:
+        if d < 0:
+            raise ValueError("dims entries must be >= 0")
+        if d:
+            fixed *= d
+    if fixed <= 0 or nnodes % fixed:
+        raise ValueError(f"cannot factor {nnodes} over fixed dims {out}")
+    rem = nnodes // fixed
+    free = [i for i, d in enumerate(out) if d == 0]
+    if not free:
+        if rem != 1:
+            raise ValueError("dims fully fixed but product != nnodes")
+        return out
+    # greedy balance: prime factors of rem, largest first, each onto
+    # the currently-smallest bucket; buckets then sorted non-increasing
+    buckets = [1] * len(free)
+    n, p = rem, 2
+    primes: List[int] = []
+    while p * p <= n:
+        while n % p == 0:
+            primes.append(p)
+            n //= p
+        p += 1
+    if n > 1:
+        primes.append(n)
+    for f in sorted(primes, reverse=True):
+        buckets[buckets.index(min(buckets))] *= f
+    buckets.sort(reverse=True)
+    for i, idx in enumerate(free):
+        out[idx] = buckets[i]
+    return out
+
+
+class CartTopo:
+    """Cartesian topology state (ref: mca_topo_base_comm_cart_2_2_0_t)."""
+
+    kind = CART
+
+    def __init__(self, dims: Sequence[int], periods: Sequence[bool],
+                 rank: int) -> None:
+        self.dims = list(dims)
+        self.periods = [bool(p) for p in periods]
+        self.ndims = len(self.dims)
+        self.coords = self.rank_to_coords(rank)
+
+    # row-major: dimension 0 most significant (MPI semantics)
+    def rank_to_coords(self, rank: int) -> List[int]:
+        coords = [0] * self.ndims
+        for d in range(self.ndims - 1, -1, -1):
+            coords[d] = rank % self.dims[d]
+            rank //= self.dims[d]
+        return coords
+
+    def coords_to_rank(self, coords: Sequence[int]) -> int:
+        rank = 0
+        for d in range(self.ndims):
+            c = coords[d]
+            if self.periods[d]:
+                c %= self.dims[d]
+            elif not (0 <= c < self.dims[d]):
+                return PROC_NULL
+            rank = rank * self.dims[d] + c
+        return rank
+
+    def shift(self, dim: int, disp: int, rank: int) -> Tuple[int, int]:
+        """MPI_Cart_shift → (rank_source, rank_dest)."""
+        coords = self.rank_to_coords(rank)
+        src = list(coords)
+        dst = list(coords)
+        src[dim] -= disp
+        dst[dim] += disp
+        return self.coords_to_rank(src), self.coords_to_rank(dst)
+
+    def neighbors(self, rank: int) -> List[int]:
+        """Neighbor sequence for neighbor collectives (MPI-3 §7.6):
+        per dimension, source-direction then dest-direction of a
+        +1 shift."""
+        out: List[int] = []
+        for d in range(self.ndims):
+            s, t = self.shift(d, 1, rank)
+            out.extend((s, t))
+        return out
+
+    # in == out for cartesian
+    def in_neighbors(self, rank: int) -> List[int]:
+        return self.neighbors(rank)
+
+    def out_neighbors(self, rank: int) -> List[int]:
+        return self.neighbors(rank)
+
+    def shift_perm(self, dim: int, disp: int, size: int):
+        """[(src, dst)] pairs for a whole-comm shift along `dim` —
+        feeds comm.ppermute_arr, i.e. lax.ppermute over the comm's
+        mesh (the TPU halo path)."""
+        perm = []
+        for r in range(size):
+            _, dst = self.shift(dim, disp, r)
+            if dst != PROC_NULL:
+                perm.append((r, dst))
+        return perm
+
+
+class GraphTopo:
+    """MPI-1 graph topology: cumulative index + flat edge list
+    (ref: topo_base_graph_create.c)."""
+
+    kind = GRAPH
+
+    def __init__(self, index: Sequence[int], edges: Sequence[int]) -> None:
+        self.index = list(index)
+        self.edges = list(edges)
+        self.nnodes = len(self.index)
+
+    def neighbors(self, rank: int) -> List[int]:
+        lo = self.index[rank - 1] if rank > 0 else 0
+        return self.edges[lo:self.index[rank]]
+
+    def in_neighbors(self, rank: int) -> List[int]:
+        return self.neighbors(rank)
+
+    def out_neighbors(self, rank: int) -> List[int]:
+        return self.neighbors(rank)
+
+
+class DistGraphTopo:
+    """MPI-2.2 distributed graph: per-rank local sources/destinations
+    (ref: topo_base_dist_graph_create.c; adjacent variant keeps the
+    lists local — no exchange needed)."""
+
+    kind = DIST_GRAPH
+
+    def __init__(self, sources: Sequence[int], destinations: Sequence[int],
+                 sourceweights=None, destweights=None) -> None:
+        self.sources = list(sources)
+        self.destinations = list(destinations)
+        self.sourceweights = list(sourceweights) if sourceweights else \
+            [1] * len(self.sources)
+        self.destweights = list(destweights) if destweights else \
+            [1] * len(self.destinations)
+
+    def in_neighbors(self, rank: int) -> List[int]:
+        return self.sources
+
+    def out_neighbors(self, rank: int) -> List[int]:
+        return self.destinations
+
+
+# ---------------------------------------------------------------------------
+# constructors (collective over the parent comm)
+# ---------------------------------------------------------------------------
+
+def cart_create(comm, dims: Sequence[int], periods=None,
+                reorder: bool = False):
+    """MPI_Cart_create: ranks >= prod(dims) get None (MPI_COMM_NULL)."""
+    dims = list(dims)
+    n = 1
+    for d in dims:
+        n *= d
+    if n > comm.size:
+        raise ValueError(f"cart size {n} exceeds comm size {comm.size}")
+    periods = [False] * len(dims) if periods is None else list(periods)
+    if len(periods) != len(dims):
+        raise ValueError(
+            f"periods length {len(periods)} != ndims {len(dims)}")
+    sub = comm.split(0 if comm.rank < n else UNDEFINED_TOPO, comm.rank)
+    if sub is None:
+        return None
+    sub.topo = CartTopo(dims, periods, sub.rank)
+    sub.name = f"cart{tuple(dims)}-{sub.cid}"
+    return sub
+
+
+def graph_create(comm, index: Sequence[int], edges: Sequence[int],
+                 reorder: bool = False):
+    """MPI_Graph_create: nnodes = len(index) participating ranks."""
+    n = len(index)
+    if n > comm.size:
+        raise ValueError("graph larger than communicator")
+    sub = comm.split(0 if comm.rank < n else UNDEFINED_TOPO, comm.rank)
+    if sub is None:
+        return None
+    sub.topo = GraphTopo(index, edges)
+    return sub
+
+
+def dist_graph_create_adjacent(comm, sources, destinations,
+                               sourceweights=None, destweights=None,
+                               reorder: bool = False):
+    """MPI_Dist_graph_create_adjacent: every rank participates; the
+    adjacency is purely local so only a dup is collective."""
+    sub = comm.dup()
+    sub.topo = DistGraphTopo(sources, destinations, sourceweights,
+                             destweights)
+    return sub
+
+
+def cart_sub(comm, remain_dims: Sequence[bool]):
+    """MPI_Cart_sub: slice the grid, keeping `remain_dims` axes
+    (ref: topo_base_cart_sub.c).  Collective over the cart comm."""
+    topo = comm.topo
+    if topo is None or topo.kind != CART:
+        raise ValueError("cart_sub on a non-cartesian communicator")
+    keep = [bool(k) for k in remain_dims]
+    if len(keep) != topo.ndims:
+        raise ValueError("remain_dims length mismatch")
+    # color = coordinates of dropped dims; key = rank (keeps row-major
+    # order of kept dims within each slice)
+    color = 0
+    for d in range(topo.ndims):
+        if not keep[d]:
+            color = color * topo.dims[d] + topo.coords[d]
+    sub = comm.split(color, comm.rank)
+    new_dims = [topo.dims[d] for d in range(topo.ndims) if keep[d]]
+    new_periods = [topo.periods[d] for d in range(topo.ndims) if keep[d]]
+    if not new_dims:
+        new_dims, new_periods = [1], [False]
+    sub.topo = CartTopo(new_dims, new_periods, sub.rank)
+    return sub
