@@ -625,13 +625,26 @@ class Communicator:
         return (t.sources, t.destinations)
 
     # -- neighbor collectives (MPI-3 §7.6) ------------------------------
+    @staticmethod
+    def _nbr_block(total: int, nbrs: int, what: str) -> int:
+        """Per-neighbor block count; a buffer that doesn't divide
+        evenly is a count mismatch, not a silent truncation."""
+        if nbrs == 0:
+            return 0
+        if total % nbrs:
+            raise ValueError(
+                f"{what} buffer of {total} elements not divisible by "
+                f"{nbrs} neighbors (MPI_ERR_COUNT)")
+        return total // nbrs
+
     def Neighbor_allgather(self, sspec, rspec) -> None:
         from ompi_tpu.topo import neighbor as nb
         sbuf, scount, sdt = self._spec(sspec)
         rbuf, rcount, rdt = self._spec(rspec)
-        nin = max(1, len(self._require_topo().in_neighbors(self.rank)))
+        topo = self._require_topo()
+        nin = len(topo.in_neighbors(self.rank))
         nb.neighbor_allgather(self, sbuf, scount, sdt, rbuf,
-                              rcount // nin, rdt)
+                              self._nbr_block(rcount, nin, "recv"), rdt)
 
     def Neighbor_allgatherv(self, sspec, rspec, rcounts, displs) -> None:
         from ompi_tpu.topo import neighbor as nb
@@ -644,10 +657,13 @@ class Communicator:
         from ompi_tpu.topo import neighbor as nb
         sbuf, scount, sdt = self._spec(sspec)
         rbuf, rcount, rdt = self._spec(rspec)
-        nout = max(1, len(self._require_topo().out_neighbors(self.rank)))
-        nin = max(1, len(self._require_topo().in_neighbors(self.rank)))
-        nb.neighbor_alltoall(self, sbuf, scount // nout, sdt, rbuf,
-                             rcount // nin, rdt)
+        topo = self._require_topo()
+        nout = len(topo.out_neighbors(self.rank))
+        nin = len(topo.in_neighbors(self.rank))
+        nb.neighbor_alltoall(self, sbuf,
+                             self._nbr_block(scount, nout, "send"), sdt,
+                             rbuf, self._nbr_block(rcount, nin, "recv"),
+                             rdt)
 
     def Neighbor_alltoallv(self, sspec, scounts, sdispls, rspec, rcounts,
                            rdispls) -> None:
@@ -661,18 +677,21 @@ class Communicator:
         from ompi_tpu.topo import neighbor as nb
         sbuf, scount, sdt = self._spec(sspec)
         rbuf, rcount, rdt = self._spec(rspec)
-        nin = max(1, len(self._require_topo().in_neighbors(self.rank)))
-        return nb.ineighbor_allgather(self, sbuf, scount, sdt, rbuf,
-                                      rcount // nin, rdt)
+        nin = len(self._require_topo().in_neighbors(self.rank))
+        return nb.ineighbor_allgather(
+            self, sbuf, scount, sdt, rbuf,
+            self._nbr_block(rcount, nin, "recv"), rdt)
 
     def Ineighbor_alltoall(self, sspec, rspec):
         from ompi_tpu.topo import neighbor as nb
         sbuf, scount, sdt = self._spec(sspec)
         rbuf, rcount, rdt = self._spec(rspec)
-        nout = max(1, len(self._require_topo().out_neighbors(self.rank)))
-        nin = max(1, len(self._require_topo().in_neighbors(self.rank)))
-        return nb.ineighbor_alltoall(self, sbuf, scount // nout, sdt,
-                                     rbuf, rcount // nin, rdt)
+        topo = self._require_topo()
+        nout = len(topo.out_neighbors(self.rank))
+        nin = len(topo.in_neighbors(self.rank))
+        return nb.ineighbor_alltoall(
+            self, sbuf, self._nbr_block(scount, nout, "send"), sdt,
+            rbuf, self._nbr_block(rcount, nin, "recv"), rdt)
 
     def Ineighbor_alltoallv(self, sspec, scounts, sdispls, rspec, rcounts,
                             rdispls):

@@ -209,6 +209,14 @@ class VarRegistry:
     def all_vars(self) -> List[Var]:
         return sorted(self._vars.values(), key=lambda v: v.full_name)
 
+    def vars_in_registration_order(self) -> List[Var]:
+        """Stable enumeration for MPI_T: indices never shift because
+        later registrations only append (dict preserves insertion)."""
+        return list(self._vars.values())
+
+    def pvars_in_registration_order(self) -> List[PVar]:
+        return list(self._pvars.values())
+
     def refresh(self) -> None:
         """Re-resolve every variable (e.g. after env changes in tests)."""
         with self._lock:

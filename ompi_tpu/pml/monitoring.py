@@ -1,0 +1,184 @@
+"""pml/monitoring: interposition layer counting traffic per peer.
+
+Re-design of ompi/mca/pml/monitoring (ref: pml_monitoring.h:26-41 —
+a pml component that layers itself over the real pml and counts
+messages/bytes per destination, splitting user traffic from internal
+"filtered" traffic by tag sign; results surface as MPI_T pvars and a
+dumpable traffic matrix, cf. test/monitoring/monitoring_prof.c +
+profile2mat.pl).
+
+Enable with ``--mca pml_monitoring_enable 1`` (or programmatically via
+``registry.set``); mpi_init then wraps the selected pml engine.  The
+wrapper delegates everything it doesn't instrument, so ob1 internals
+(matching, rndv, progress) are untouched — interposition, not
+modification, exactly like the reference.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from ompi_tpu.mca.params import registry
+from ompi_tpu.pml.request import ANY_TAG
+
+enable_var = registry.register(
+    "pml", "monitoring", "enable", False, bool,
+    help="Interpose the monitoring layer over the selected pml and "
+         "count per-peer messages/bytes (user vs internal traffic)")
+
+
+def _internal(tag: int) -> bool:
+    """Internal traffic posts exact negative tags; ANY_TAG (-1) is a
+    user-side wildcard, never an internal tag."""
+    return tag < 0 and tag != ANY_TAG
+
+
+class _Matrix:
+    """Per-peer counters: messages and bytes, user vs internal."""
+
+    def __init__(self, size: int) -> None:
+        self.msgs = [0] * size
+        self.bytes = [0] * size
+        self.filtered_msgs = [0] * size
+        self.filtered_bytes = [0] * size
+
+    def count(self, peer: int, nbytes: int, internal: bool) -> None:
+        if internal:
+            self.filtered_msgs[peer] += 1
+            self.filtered_bytes[peer] += nbytes
+        else:
+            self.msgs[peer] += 1
+            self.bytes[peer] += nbytes
+
+
+def _current_monitor() -> Optional["MonitoringPml"]:
+    """The calling thread-rank's monitoring layer, if interposed.
+    Pvar getters resolve through here so the process-global registry
+    serves every rank (each thread-rank reads ITS matrix)."""
+    from ompi_tpu.runtime import state as statemod
+    st = statemod.maybe_current()
+    pml = getattr(st, "pml", None) if st is not None else None
+    return pml if isinstance(pml, MonitoringPml) else None
+
+
+def _row(attr_outer: str, attr_inner: str):
+    def getter():
+        mon = _current_monitor()
+        if mon is None:
+            return []
+        return list(getattr(getattr(mon, attr_outer), attr_inner))
+    return getter
+
+
+# pvars registered once at import (ref: the reference registers its
+# pvars at component init; values resolve per-rank at read time)
+registry.register_pvar("pml", "monitoring", "messages_count",
+                       "Messages sent per peer (user traffic)",
+                       "size", getter=_row("sent", "msgs"))
+registry.register_pvar("pml", "monitoring", "messages_size",
+                       "Bytes sent per peer (user traffic)",
+                       "size", getter=_row("sent", "bytes"))
+registry.register_pvar("pml", "monitoring", "filtered_count",
+                       "Internal (tag<0) messages sent per peer",
+                       "size", getter=_row("sent", "filtered_msgs"))
+registry.register_pvar("pml", "monitoring", "filtered_size",
+                       "Internal (tag<0) bytes sent per peer",
+                       "size", getter=_row("sent", "filtered_bytes"))
+
+
+class MonitoringPml:
+    """Wraps the real pml; counts on the send and receive paths."""
+
+    def __init__(self, pml, state) -> None:
+        self._pml = pml
+        self._state = state
+        self.sent = _Matrix(state.size)
+        self.recvd = _Matrix(state.size)
+        # per-instance: each thread-rank only mutates its own matrix
+        self._lock = threading.Lock()
+
+    # -- instrumented entry points --------------------------------------
+    def _peer_global(self, comm, peer: int) -> Optional[int]:
+        if peer is None or peer < 0 or peer >= comm.size:
+            return None
+        return comm.group[peer]
+
+    def _count_send(self, comm, dst, count, datatype, tag) -> None:
+        g = self._peer_global(comm, dst)
+        if g is None:
+            return
+        with self._lock:
+            self.sent.count(g, count * datatype.size, _internal(tag))
+
+    def _count_recv_status(self, comm, status) -> None:
+        if status is None or status.source is None or status.source < 0:
+            return
+        g = self._peer_global(comm, status.source)
+        if g is None:
+            return
+        with self._lock:
+            self.recvd.count(g, status.count, _internal(status.tag))
+
+    def send(self, buf, count, datatype, dst, tag, comm, *a, **kw):
+        self._count_send(comm, dst, count, datatype, tag)
+        return self._pml.send(buf, count, datatype, dst, tag, comm,
+                              *a, **kw)
+
+    def isend(self, buf, count, datatype, dst, tag, comm, *a, **kw):
+        self._count_send(comm, dst, count, datatype, tag)
+        return self._pml.isend(buf, count, datatype, dst, tag, comm,
+                               *a, **kw)
+
+    def recv(self, buf, count, datatype, src, tag, comm, *a, **kw):
+        st = self._pml.recv(buf, count, datatype, src, tag, comm, *a, **kw)
+        self._count_recv_status(comm, st)
+        return st
+
+    # irecv completion is asynchronous; count at post time with the
+    # posted size (upper bound), like the reference counts at the pml
+    # entry rather than at completion
+    def irecv(self, buf, count, datatype, src, tag, comm, *a, **kw):
+        req = self._pml.irecv(buf, count, datatype, src, tag, comm,
+                              *a, **kw)
+        g = self._peer_global(comm, src) if src is not None and src >= 0 \
+            else None
+        if g is not None:
+            with self._lock:
+                self.recvd.count(g, count * datatype.size, _internal(tag))
+        return req
+
+    # everything else passes straight through (probe/improbe/mrecv/
+    # add_procs/progress/state_comm_peer/cancel...)
+    def __getattr__(self, name):
+        return getattr(self._pml, name)
+
+    # -- reporting -------------------------------------------------------
+    def matrix_rows(self) -> Dict[str, List[int]]:
+        with self._lock:
+            return {
+                "sent_msgs": list(self.sent.msgs),
+                "sent_bytes": list(self.sent.bytes),
+                "sent_filtered_msgs": list(self.sent.filtered_msgs),
+                "sent_filtered_bytes": list(self.sent.filtered_bytes),
+                "recv_msgs": list(self.recvd.msgs),
+                "recv_bytes": list(self.recvd.bytes),
+            }
+
+    def dump(self, path: str) -> None:
+        """One 'src dst msgs bytes' line per nonzero peer (the
+        profile2mat.pl input format)."""
+        me = self._state.rank
+        with open(path, "w") as fh:
+            for peer in range(self._state.size):
+                if self.sent.msgs[peer] or self.sent.bytes[peer]:
+                    fh.write(f"{me} {peer} {self.sent.msgs[peer]} "
+                             f"{self.sent.bytes[peer]}\n")
+
+
+def maybe_wrap(pml, state):
+    """Called from mpi_init after pml selection (the reference winning
+    component interposes the same way at init)."""
+    if registry.lookup("pml", "monitoring", "enable", False):
+        return MonitoringPml(pml, state)
+    return pml

@@ -17,15 +17,17 @@ from ompi_tpu.btl import shm as _btl_shm  # noqa: F401
 from ompi_tpu.btl import tcp as _btl_tcp  # noqa: F401
 from ompi_tpu.comm.communicator import Communicator, Group
 from ompi_tpu.pml import ob1 as _pml_ob1
+from ompi_tpu.pml import monitoring as _pml_monitoring
 from .state import ProcState, clear_current, set_current
 
 
 def mpi_init(state: ProcState, device=None) -> ProcState:
     set_current(state)
     state.device = device
-    # 1. select the single pml engine (ref: ompi_mpi_init.c:640)
+    # 1. select the single pml engine (ref: ompi_mpi_init.c:640),
+    # optionally interposed by pml/monitoring
     comp, pml_cls = _pml_ob1.pml_framework.select_one(state)
-    state.pml = pml_cls(state)
+    state.pml = _pml_monitoring.maybe_wrap(pml_cls(state), state)
     # 2. btl modules + endpoint wiring (modex happens inside init)
     modules = []
     for c in btl_base.btl_framework.components():

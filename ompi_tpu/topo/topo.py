@@ -75,10 +75,18 @@ class CartTopo:
         self.dims = list(dims)
         self.periods = [bool(p) for p in periods]
         self.ndims = len(self.dims)
+        self.nnodes = 1
+        for d in self.dims:
+            self.nnodes *= d
         self.coords = self.rank_to_coords(rank)
+        self._nbr_cache: dict = {}
 
     # row-major: dimension 0 most significant (MPI semantics)
     def rank_to_coords(self, rank: int) -> List[int]:
+        if not 0 <= rank < self.nnodes:
+            raise ValueError(
+                f"rank {rank} outside cartesian grid of {self.nnodes} "
+                f"(MPI_ERR_RANK)")
         coords = [0] * self.ndims
         for d in range(self.ndims - 1, -1, -1):
             coords[d] = rank % self.dims[d]
@@ -108,11 +116,16 @@ class CartTopo:
     def neighbors(self, rank: int) -> List[int]:
         """Neighbor sequence for neighbor collectives (MPI-3 §7.6):
         per dimension, source-direction then dest-direction of a
-        +1 shift."""
+        +1 shift.  Cached — the topology is immutable and this is the
+        halo-exchange hot path."""
+        cached = self._nbr_cache.get(rank)
+        if cached is not None:
+            return cached
         out: List[int] = []
         for d in range(self.ndims):
             s, t = self.shift(d, 1, rank)
             out.extend((s, t))
+        self._nbr_cache[rank] = out
         return out
 
     # in == out for cartesian

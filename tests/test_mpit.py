@@ -1,0 +1,215 @@
+"""MPI_T surface, pml/monitoring interposition, info tool (ref:
+ompi/mpi/tool, ompi/mca/pml/monitoring + test/monitoring/)."""
+
+import numpy as np
+import pytest
+
+import ompi_tpu.mpit as mpit
+from ompi_tpu.mca.params import registry
+from ompi_tpu.testing import run_ranks
+
+
+@pytest.fixture
+def mpit_session():
+    mpit.init_thread()
+    yield
+    mpit.finalize()
+
+
+def test_mpit_requires_init():
+    with pytest.raises(mpit.MpitError):
+        mpit.cvar_get_num()
+
+
+def test_cvar_enumeration_and_handles(mpit_session):
+    n = mpit.cvar_get_num()
+    assert n > 0
+    info = mpit.cvar_get_info(0)
+    assert {"name", "help", "type", "level", "scope"} <= set(info)
+    idx = mpit.cvar_get_index(info["name"])
+    assert idx == 0
+    with pytest.raises(mpit.MpitError):
+        mpit.cvar_get_info(n + 1000)
+    with pytest.raises(mpit.MpitError):
+        mpit.cvar_get_index("no_such_variable_xyz")
+
+
+def test_cvar_write_roundtrip(mpit_session):
+    registry.register("mpitest", "demo", "knob", 7, int, help="test knob")
+    h = mpit.cvar_handle_alloc("mpitest_demo_knob")
+    assert mpit.cvar_read(h) == 7
+    mpit.cvar_write(h, 13)
+    assert mpit.cvar_read(h) == 13
+    assert registry.get("mpitest_demo_knob") == 13
+
+
+def test_categories_cover_frameworks(mpit_session):
+    import ompi_tpu.coll  # ensure frameworks registered  # noqa: F401
+    n = mpit.category_get_num()
+    names = [mpit.category_get_info(i)["name"] for i in range(n)]
+    assert "coll" in names and "pml" in names
+
+
+def test_monitoring_counts_traffic():
+    registry.set("pml_monitoring_enable", True)
+    try:
+        def fn(comm):
+            x = np.arange(64, dtype=np.float64)
+            r = np.empty_like(x)
+            if comm.rank == 0:
+                comm.Send(x, dest=1, tag=5)
+            elif comm.rank == 1:
+                comm.Recv(r, source=0, tag=5)
+            comm.Barrier()
+            return comm.state.pml.matrix_rows()
+
+        rows = run_ranks(2, fn)
+        # rank0 sent one user message of 512 bytes to peer 1
+        assert rows[0]["sent_msgs"][1] == 1
+        assert rows[0]["sent_bytes"][1] == 512
+        # barrier traffic is internal (tag < 0) → filtered
+        assert rows[0]["sent_filtered_msgs"][1] >= 1
+        # rank1 received the user payload
+        assert rows[1]["recv_bytes"][0] >= 512
+        # user and internal streams kept separate
+        assert rows[0]["sent_msgs"][0] == 0
+    finally:
+        registry.set("pml_monitoring_enable", False)
+
+
+def test_monitoring_pvar_session_delta():
+    registry.set("pml_monitoring_enable", True)
+    try:
+        def fn(comm):
+            mpit.init_thread()
+            s = mpit.pvar_session_create()
+            h = mpit.pvar_handle_alloc(s, "pml_monitoring_messages_size")
+            base = mpit.pvar_read(h)
+            mpit.pvar_reset(h)
+            if comm.rank == 0:
+                comm.Send(np.zeros(32, dtype=np.float64), dest=1, tag=0)
+            else:
+                r = np.empty(32, dtype=np.float64)
+                comm.Recv(r, source=0, tag=0)
+            delta = mpit.pvar_read(h)
+            mpit.finalize()
+            return (base, delta)
+
+        res = run_ranks(2, fn)
+        base0, delta0 = res[0]
+        assert delta0[1] == 256      # bytes to peer 1 since reset
+        _, delta1 = res[1]
+        assert delta1 == [0, 0]      # rank1 sent nothing
+    finally:
+        registry.set("pml_monitoring_enable", False)
+
+
+def test_monitoring_dump(tmp_path):
+    registry.set("pml_monitoring_enable", True)
+    try:
+        def fn(comm):
+            if comm.rank == 0:
+                comm.Send(np.zeros(8, dtype=np.int64), dest=1, tag=0)
+            else:
+                comm.Recv(np.empty(8, dtype=np.int64), source=0, tag=0)
+            path = str(tmp_path / f"prof.{comm.rank}")
+            comm.state.pml.dump(path)
+            return path
+
+        paths = run_ranks(2, fn)
+        lines = open(paths[0]).read().strip().splitlines()
+        assert lines == ["0 1 1 64"]
+    finally:
+        registry.set("pml_monitoring_enable", False)
+
+
+def test_monitoring_disabled_no_wrap():
+    def fn(comm):
+        return hasattr(comm.state.pml, "matrix_rows")
+
+    assert run_ranks(2, fn) == [False, False]
+
+
+def test_pvar_stop_freezes_value(mpit_session):
+    registry.set("pml_monitoring_enable", True)
+    try:
+        def fn(comm):
+            s = mpit.pvar_session_create()
+            h = mpit.pvar_handle_alloc(s, "pml_monitoring_messages_count")
+            if comm.rank == 0:
+                comm.Send(np.zeros(4, dtype=np.int64), dest=1, tag=0)
+                mpit.pvar_stop(h)
+                frozen = mpit.pvar_read(h)
+                comm.Send(np.zeros(4, dtype=np.int64), dest=1, tag=0)
+                still = mpit.pvar_read(h)
+                mpit.pvar_start(h)
+                live = mpit.pvar_read(h)
+                return (frozen, still, live)
+            comm.Recv(np.empty(4, dtype=np.int64), source=0, tag=0)
+            comm.Recv(np.empty(4, dtype=np.int64), source=0, tag=0)
+            return None
+
+        frozen, still, live = run_ranks(2, fn)[0]
+        assert frozen[1] == 1 and still[1] == 1   # frozen at stop
+        assert live[1] == 2                        # live again
+    finally:
+        registry.set("pml_monitoring_enable", False)
+
+
+def test_cvar_index_stable_across_new_registrations(mpit_session):
+    idx = mpit.cvar_get_index("pml_monitoring_enable")
+    # an alphabetically-earlier registration must NOT shift indices
+    registry.register("aaa", "zzz", "newvar", 1, int)
+    assert mpit.cvar_get_index("pml_monitoring_enable") == idx
+    assert mpit.cvar_get_info(idx)["name"] == "pml_monitoring_enable"
+
+
+def test_monitoring_anytag_irecv_counts_as_user():
+    registry.set("pml_monitoring_enable", True)
+    try:
+        def fn(comm):
+            if comm.rank == 0:
+                comm.Send(np.zeros(16, dtype=np.float64), dest=1, tag=9)
+                return None
+            r = np.empty(16, dtype=np.float64)
+            comm.Irecv(r, source=0).wait()   # default tag = ANY_TAG
+            return comm.state.pml.matrix_rows()
+
+        rows = run_ranks(2, fn)[1]
+        assert rows["recv_bytes"][0] == 128   # user, not filtered
+    finally:
+        registry.set("pml_monitoring_enable", False)
+
+
+def test_neighbor_buffer_divisibility_error():
+    def fn(comm):
+        cart = comm.Create_cart([3], periods=[True])
+        try:
+            cart.Neighbor_allgather(np.zeros(1), np.zeros(5))
+            return "no-error"
+        except ValueError:
+            return "ok"
+
+    assert run_ranks(3, fn) == ["ok"] * 3
+
+
+def test_cart_coords_invalid_rank_raises():
+    def fn(comm):
+        cart = comm.Create_cart([2, 2])
+        try:
+            cart.Get_coords(7)
+            return "no-error"
+        except ValueError:
+            return "ok"
+
+    assert run_ranks(4, fn) == ["ok"] * 4
+
+
+def test_info_tool_output(capsys):
+    from ompi_tpu.tools import info
+    assert info.main([]) == 0
+    out = capsys.readouterr().out
+    assert "Components:" in out and "coll" in out
+    assert info.main(["--param", "all", "all", "--parsable"]) == 0
+    out = capsys.readouterr().out
+    assert "mca:" in out and ":param:" in out and ":source:" in out
