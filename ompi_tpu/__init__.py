@@ -57,6 +57,22 @@ def finalize() -> None:
         _finalized_once = True
 
 
+def attach_buffer(size_or_buf) -> None:
+    """MPI_Buffer_attach for this rank (Bsend backing store)."""
+    from ompi_tpu.pml.persistent import attach_buffer as _attach
+    from ompi_tpu.runtime import state as statemod
+
+    _attach(statemod.current(), size_or_buf)
+
+
+def detach_buffer() -> int:
+    """MPI_Buffer_detach: drains pending buffered sends."""
+    from ompi_tpu.pml.persistent import detach_buffer as _detach
+    from ompi_tpu.runtime import state as statemod
+
+    return _detach(statemod.current())
+
+
 def initialized() -> bool:
     from ompi_tpu.runtime import state as statemod
 

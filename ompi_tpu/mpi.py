@@ -1,0 +1,485 @@
+"""Flat MPI_* surface: C-binding-shaped names over the object API.
+
+The analog of ompi/mpi/c's 385 one-function files (ref:
+ompi/mpi/c/send.c:78, allreduce.c:110 — arg checking + handle
+translation + dispatch): each MPI_* function here translates to the
+corresponding Communicator/Window method.  Predefined handles
+(datatypes, ops, constants) are re-exported under their MPI names so
+a reference user can port code token-for-token:
+
+    from ompi_tpu import mpi as MPI
+    MPI.MPI_Init()
+    rank = MPI.MPI_Comm_rank(MPI.MPI_COMM_WORLD())
+    MPI.MPI_Send(buf, 4, MPI.MPI_DOUBLE, 1, 0, comm)
+
+Like PMPI in the reference (ompi/mpi/c/init.c:35-37 weak symbols),
+every MPI_* name has a PMPI_* alias created at import time, so
+profiling interposers can wrap MPI_* while calling through PMPI_*.
+"""
+
+from __future__ import annotations
+
+import sys as _sys
+from typing import List, Optional
+
+import ompi_tpu as _top
+from ompi_tpu.datatype.engine import (  # noqa: F401
+    BYTE as MPI_BYTE, PACKED as MPI_PACKED, CHAR as MPI_CHAR,
+    SHORT as MPI_SHORT, INT as MPI_INT, LONG as MPI_LONG,
+    LONG_LONG as MPI_LONG_LONG, UNSIGNED as MPI_UNSIGNED,
+    UNSIGNED_LONG as MPI_UNSIGNED_LONG, INT8_T as MPI_INT8_T,
+    INT16_T as MPI_INT16_T, INT32_T as MPI_INT32_T,
+    INT64_T as MPI_INT64_T, UINT8_T as MPI_UINT8_T,
+    UINT16_T as MPI_UINT16_T, UINT32_T as MPI_UINT32_T,
+    UINT64_T as MPI_UINT64_T, FLOAT as MPI_FLOAT, DOUBLE as MPI_DOUBLE,
+    C_BOOL as MPI_C_BOOL, C_FLOAT_COMPLEX as MPI_C_FLOAT_COMPLEX,
+    C_DOUBLE_COMPLEX as MPI_C_DOUBLE_COMPLEX, AINT as MPI_AINT,
+    OFFSET as MPI_OFFSET, COUNT as MPI_COUNT,
+    FLOAT_INT as MPI_FLOAT_INT, DOUBLE_INT as MPI_DOUBLE_INT,
+    LONG_INT as MPI_LONG_INT,
+    contiguous as MPI_Type_contiguous, vector as MPI_Type_vector,
+    indexed as MPI_Type_indexed, struct as MPI_Type_create_struct,
+)
+from ompi_tpu.op.op import (  # noqa: F401
+    MAX as MPI_MAX, MIN as MPI_MIN, SUM as MPI_SUM, PROD as MPI_PROD,
+    LAND as MPI_LAND, BAND as MPI_BAND, LOR as MPI_LOR, BOR as MPI_BOR,
+    LXOR as MPI_LXOR, BXOR as MPI_BXOR, MAXLOC as MPI_MAXLOC,
+    MINLOC as MPI_MINLOC, REPLACE as MPI_REPLACE, NO_OP as MPI_NO_OP,
+)
+from ompi_tpu.coll.buffers import IN_PLACE as MPI_IN_PLACE  # noqa: F401
+from ompi_tpu.pml.request import (  # noqa: F401
+    ANY_SOURCE as MPI_ANY_SOURCE, ANY_TAG as MPI_ANY_TAG,
+    PROC_NULL as MPI_PROC_NULL, SUCCESS as MPI_SUCCESS,
+    Status, wait_all, wait_any, wait_some, test_all,
+)
+from ompi_tpu.comm.communicator import (  # noqa: F401
+    COMM_TYPE_SHARED as MPI_COMM_TYPE_SHARED, UNDEFINED as MPI_UNDEFINED,
+    Communicator, Group,
+)
+
+MPI_COMM_NULL = None
+MPI_STATUS_IGNORE = None
+
+
+# -- environment ------------------------------------------------------------
+
+def MPI_Init(args=None):
+    return _top.init()
+
+
+def MPI_Finalize():
+    _top.finalize()
+
+
+def MPI_Initialized() -> bool:
+    return _top.initialized()
+
+
+def MPI_Finalized() -> bool:
+    return _top.finalized()
+
+
+def MPI_COMM_WORLD() -> Communicator:
+    from ompi_tpu.runtime import state as _st
+    return _st.current().comm_world
+
+
+def MPI_COMM_SELF() -> Communicator:
+    from ompi_tpu.runtime import state as _st
+    return _st.current().comm_self
+
+
+def MPI_Abort(comm, errorcode: int = 1):
+    comm.abort(errorcode)
+
+
+def MPI_Wtime() -> float:
+    import time
+    return time.monotonic()
+
+
+def MPI_Get_processor_name() -> str:
+    import socket
+    return socket.gethostname()
+
+
+# -- communicator management ------------------------------------------------
+
+def MPI_Comm_rank(comm) -> int:
+    return comm.rank
+
+
+def MPI_Comm_size(comm) -> int:
+    return comm.size
+
+
+def MPI_Comm_dup(comm):
+    return comm.dup()
+
+
+def MPI_Comm_split(comm, color, key=0):
+    return comm.split(color, key)
+
+
+def MPI_Comm_split_type(comm, split_type, key=0):
+    return comm.split_type(split_type, key)
+
+
+def MPI_Comm_create(comm, group):
+    return comm.create(group)
+
+
+def MPI_Comm_free(comm):
+    comm.free()
+
+
+def MPI_Comm_group(comm):
+    return comm.group_obj()
+
+
+def MPI_Comm_compare(a, b) -> str:
+    if a is b:
+        return "ident"
+    if a.group == b.group:
+        return "congruent" if a.rank == b.rank else "similar"
+    return "unequal"
+
+
+def MPI_Group_size(group) -> int:
+    return group.size
+
+
+def MPI_Group_rank(group) -> int:
+    from ompi_tpu.runtime import state as _st
+    return group.rank_of(_st.current().rank)
+
+
+def MPI_Group_incl(group, ranks):
+    return group.incl(ranks)
+
+
+def MPI_Group_excl(group, ranks):
+    return group.excl(ranks)
+
+
+def MPI_Group_union(a, b):
+    return a.union(b)
+
+
+def MPI_Group_intersection(a, b):
+    return a.intersection(b)
+
+
+def MPI_Group_difference(a, b):
+    return a.difference(b)
+
+
+def MPI_Group_translate_ranks(a, ranks, b) -> List[int]:
+    return [a.translate(b, r) for r in ranks]
+
+
+# -- point-to-point ---------------------------------------------------------
+
+def MPI_Send(buf, count, datatype, dest, tag, comm):
+    comm.Send((buf, count, datatype), dest, tag)
+
+
+def MPI_Ssend(buf, count, datatype, dest, tag, comm):
+    comm.Ssend((buf, count, datatype), dest, tag)
+
+
+def MPI_Bsend(buf, count, datatype, dest, tag, comm):
+    comm.Bsend((buf, count, datatype), dest, tag)
+
+
+def MPI_Rsend(buf, count, datatype, dest, tag, comm):
+    comm.Rsend((buf, count, datatype), dest, tag)
+
+
+def MPI_Recv(buf, count, datatype, source, tag, comm) -> Status:
+    return comm.Recv((buf, count, datatype), source, tag)
+
+
+def MPI_Isend(buf, count, datatype, dest, tag, comm):
+    return comm.Isend((buf, count, datatype), dest, tag)
+
+
+def MPI_Issend(buf, count, datatype, dest, tag, comm):
+    return comm.Issend((buf, count, datatype), dest, tag)
+
+
+def MPI_Ibsend(buf, count, datatype, dest, tag, comm):
+    return comm.Ibsend((buf, count, datatype), dest, tag)
+
+
+def MPI_Irsend(buf, count, datatype, dest, tag, comm):
+    return comm.Irsend((buf, count, datatype), dest, tag)
+
+
+def MPI_Irecv(buf, count, datatype, source, tag, comm):
+    return comm.Irecv((buf, count, datatype), source, tag)
+
+
+def MPI_Sendrecv(sbuf, scount, sdt, dest, stag,
+                 rbuf, rcount, rdt, source, rtag, comm) -> Status:
+    return comm.Sendrecv((sbuf, scount, sdt), dest, stag,
+                         (rbuf, rcount, rdt), source, rtag)
+
+
+def MPI_Probe(source, tag, comm) -> Status:
+    return comm.Probe(source, tag)
+
+
+def MPI_Iprobe(source, tag, comm) -> Optional[Status]:
+    return comm.Iprobe(source, tag)
+
+
+def MPI_Mprobe(source, tag, comm):
+    return comm.Mprobe(source, tag)
+
+
+def MPI_Mrecv(buf, count, datatype, message, comm) -> Status:
+    return comm.Mrecv((buf, count, datatype), message)
+
+
+def MPI_Wait(request, status=None) -> Status:
+    return request.wait()
+
+
+def MPI_Test(request) -> bool:
+    return request.test()
+
+
+def MPI_Waitall(requests, statuses=None) -> List[Status]:
+    return wait_all(requests)
+
+
+def MPI_Waitany(requests) -> int:
+    return wait_any(requests)
+
+
+def MPI_Waitsome(requests) -> List[int]:
+    return wait_some(requests)
+
+
+def MPI_Testall(requests) -> bool:
+    return test_all(requests)
+
+
+def MPI_Cancel(request):
+    request.cancel()
+
+
+def MPI_Get_count(status, datatype) -> int:
+    return status.get_count(datatype)
+
+
+# -- persistent + buffered --------------------------------------------------
+
+def MPI_Send_init(buf, count, datatype, dest, tag, comm):
+    return comm.Send_init((buf, count, datatype), dest, tag)
+
+
+def MPI_Bsend_init(buf, count, datatype, dest, tag, comm):
+    return comm.Bsend_init((buf, count, datatype), dest, tag)
+
+
+def MPI_Ssend_init(buf, count, datatype, dest, tag, comm):
+    return comm.Ssend_init((buf, count, datatype), dest, tag)
+
+
+def MPI_Recv_init(buf, count, datatype, source, tag, comm):
+    return comm.Recv_init((buf, count, datatype), source, tag)
+
+
+def MPI_Start(request):
+    request.start()
+
+
+def MPI_Startall(requests):
+    from ompi_tpu.pml.persistent import start_all
+    start_all(requests)
+
+
+def MPI_Request_free(request):
+    request.free()
+
+
+def MPI_Buffer_attach(size_or_buf):
+    _top.attach_buffer(size_or_buf)
+
+
+def MPI_Buffer_detach() -> int:
+    return _top.detach_buffer()
+
+
+# -- collectives ------------------------------------------------------------
+
+def MPI_Barrier(comm):
+    comm.Barrier()
+
+
+def MPI_Bcast(buf, count, datatype, root, comm):
+    comm.Bcast((buf, count, datatype), root)
+
+
+def MPI_Reduce(sbuf, rbuf, count, datatype, op, root, comm):
+    comm.Reduce((sbuf, count, datatype),
+                None if rbuf is None else (rbuf, count, datatype),
+                op, root)
+
+
+def MPI_Allreduce(sbuf, rbuf, count, datatype, op, comm):
+    comm.Allreduce((sbuf, count, datatype), (rbuf, count, datatype), op)
+
+
+def MPI_Allgather(sbuf, scount, sdt, rbuf, rcount, rdt, comm):
+    comm.Allgather((sbuf, scount, sdt), (rbuf, rcount * comm.size, rdt))
+
+
+def MPI_Allgatherv(sbuf, scount, sdt, rbuf, rcounts, displs, rdt, comm):
+    comm.Allgatherv((sbuf, scount, sdt), (rbuf, sum(rcounts), rdt),
+                    rcounts, displs)
+
+
+def MPI_Gather(sbuf, scount, sdt, rbuf, rcount, rdt, root, comm):
+    comm.Gather((sbuf, scount, sdt),
+                None if comm.rank != root else
+                (rbuf, rcount * comm.size, rdt), root)
+
+
+def MPI_Scatter(sbuf, scount, sdt, rbuf, rcount, rdt, root, comm):
+    comm.Scatter(None if comm.rank != root else
+                 (sbuf, scount * comm.size, sdt),
+                 (rbuf, rcount, rdt), root)
+
+
+def MPI_Alltoall(sbuf, scount, sdt, rbuf, rcount, rdt, comm):
+    comm.Alltoall((sbuf, scount * comm.size, sdt),
+                  (rbuf, rcount * comm.size, rdt))
+
+
+def MPI_Alltoallv(sbuf, scounts, sdispls, sdt, rbuf, rcounts, rdispls,
+                  rdt, comm):
+    comm.Alltoallv((sbuf, 0, sdt), scounts, sdispls, (rbuf, 0, rdt),
+                   rcounts, rdispls)
+
+
+def MPI_Reduce_scatter(sbuf, rbuf, rcounts, datatype, op, comm):
+    comm.Reduce_scatter((sbuf, sum(rcounts), datatype),
+                        (rbuf, rcounts[comm.rank], datatype), rcounts, op)
+
+
+def MPI_Reduce_scatter_block(sbuf, rbuf, rcount, datatype, op, comm):
+    comm.Reduce_scatter_block((sbuf, rcount * comm.size, datatype),
+                              (rbuf, rcount, datatype), op)
+
+
+def MPI_Scan(sbuf, rbuf, count, datatype, op, comm):
+    comm.Scan((sbuf, count, datatype), (rbuf, count, datatype), op)
+
+
+def MPI_Exscan(sbuf, rbuf, count, datatype, op, comm):
+    comm.Exscan((sbuf, count, datatype), (rbuf, count, datatype), op)
+
+
+def MPI_Ibarrier(comm):
+    return comm.Ibarrier()
+
+
+def MPI_Ibcast(buf, count, datatype, root, comm):
+    return comm.Ibcast((buf, count, datatype), root)
+
+
+def MPI_Iallreduce(sbuf, rbuf, count, datatype, op, comm):
+    return comm.Iallreduce((sbuf, count, datatype),
+                           (rbuf, count, datatype), op)
+
+
+def MPI_Ialltoall(sbuf, scount, sdt, rbuf, rcount, rdt, comm):
+    return comm.Ialltoall((sbuf, scount * comm.size, sdt),
+                          (rbuf, rcount * comm.size, rdt))
+
+
+# -- topologies -------------------------------------------------------------
+
+def MPI_Dims_create(nnodes, ndims, dims=None) -> List[int]:
+    from ompi_tpu.topo import dims_create
+    return dims_create(nnodes, ndims, dims)
+
+
+def MPI_Cart_create(comm, ndims, dims, periods, reorder=False):
+    return comm.Create_cart(dims, periods, reorder)
+
+
+def MPI_Cart_coords(comm, rank) -> List[int]:
+    return comm.Get_coords(rank)
+
+
+def MPI_Cart_rank(comm, coords) -> int:
+    return comm.Get_cart_rank(coords)
+
+
+def MPI_Cart_shift(comm, direction, disp):
+    return comm.Shift(direction, disp)
+
+
+def MPI_Cart_sub(comm, remain_dims):
+    return comm.Sub(remain_dims)
+
+
+def MPI_Topo_test(comm) -> int:
+    return comm.Topo_test()
+
+
+def MPI_Neighbor_allgather(sbuf, scount, sdt, rbuf, rcount, rdt, comm):
+    nin = len(comm.topo.in_neighbors(comm.rank))
+    comm.Neighbor_allgather((sbuf, scount, sdt),
+                            (rbuf, rcount * nin, rdt))
+
+
+def MPI_Neighbor_alltoall(sbuf, scount, sdt, rbuf, rcount, rdt, comm):
+    nin = len(comm.topo.in_neighbors(comm.rank))
+    nout = len(comm.topo.out_neighbors(comm.rank))
+    comm.Neighbor_alltoall((sbuf, scount * nout, sdt),
+                           (rbuf, rcount * nin, rdt))
+
+
+# -- one-sided --------------------------------------------------------------
+
+def MPI_Win_create(base, size=None, disp_unit=None, info=None, comm=None):
+    from ompi_tpu.osc import window as _w
+    return _w.create(comm, base, disp_unit)
+
+
+def MPI_Win_fence(assert_=0, win=None):
+    win.fence()
+
+
+def MPI_Win_lock(lock_type, rank, assert_=0, win=None):
+    win.lock(rank, lock_type)
+
+
+def MPI_Win_unlock(rank, win=None):
+    win.unlock(rank)
+
+
+def MPI_Put(obuf, ocount, odt, target, tdisp, tcount, tdt, win):
+    win.put(obuf, target, tdisp)
+
+
+def MPI_Get(obuf, ocount, odt, target, tdisp, tcount, tdt, win):
+    win.get(obuf, target, tdisp)
+
+
+def MPI_Accumulate(obuf, ocount, odt, target, tdisp, tcount, tdt, op, win):
+    win.accumulate(obuf, target, tdisp, op=op)
+
+
+# -- PMPI aliases (profiling layer, ref: ompi/mpi/c/init.c:35-37) -----------
+
+_mod = _sys.modules[__name__]
+for _name in list(vars(_mod)):
+    if _name.startswith("MPI_") and callable(getattr(_mod, _name)):
+        setattr(_mod, "P" + _name, getattr(_mod, _name))
+del _mod, _name

@@ -98,27 +98,24 @@ def wait_all(reqs: List[Request], timeout: Optional[float] = None
     return [r.status for r in reqs]
 
 
+# pollers go through r.test(), not the raw `complete` flag: wrapper
+# requests (e.g. PersistentRequest) sync their outer state there
+
 def wait_any(reqs: List[Request]) -> int:
     if not reqs:
         return -1
     while True:
         for i, r in enumerate(reqs):
-            if r.complete:
+            if r.complete or r.test():
                 return i
-        reqs[0]._progress.progress()
 
 
 def wait_some(reqs: List[Request]) -> List[int]:
     while True:
-        done = [i for i, r in enumerate(reqs) if r.complete]
+        done = [i for i, r in enumerate(reqs) if r.complete or r.test()]
         if done:
             return done
-        reqs[0]._progress.progress()
 
 
 def test_all(reqs: List[Request]) -> bool:
-    for r in reqs:
-        if not r.complete:
-            r._progress.progress()
-            break
-    return all(r.complete for r in reqs)
+    return all(r.complete or r.test() for r in reqs)

@@ -1,0 +1,276 @@
+"""Persistent requests, buffered sends, flat MPI_* surface (ref:
+ompi/mpi/c/send_init.c, bsend.c, buffer_attach.c; PMPI aliasing
+init.c:35-37)."""
+
+import numpy as np
+import pytest
+
+import ompi_tpu
+from ompi_tpu.testing import run_ranks
+
+
+def test_persistent_ping_loop():
+    def fn(comm):
+        n = 16
+        out = []
+        if comm.rank == 0:
+            buf = np.zeros(n, dtype=np.float64)
+            req = comm.Send_init(buf, dest=1, tag=7)
+            for it in range(5):
+                buf[:] = it  # refresh payload between starts
+                req.start()
+                req.wait()
+        else:
+            buf = np.empty(n, dtype=np.float64)
+            req = comm.Recv_init(buf, source=0, tag=7)
+            for it in range(5):
+                req.start()
+                st = req.wait()
+                assert st.source == 0 and st.tag == 7
+                out.append(buf.copy())
+        return out
+
+    res = run_ranks(2, fn)
+    for it, arr in enumerate(res[1]):
+        np.testing.assert_allclose(arr, np.full(16, float(it)))
+
+
+def test_persistent_startall_and_inactive_wait():
+    def fn(comm):
+        right = (comm.rank + 1) % comm.size
+        left = (comm.rank - 1) % comm.size
+        s = np.array([comm.rank * 1.0])
+        r = np.zeros(1)
+        sreq = comm.Send_init(s, dest=right, tag=2)
+        rreq = comm.Recv_init(r, source=left, tag=2)
+        # wait on an inactive persistent request returns immediately
+        rreq.wait()
+        from ompi_tpu.pml.persistent import start_all
+        for _ in range(3):
+            start_all([rreq, sreq])
+            rreq.wait()
+            sreq.wait()
+        return float(r[0])
+
+    res = run_ranks(3, fn)
+    assert res == [2.0, 0.0, 1.0]
+
+
+def test_persistent_double_start_raises():
+    def fn(comm):
+        if comm.rank == 0:
+            r = np.zeros(1)
+            req = comm.Recv_init(r, source=1, tag=0)
+            req.start()
+            try:
+                req.start()
+                out = "no-error"
+            except RuntimeError:
+                out = "ok"
+            req.wait()  # the peer's send satisfies the first start
+            comm.Send(np.zeros(1), dest=1, tag=1)  # release peer
+            return out
+        comm.Send(np.zeros(1), dest=0, tag=0)
+        comm.Recv(np.zeros(1), source=0, tag=1)
+        return None
+
+    assert run_ranks(2, fn)[0] == "ok"
+
+
+def test_bsend_user_buffer_reusable():
+    def fn(comm):
+        if comm.rank == 0:
+            ompi_tpu.attach_buffer(1 << 16)
+            buf = np.arange(32, dtype=np.float64)
+            comm.Bsend(buf, dest=1, tag=0)
+            buf[:] = -1  # clobber immediately: receiver must see copy
+            comm.Bsend(buf * 0 + 5, dest=1, tag=1)
+            size = ompi_tpu.detach_buffer()
+            assert size == 1 << 16
+            return None
+        r1 = np.empty(32, dtype=np.float64)
+        r2 = np.empty(32, dtype=np.float64)
+        comm.Recv(r1, source=0, tag=0)
+        comm.Recv(r2, source=0, tag=1)
+        return (r1, r2)
+
+    r1, r2 = run_ranks(2, fn)[1]
+    np.testing.assert_allclose(r1, np.arange(32, dtype=np.float64))
+    np.testing.assert_allclose(r2, np.full(32, 5.0))
+
+
+def test_bsend_without_buffer_raises():
+    def fn(comm):
+        try:
+            comm.Bsend(np.zeros(4), dest=(comm.rank + 1) % 2, tag=0)
+            return "no-error"
+        except RuntimeError:
+            return "ok"
+
+    assert run_ranks(2, fn) == ["ok", "ok"]
+
+
+def test_bsend_exhaustion_raises():
+    def fn(comm):
+        if comm.rank == 0:
+            ompi_tpu.attach_buffer(256)
+            try:
+                # 512B payload can't fit a 256B buffer
+                comm.Bsend(np.zeros(64, dtype=np.float64), dest=1, tag=0)
+                out = "no-error"
+            except RuntimeError:
+                out = "ok"
+            comm.Send(np.zeros(1), dest=1, tag=9)
+            ompi_tpu.detach_buffer()
+            return out
+        comm.Recv(np.zeros(1), source=0, tag=9)
+        return None
+
+    assert run_ranks(2, fn)[0] == "ok"
+
+
+def test_bsend_init_persistent():
+    def fn(comm):
+        if comm.rank == 0:
+            ompi_tpu.attach_buffer(1 << 14)
+            buf = np.zeros(8, dtype=np.int64)
+            req = comm.Bsend_init(buf, dest=1, tag=3)
+            for it in range(3):
+                buf[:] = it * 10
+                req.start()
+                req.wait()
+            ompi_tpu.detach_buffer()
+            return None
+        got = []
+        r = np.empty(8, dtype=np.int64)
+        for _ in range(3):
+            comm.Recv(r, source=0, tag=3)
+            got.append(int(r[0]))
+        return got
+
+    assert run_ranks(2, fn)[1] == [0, 10, 20]
+
+
+def test_rsend_behaves_as_send():
+    def fn(comm):
+        if comm.rank == 0:
+            comm.Rsend(np.full(4, 9.0), dest=1, tag=0)
+            comm.Irsend(np.full(4, 8.0), dest=1, tag=1).wait()
+            return None
+        a = np.empty(4)
+        b = np.empty(4)
+        comm.Recv(a, source=0, tag=0)
+        comm.Recv(b, source=0, tag=1)
+        return (float(a[0]), float(b[0]))
+
+    assert run_ranks(2, fn)[1] == (9.0, 8.0)
+
+
+def test_persistent_with_waitany_testall():
+    from ompi_tpu.pml.request import test_all, wait_any
+
+    def fn(comm):
+        if comm.rank == 0:
+            s = np.array([42.0])
+            req = comm.Send_init(s, dest=1, tag=0)
+            req.start()
+            i = wait_any([req])            # must observe completion
+            assert i == 0 and test_all([req])
+            return "ok"
+        r = np.zeros(1)
+        rq = comm.Recv_init(r, source=0, tag=0)
+        rq.start()
+        assert wait_any([rq]) == 0
+        return float(r[0])
+
+    res = run_ranks(2, fn)
+    assert res == ["ok", 42.0]
+
+
+def test_bsend_failed_send_releases_reservation():
+    def fn(comm):
+        ompi_tpu.attach_buffer(600)
+        try:
+            try:
+                comm.Bsend(np.zeros(32, dtype=np.float64), dest=99, tag=0)
+            except Exception:
+                pass
+            # the 256B+overhead reservation must have been released:
+            # a legal send of the same size fits a 600B buffer only
+            # if the failed one didn't leak
+            comm.Bsend(np.zeros(32, dtype=np.float64),
+                       dest=(comm.rank + 1) % 2, tag=1)
+            comm.Recv(np.empty(32, dtype=np.float64),
+                      source=(comm.rank - 1) % 2, tag=1)
+            return "ok"
+        finally:
+            ompi_tpu.detach_buffer()
+
+    assert run_ranks(2, fn) == ["ok", "ok"]
+
+
+# -- flat MPI_* surface -----------------------------------------------------
+
+def test_flat_mpi_ring():
+    from ompi_tpu import mpi as MPI
+
+    def fn(comm):
+        rank = MPI.MPI_Comm_rank(comm)
+        size = MPI.MPI_Comm_size(comm)
+        token = np.array([rank * 1.0])
+        if rank == 0:
+            MPI.MPI_Send(token, 1, MPI.MPI_DOUBLE, 1 % size, 0, comm)
+            st = MPI.MPI_Recv(token, 1, MPI.MPI_DOUBLE,
+                              (size - 1) % size, 0, comm)
+            return (float(token[0]), st.source)
+        MPI.MPI_Recv(token, 1, MPI.MPI_DOUBLE, rank - 1, 0, comm)
+        token[0] += 1
+        MPI.MPI_Send(token, 1, MPI.MPI_DOUBLE, (rank + 1) % size, 0, comm)
+        return float(token[0])
+
+    res = run_ranks(4, fn)
+    assert res[0] == (3.0, 3)
+    assert res[1:] == [1.0, 2.0, 3.0]
+
+
+def test_flat_mpi_collectives_and_pmpi():
+    from ompi_tpu import mpi as MPI
+
+    # PMPI aliases exist and are the same callables
+    assert MPI.PMPI_Allreduce is MPI.MPI_Allreduce
+    assert MPI.PMPI_Send is MPI.MPI_Send
+
+    def fn(comm):
+        x = np.full(8, comm.rank + 1.0)
+        r = np.empty(8)
+        MPI.MPI_Allreduce(x, r, 8, MPI.MPI_DOUBLE, MPI.MPI_SUM, comm)
+        dims = MPI.MPI_Dims_create(comm.size, 2)
+        cart = MPI.MPI_Cart_create(comm, 2, dims, [True, True])
+        coords = MPI.MPI_Cart_coords(cart, cart.rank)
+        return (float(r[0]), tuple(dims), tuple(coords))
+
+    res = run_ranks(4, fn)
+    for rank, (total, dims, coords) in enumerate(res):
+        assert total == 1 + 2 + 3 + 4
+        assert dims == (2, 2)
+        assert coords == (rank // 2, rank % 2)
+
+
+def test_flat_mpi_win():
+    from ompi_tpu import mpi as MPI
+
+    def fn(comm):
+        mem = np.zeros(4, dtype=np.int64)
+        win = MPI.MPI_Win_create(mem, comm=comm)
+        MPI.MPI_Win_fence(0, win)
+        if comm.rank == 0:
+            val = np.array([77], dtype=np.int64)
+            MPI.MPI_Put(val, 1, MPI.MPI_INT64_T, 1, 2, 1,
+                        MPI.MPI_INT64_T, win)
+        MPI.MPI_Win_fence(0, win)
+        out = int(mem[2])
+        win.free()
+        return out
+
+    res = run_ranks(2, fn)
+    assert res[1] == 77
