@@ -1,0 +1,15 @@
+"""MPI-IO (io/ompio analog; SURVEY.md §2.5 io/fs/fbtl/fcoll/sharedfp).
+
+    from ompi_tpu import io as mpiio
+    f = mpiio.open(comm, "data.bin", mpiio.MODE_CREATE | mpiio.MODE_RDWR)
+    f.write_at(comm.rank * n, arr)
+    f.close()
+"""
+
+from ompi_tpu.io.file import (  # noqa: F401
+    File, open, delete,
+    MODE_APPEND, MODE_CREATE, MODE_DELETE_ON_CLOSE, MODE_EXCL,
+    MODE_RDONLY, MODE_RDWR, MODE_SEQUENTIAL, MODE_UNIQUE_OPEN,
+    MODE_WRONLY, SEEK_CUR, SEEK_END, SEEK_SET,
+)
+from ompi_tpu.io.view import FileView  # noqa: F401

@@ -476,6 +476,123 @@ def MPI_Accumulate(obuf, ocount, odt, target, tdisp, tcount, tdt, op, win):
     win.accumulate(obuf, target, tdisp, op=op)
 
 
+# -- MPI-IO -----------------------------------------------------------------
+
+from ompi_tpu.io import (  # noqa: E402,F401
+    MODE_APPEND as MPI_MODE_APPEND, MODE_CREATE as MPI_MODE_CREATE,
+    MODE_DELETE_ON_CLOSE as MPI_MODE_DELETE_ON_CLOSE,
+    MODE_EXCL as MPI_MODE_EXCL, MODE_RDONLY as MPI_MODE_RDONLY,
+    MODE_RDWR as MPI_MODE_RDWR, MODE_SEQUENTIAL as MPI_MODE_SEQUENTIAL,
+    MODE_UNIQUE_OPEN as MPI_MODE_UNIQUE_OPEN,
+    MODE_WRONLY as MPI_MODE_WRONLY,
+    SEEK_CUR as MPI_SEEK_CUR, SEEK_END as MPI_SEEK_END,
+    SEEK_SET as MPI_SEEK_SET,
+)
+
+
+def MPI_File_open(comm, filename, amode, info=None):
+    from ompi_tpu import io as _io
+    return _io.open(comm, filename, amode, info)
+
+
+def MPI_File_close(fh):
+    fh.close()
+
+
+def MPI_File_delete(filename, info=None):
+    from ompi_tpu import io as _io
+    _io.delete(filename)
+
+
+def MPI_File_set_view(fh, disp, etype, filetype, datarep="native",
+                      info=None):
+    fh.set_view(disp, etype, filetype, datarep)
+
+
+def MPI_File_seek(fh, offset, whence=MPI_SEEK_SET):
+    fh.seek(offset, whence)
+
+
+def MPI_File_get_position(fh) -> int:
+    return fh.get_position()
+
+
+def MPI_File_get_size(fh) -> int:
+    return fh.get_size()
+
+
+def MPI_File_set_size(fh, size):
+    fh.set_size(size)
+
+
+def MPI_File_sync(fh):
+    fh.sync()
+
+
+def MPI_File_read(fh, buf, count, datatype) -> Status:
+    return fh.read((buf, count, datatype))
+
+
+def MPI_File_write(fh, buf, count, datatype) -> Status:
+    return fh.write((buf, count, datatype))
+
+
+def MPI_File_read_at(fh, offset, buf, count, datatype) -> Status:
+    return fh.read_at(offset, (buf, count, datatype))
+
+
+def MPI_File_write_at(fh, offset, buf, count, datatype) -> Status:
+    return fh.write_at(offset, (buf, count, datatype))
+
+
+def MPI_File_read_all(fh, buf, count, datatype) -> Status:
+    return fh.read_all((buf, count, datatype))
+
+
+def MPI_File_write_all(fh, buf, count, datatype) -> Status:
+    return fh.write_all((buf, count, datatype))
+
+
+def MPI_File_read_at_all(fh, offset, buf, count, datatype) -> Status:
+    return fh.read_at_all(offset, (buf, count, datatype))
+
+
+def MPI_File_write_at_all(fh, offset, buf, count, datatype) -> Status:
+    return fh.write_at_all(offset, (buf, count, datatype))
+
+
+def MPI_File_read_shared(fh, buf, count, datatype) -> Status:
+    return fh.read_shared((buf, count, datatype))
+
+
+def MPI_File_write_shared(fh, buf, count, datatype) -> Status:
+    return fh.write_shared((buf, count, datatype))
+
+
+def MPI_File_read_ordered(fh, buf, count, datatype) -> Status:
+    return fh.read_ordered((buf, count, datatype))
+
+
+def MPI_File_write_ordered(fh, buf, count, datatype) -> Status:
+    return fh.write_ordered((buf, count, datatype))
+
+
+def MPI_File_iread(fh, buf, count, datatype):
+    return fh.iread((buf, count, datatype))
+
+
+def MPI_File_iwrite(fh, buf, count, datatype):
+    return fh.iwrite((buf, count, datatype))
+
+
+def MPI_File_iread_at(fh, offset, buf, count, datatype):
+    return fh.iread_at(offset, (buf, count, datatype))
+
+
+def MPI_File_iwrite_at(fh, offset, buf, count, datatype):
+    return fh.iwrite_at(offset, (buf, count, datatype))
+
+
 # -- PMPI aliases (profiling layer, ref: ompi/mpi/c/init.c:35-37) -----------
 
 _mod = _sys.modules[__name__]

@@ -1,0 +1,475 @@
+"""MPI-IO: views, individual/shared/ordered/collective IO (ref:
+ompi/mca/io/ompio + fcoll/two_phase; test spirit of ROMIO's
+coll_test/atomicity programs)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from ompi_tpu import io as mpiio
+from ompi_tpu.datatype import engine as dt
+from ompi_tpu.io.view import FileView
+from ompi_tpu.testing import run_ranks
+
+RW = mpiio.MODE_CREATE | mpiio.MODE_RDWR
+
+
+# -- FileView mapping (pure) ------------------------------------------------
+
+def test_view_default_is_byte_stream():
+    v = FileView()
+    assert v.map_bytes(10, 4) == [(10, 4)]
+
+
+def test_view_disp_and_etype_units():
+    v = FileView(disp=100, etype=dt.DOUBLE)
+    assert v.map_bytes(2, 24) == [(100 + 16, 24)]
+
+
+def test_view_strided_filetype():
+    # filetype: 1 double taken, 1 skipped (double resized to extent 16
+    # — the MPI idiom for interleaved views)
+    ft = dt.resized(dt.DOUBLE, 0, 16)
+    v = FileView(disp=0, etype=dt.DOUBLE, filetype=ft)
+    assert v.tile_bytes == 8 and v.tile_extent == 16
+    # element i lands at byte 16*i
+    assert v.map_bytes(0, 8) == [(0, 8)]
+    assert v.map_bytes(1, 8) == [(16, 8)]
+    assert v.map_bytes(0, 24) == [(0, 8), (16, 8), (32, 8)]
+
+
+def test_view_block_cyclic():
+    # 2 doubles mine, 4 doubles extent (2-rank interleave)
+    ft = dt.resized(dt.contiguous(2, dt.DOUBLE), 0, 32)
+    v0 = FileView(0, dt.DOUBLE, ft)
+    v1 = FileView(16, dt.DOUBLE, ft)
+    assert v0.map_bytes(0, 32) == [(0, 16), (32, 16)]
+    assert v1.map_bytes(0, 32) == [(16, 16), (48, 16)]
+    # mid-tile start
+    assert v0.map_bytes(1, 16) == [(8, 8), (32, 8)]
+
+
+def test_view_rejects_bad_etype_multiple():
+    with pytest.raises(ValueError):
+        FileView(0, dt.DOUBLE, dt.contiguous(3, dt.INT32_T))
+
+
+# -- individual IO ----------------------------------------------------------
+
+def test_write_read_at(tmp_path):
+    path = str(tmp_path / "wr.bin")
+
+    def fn(comm):
+        f = mpiio.open(comm, path, RW)
+        n = 16
+        data = np.arange(n, dtype=np.float64) + comm.rank * 100
+        f.write_at(comm.rank * n * 8, data)
+        f.sync()
+        comm.Barrier()
+        peer = (comm.rank + 1) % comm.size
+        got = np.empty(n, dtype=np.float64)
+        f.read_at(peer * n * 8, got)
+        f.close()
+        return got
+
+    res = run_ranks(3, fn)
+    for rank, got in enumerate(res):
+        peer = (rank + 1) % 3
+        np.testing.assert_allclose(got,
+                                   np.arange(16, dtype=np.float64)
+                                   + peer * 100)
+
+
+def test_individual_pointer_seek_tell(tmp_path):
+    path = str(tmp_path / "seek.bin")
+
+    def fn(comm):
+        f = mpiio.open(comm, path, RW)
+        f.set_view(0, dt.DOUBLE)   # positions in doubles now
+        if comm.rank == 0:
+            f.write(np.array([1.0, 2.0, 3.0]))
+            assert f.get_position() == 3
+            f.seek(1)
+            out = np.zeros(2)
+            f.read(out)
+            assert f.get_position() == 3
+            f.seek(-1, mpiio.SEEK_CUR)
+            assert f.get_position() == 2
+            f.seek(0, mpiio.SEEK_END)
+            end = f.get_position()
+            f.close()
+            return (list(out), end)
+        f.close()
+        return None
+
+    out, end = run_ranks(2, fn)[0]
+    assert out == [2.0, 3.0] and end == 3
+
+
+def test_eof_read_zero_fills(tmp_path):
+    path = str(tmp_path / "eof.bin")
+
+    def fn(comm):
+        f = mpiio.open(comm, path, RW)
+        if comm.rank == 0:
+            f.write_at(0, np.array([7.0]))
+        f.sync()
+        comm.Barrier()
+        out = np.full(4, -1.0)
+        f.read_at(0, out)
+        f.close()
+        return list(out)
+
+    for r in run_ranks(2, fn):
+        assert r == [7.0, 0.0, 0.0, 0.0]
+
+
+def test_file_size_ops_and_delete(tmp_path):
+    path = str(tmp_path / "size.bin")
+
+    def fn(comm):
+        f = mpiio.open(comm, path,
+                       RW | mpiio.MODE_DELETE_ON_CLOSE)
+        if comm.rank == 0:
+            f.set_size(1024)
+        f.sync()
+        comm.Barrier()
+        s = f.get_size()
+        f.close()
+        return s
+
+    assert run_ranks(2, fn) == [1024, 1024]
+    assert not os.path.exists(path)
+
+
+def test_collective_open_failure_raises_everywhere(tmp_path):
+    path = str(tmp_path / "nonexistent" / "x.bin")
+
+    def fn(comm):
+        try:
+            mpiio.open(comm, path, mpiio.MODE_RDONLY)
+            return "no-error"
+        except OSError:
+            return "ok"
+
+    assert run_ranks(2, fn) == ["ok", "ok"]
+
+
+def test_iwrite_iread_requests(tmp_path):
+    path = str(tmp_path / "nb.bin")
+
+    def fn(comm):
+        f = mpiio.open(comm, path, RW)
+        if comm.rank == 0:
+            f.iwrite_at(0, np.arange(8, dtype=np.int64)).wait()
+        f.sync()
+        comm.Barrier()
+        out = np.zeros(8, dtype=np.int64)
+        st = f.iread_at(0, out).wait()
+        f.close()
+        return (list(out), st.count)
+
+    for out, count in run_ranks(2, fn):
+        assert out == list(range(8)) and count == 64
+
+
+def test_write_all_wronly_no_rmw_crash(tmp_path):
+    # WRONLY + collective write with holes must not pread (EBADF)
+    path = str(tmp_path / "wronly.bin")
+
+    def fn(comm):
+        f = mpiio.open(comm, path,
+                       mpiio.MODE_CREATE | mpiio.MODE_WRONLY)
+        f.set_view(0, dt.DOUBLE)
+        data = np.full(4, comm.rank + 1.0)
+        f.write_at_all(comm.rank * 8, data)   # hole at [4,8)
+        f.close()
+        return "ok"
+
+    assert run_ranks(2, fn) == ["ok", "ok"]
+    raw = np.fromfile(path, dtype=np.float64)
+    assert list(raw[:4]) == [1.0] * 4 and list(raw[8:12]) == [2.0] * 4
+
+
+def test_append_mode_starts_at_eof(tmp_path):
+    path = str(tmp_path / "append.bin")
+
+    def fn(comm):
+        f = mpiio.open(comm, path, RW)
+        f.set_view(0, dt.DOUBLE)
+        if comm.rank == 0:
+            f.write_at(0, np.full(4, 1.0))
+        f.close()
+        f = mpiio.open(comm, path,
+                       mpiio.MODE_RDWR | mpiio.MODE_APPEND)
+        f.set_view(0, dt.DOUBLE)
+        f.seek(0, mpiio.SEEK_END)  # view reset pos; append-like seek
+        start = 4
+        if comm.rank == 0:
+            f.write_at(start, np.full(2, 2.0))  # explicit offset works
+        f.sync()
+        comm.Barrier()
+        out = np.zeros(6)
+        f.read_at(0, out)
+        f.close()
+        return list(out)
+
+    res = run_ranks(2, fn)
+    assert res[0] == [1.0] * 4 + [2.0] * 2
+
+
+def test_read_count_reports_actual_at_eof(tmp_path):
+    path = str(tmp_path / "count.bin")
+
+    def fn(comm):
+        f = mpiio.open(comm, path, RW)
+        if comm.rank == 0:
+            f.write_at(0, np.full(1, 3.0))  # 8 bytes in file
+        f.sync()
+        comm.Barrier()
+        out = np.zeros(4)
+        st = f.read_at(0, out)
+        f.close()
+        return st.count
+
+    assert run_ranks(2, fn) == [8, 8]   # not the padded 32
+
+
+def test_seek_invalid_leaves_position(tmp_path):
+    path = str(tmp_path / "seekerr.bin")
+
+    def fn(comm):
+        f = mpiio.open(comm, path, RW)
+        f.seek(2)
+        try:
+            f.seek(-5, mpiio.SEEK_CUR)
+            out = "no-error"
+        except ValueError:
+            out = f.get_position()
+        f.close()
+        return out
+
+    assert run_ranks(2, fn) == [2, 2]
+
+
+# -- views over real files --------------------------------------------------
+
+def test_interleaved_views_write_then_read_whole(tmp_path):
+    path = str(tmp_path / "interleave.bin")
+    n_each = 4  # doubles per rank per tile
+
+    def fn(comm):
+        f = mpiio.open(comm, path, RW)
+        ft = dt.resized(dt.contiguous(n_each, dt.DOUBLE), 0,
+                        n_each * comm.size * 8)
+        f.set_view(comm.rank * n_each * 8, dt.DOUBLE, ft)
+        data = np.full(2 * n_each, comm.rank * 1.0)  # two tiles worth
+        f.write(data)
+        f.sync()
+        comm.Barrier()
+        # read back raw (fresh view) on rank 0
+        f.set_view(0, dt.DOUBLE)
+        whole = np.zeros(2 * n_each * comm.size)
+        f.read_at(0, whole)
+        f.close()
+        return list(whole)
+
+    res = run_ranks(3, fn)
+    expect = []
+    for tile in range(2):
+        for rank in range(3):
+            expect += [float(rank)] * n_each
+    assert res[0] == expect
+
+
+# -- shared / ordered -------------------------------------------------------
+
+def test_write_shared_disjoint_records(tmp_path):
+    path = str(tmp_path / "shared.bin")
+    rec = 8
+
+    def fn(comm):
+        f = mpiio.open(comm, path, RW)
+        data = np.full(rec, comm.rank * 1.0)
+        for _ in range(2):
+            f.write_shared(data)
+        f.sync()
+        comm.Barrier()
+        out = np.full(rec * 2 * comm.size, -1.0)
+        f.read_at(0, out)
+        pos = f.get_position_shared()
+        f.close()
+        return (list(out), pos)
+
+    res = run_ranks(3, fn)
+    out, pos = res[0]
+    # every record is a contiguous run of one rank's value; all present
+    recs = [tuple(out[i * rec:(i + 1) * rec]) for i in range(6)]
+    assert all(len(set(r)) == 1 for r in recs)
+    vals = sorted(r[0] for r in recs)
+    assert vals == [0.0, 0.0, 1.0, 1.0, 2.0, 2.0]
+    # positions are etype units (bytes, the default view): 6 records
+    # of 8 doubles = 384
+    assert pos == 6 * rec * 8
+    assert res[1][1] == pos and res[2][1] == pos
+
+
+def test_write_ordered_rank_order(tmp_path):
+    path = str(tmp_path / "ordered.bin")
+
+    def fn(comm):
+        f = mpiio.open(comm, path, RW)
+        f.set_view(0, dt.DOUBLE)
+        data = np.full(comm.rank + 1, comm.rank * 1.0)  # varying sizes
+        f.write_ordered(data)
+        f.sync()
+        comm.Barrier()
+        out = np.full(6, -1.0)
+        f.read_at(0, out)
+        f.close()
+        return list(out)
+
+    res = run_ranks(3, fn)
+    assert res[0] == [0.0, 1.0, 1.0, 2.0, 2.0, 2.0]
+
+
+def test_seek_shared_resets(tmp_path):
+    path = str(tmp_path / "seeksh.bin")
+
+    def fn(comm):
+        f = mpiio.open(comm, path, RW)
+        f.write_shared(np.zeros(4, dtype=np.uint8))
+        comm.Barrier()
+        f.seek_shared(0)
+        p = f.get_position_shared()
+        f.close()
+        return p
+
+    assert run_ranks(2, fn) == [0, 0]
+
+
+# -- collective two-phase ---------------------------------------------------
+
+@pytest.mark.parametrize("naggs", [0, 1, 2])
+def test_write_at_all_contiguous_blocks(tmp_path, naggs):
+    from ompi_tpu.mca.params import registry
+    path = str(tmp_path / f"wall{naggs}.bin")
+    registry.set("io_fcoll_num_aggregators", naggs)
+    try:
+        def fn(comm):
+            f = mpiio.open(comm, path, RW)
+            n = 32
+            data = np.arange(n, dtype=np.float64) + comm.rank * 1000
+            f.write_at_all(comm.rank * n * 8, data)
+            f.sync()
+            comm.Barrier()
+            out = np.zeros(n * comm.size, dtype=np.float64)
+            f.read_at(0, out)
+            f.close()
+            return out
+
+        res = run_ranks(3, fn)
+        expect = np.concatenate(
+            [np.arange(32, dtype=np.float64) + r * 1000 for r in range(3)])
+        np.testing.assert_allclose(res[0], expect)
+    finally:
+        registry.set("io_fcoll_num_aggregators", 0)
+
+
+def test_write_at_all_interleaved_views(tmp_path):
+    path = str(tmp_path / "wallview.bin")
+
+    def fn(comm):
+        f = mpiio.open(comm, path, RW)
+        ft = dt.resized(dt.contiguous(2, dt.DOUBLE), 0,
+                        2 * comm.size * 8)
+        f.set_view(comm.rank * 16, dt.DOUBLE, ft)
+        data = np.full(6, comm.rank * 1.0)  # 3 tiles of 2
+        f.write_at_all(0, data)
+        f.sync()
+        comm.Barrier()
+        f.set_view(0, dt.DOUBLE)
+        whole = np.zeros(6 * comm.size)
+        f.read_at(0, whole)
+        f.close()
+        return list(whole)
+
+    res = run_ranks(4, fn)
+    expect = []
+    for tile in range(3):
+        for rank in range(4):
+            expect += [float(rank)] * 2
+    assert res[0] == expect
+
+
+def test_read_at_all_roundtrip(tmp_path):
+    path = str(tmp_path / "rall.bin")
+
+    def fn(comm):
+        f = mpiio.open(comm, path, RW)
+        n = 16
+        if comm.rank == 0:
+            allv = np.arange(n * comm.size, dtype=np.float64)
+            f.write_at(0, allv)
+        f.sync()
+        comm.Barrier()
+        mine = np.zeros(n, dtype=np.float64)
+        f.read_at_all(comm.rank * n * 8, mine)
+        f.close()
+        return mine
+
+    res = run_ranks(4, fn)
+    for rank, got in enumerate(res):
+        np.testing.assert_allclose(
+            got, np.arange(16, dtype=np.float64) + rank * 16)
+
+
+def test_write_all_gap_preserves_existing(tmp_path):
+    # ranks write disjoint NON-adjacent blocks; the hole between them
+    # must keep its prior contents (read-modify-write correctness)
+    path = str(tmp_path / "gap.bin")
+
+    def fn(comm):
+        f = mpiio.open(comm, path, RW)
+        f.set_view(0, dt.DOUBLE)   # positions in doubles
+        if comm.rank == 0:
+            f.write_at(0, np.full(64, 9.0))   # pre-existing content
+        f.sync()
+        comm.Barrier()
+        data = np.full(8, comm.rank + 1.0)
+        # rank 0 → [0,8), rank 1 → [24,32): hole at [8,24)
+        f.write_at_all(comm.rank * 24, data)
+        f.sync()
+        comm.Barrier()
+        out = np.zeros(32)
+        f.read_at(0, out)
+        f.close()
+        return list(out)
+
+    res = run_ranks(2, fn)
+    out = res[0]
+    assert out[:8] == [1.0] * 8
+    assert out[8:24] == [9.0] * 16      # hole untouched
+    assert out[24:32] == [2.0] * 8
+
+
+def test_read_all_sparse_views(tmp_path):
+    path = str(tmp_path / "rsparse.bin")
+
+    def fn(comm):
+        f = mpiio.open(comm, path, RW)
+        if comm.rank == 0:
+            f.write_at(0, np.arange(32, dtype=np.float64))
+        f.sync()
+        comm.Barrier()
+        ft = dt.resized(dt.DOUBLE, 0, comm.size * 8)
+        f.set_view(comm.rank * 8, dt.DOUBLE, ft)
+        mine = np.zeros(32 // comm.size)
+        f.read_at_all(0, mine)
+        f.close()
+        return list(mine)
+
+    res = run_ranks(4, fn)
+    for rank, got in enumerate(res):
+        assert got == [float(rank + 4 * i) for i in range(8)]
