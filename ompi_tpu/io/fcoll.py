@@ -37,8 +37,10 @@ def _plan(file, offset: int, nbytes: int):
     Collective: every rank learns the aggregate [lo, hi) range."""
     comm = file.comm
     segs = file.view.map_bytes(offset, nbytes)
-    lo = segs[0][0] if segs else np.iinfo(np.int64).max
-    hi = segs[-1][0] + segs[-1][1] if segs else 0
+    # interleaved views (extent < true_ub) can emit out-of-order
+    # offsets across tiles, so the hull needs min/max, not ends
+    lo = min(o for o, _ in segs) if segs else np.iinfo(np.int64).max
+    hi = max(o + ln for o, ln in segs) if segs else 0
     from ompi_tpu.op import op as opmod
     mine = np.array([lo, -hi], dtype=np.int64)
     mn = np.empty(2, dtype=np.int64)
@@ -121,6 +123,19 @@ def _merge_intervals(ivs):
     return out
 
 
+def _interval_lookup(merged):
+    """merged disjoint (lo, hi) intervals → fn(off) = (index, off-lo).
+    Callers guarantee every queried (off, len) lies wholly inside one
+    interval (pieces/requests were merged from the same inputs)."""
+    from bisect import bisect_right
+    starts = [lo for lo, _ in merged]
+
+    def locate(off: int):
+        i = bisect_right(starts, off) - 1
+        return i, off - starts[i]
+    return locate
+
+
 def write_all(file, offset: int, spec) -> Status:
     comm = file.comm
     buf, count, dt = file._spec(spec)
@@ -149,12 +164,13 @@ def write_all(file, offset: int, spec) -> Status:
         reqs.append(pml.isend(data, data.size, dtmod.BYTE, a, T_DATA,
                               comm))
 
-    # aggregator role: overlay received pieces into a partition-sized
-    # buffer, then write only the covered intervals — holes are never
-    # touched, so no read-modify-write (and no pread on WRONLY files)
+    # aggregator role: collect every rank's pieces, then allocate one
+    # buffer per *covered* interval (never the whole partition span —
+    # sparse writes at far-apart offsets must not allocate span/nagg
+    # bytes) and write only those intervals.  Holes are never touched,
+    # so no read-modify-write (and no pread on WRONLY files).
     if comm.rank < nagg:
-        plo, phi = parts[comm.rank]
-        region = bytearray(phi - plo)
+        pieces: List[Tuple[int, np.ndarray]] = []  # (abs_off, bytes)
         covered = []
         for src in range(comm.size):
             meta = _recv_meta(pml, src, comm)
@@ -163,12 +179,18 @@ def write_all(file, offset: int, spec) -> Status:
             pml.recv(data, total, dtmod.BYTE, src, T_DATA, comm)
             o = 0
             for off, ln in _iter_meta(meta):
-                region[off - plo:off - plo + ln] = data[o:o + ln].tobytes()
+                pieces.append((off, data[o:o + ln]))
                 covered.append((off, off + ln))
                 o += ln
-        for lo, hi in _merge_intervals(covered):
-            file._pwrite_segs([(lo, hi - lo)],
-                              memoryview(bytes(region[lo - plo:hi - plo])))
+        merged = _merge_intervals(covered)
+        if merged:
+            locate = _interval_lookup(merged)
+            regions = [bytearray(hi - lo) for lo, hi in merged]
+            for off, piece in pieces:  # later sources win, as received
+                i, o = locate(off)
+                regions[i][o:o + len(piece)] = piece.data
+            for (lo, hi), region in zip(merged, regions):
+                file._pwrite_segs([(lo, hi - lo)], memoryview(region))
     for r in reqs:
         r.wait()
     comm.Barrier()  # write_all is collective: data visible on return
@@ -198,28 +220,47 @@ def read_all(file, offset: int, spec) -> Status:
         reqs.append(pml.isend(meta, meta.size, dtmod.INT64_T, a, T_META,
                               comm))
 
-    # serve phase: aggregator preads its partition once, answers each
-    # rank's request list from memory
+    # serve phase: aggregator collects every request list first, preads
+    # only the union of requested intervals (never the whole partition
+    # — sparse reads must not allocate or read span/nagg bytes), and
+    # answers each rank from memory.  Per-interval actual read counts
+    # from _pread_segs_counted give true EOF byte counts, which travel
+    # back with the data so Status.count matches the individual path.
     if comm.rank < nagg:
-        plo, phi = parts[comm.rank]
-        region = file._pread_segs([(plo, phi - plo)]) if phi > plo \
-            else b""
-        for src in range(comm.size):
-            meta = _recv_meta(pml, src, comm)
-            resp = bytearray()
+        metas = [_recv_meta(pml, src, comm) for src in range(comm.size)]
+        wanted = _merge_intervals(
+            [(off, off + ln) for m in metas for off, ln in _iter_meta(m)])
+        locate = _interval_lookup(wanted)
+        regions: List[bytes] = []
+        avail: List[int] = []          # readable end of each interval
+        for lo, hi in wanted:
+            data_i, actual = file._pread_segs_counted([(lo, hi - lo)])
+            regions.append(data_i)
+            avail.append(lo + actual)
+        for src, meta in enumerate(metas):
+            # response = 8-byte true-count header + the padded data,
+            # one message (the count must not double T_BACK traffic)
+            resp = bytearray(8)
+            got = 0
             for off, ln in _iter_meta(meta):
-                resp += region[off - plo:off - plo + ln]
+                i, o = locate(off)
+                resp += regions[i][o:o + ln]
+                got += max(0, min(off + ln, avail[i]) - off)
+            resp[:8] = np.int64(got).tobytes()
             arr = np.frombuffer(bytes(resp), dtype=np.uint8)
             reqs.append(pml.isend(arr, arr.size, dtmod.BYTE, src, T_BACK,
                                   comm))
 
     # gather phase: collect the slices back, in aggregator order
     out = np.empty(nbytes, dtype=np.uint8)
+    true_count = 0
     for a in range(nagg):
         items = per[a]
         total = sum(ln for _, ln, _ in items)
-        data = np.empty(total, dtype=np.uint8)
-        pml.recv(data, total, dtmod.BYTE, a, T_BACK, comm)
+        data = np.empty(total + 8, dtype=np.uint8)
+        pml.recv(data, total + 8, dtmod.BYTE, a, T_BACK, comm)
+        true_count += int(data[:8].view(np.int64)[0])
+        data = data[8:]
         o = 0
         for off, ln, dpos in items:
             out[dpos:dpos + ln] = data[o:o + ln]
@@ -230,5 +271,5 @@ def read_all(file, offset: int, spec) -> Status:
         r.wait()
     comm.Barrier()
     st = Status()
-    st.count = nbytes
+    st.count = true_count
     return st

@@ -48,14 +48,47 @@ class FileView:
                              "size (MPI_ERR_ARG)")
         self.segs = _flatten(self.filetype)
         self.tile_bytes = sum(ln for _, ln in self.segs)  # data per tile
-        self.tile_extent = max(self.filetype.extent,
-                               self.filetype.true_ub)
+        # the filetype's extent IS the tile stride — a resized type may
+        # legally have extent < true_ub as long as consecutive tiles'
+        # data segments interleave without overlapping
+        self.tile_extent = self.filetype.extent
         if self.tile_bytes != self.filetype.size:
             raise ValueError("overlapping filetype segments")
+        self._check_tile_overlap()
         # prefix sums of segment data bytes for O(log n) seek
         self._prefix = [0]
         for _, ln in self.segs:
             self._prefix.append(self._prefix[-1] + ln)
+
+    def _check_tile_overlap(self) -> None:
+        """Tiles repeat every ``extent`` bytes, so byte b of tile k
+        lands at b + k*extent: two tiles collide iff two data bytes of
+        one tile are congruent mod extent.  Fold every segment into
+        [0, extent) and require the folded intervals to be disjoint —
+        this accepts legal interleavings (e.g. data [0,4)+[12,16) with
+        extent 8) and rejects genuine overlaps (MPI_ERR_TYPE)."""
+        if not self.segs:
+            return
+        e = self.tile_extent
+        if e <= 0 or self.tile_bytes > e:
+            raise ValueError(
+                f"filetype tiles overlap: {self.tile_bytes} data bytes "
+                f"per tile exceed the {e}-byte tile extent (MPI_ERR_TYPE)")
+        folded: List[Tuple[int, int]] = []
+        for off, ln in self.segs:
+            off %= e
+            while ln > 0:
+                take = min(ln, e - off)
+                folded.append((off, take))
+                ln -= take
+                off = 0
+        folded.sort()
+        for (o1, l1), (o2, _) in zip(folded, folded[1:]):
+            if o1 + l1 > o2:
+                raise ValueError(
+                    "filetype tiles overlap: data bytes at offsets "
+                    f"{o2} and {o1}+{l1} collide mod the {e}-byte "
+                    "extent (MPI_ERR_TYPE)")
 
     def is_contiguous(self) -> bool:
         return (len(self.segs) == 1

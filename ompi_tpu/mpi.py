@@ -140,8 +140,10 @@ def MPI_Comm_group(comm):
 def MPI_Comm_compare(a, b) -> str:
     if a is b:
         return "ident"
-    if a.group == b.group:
-        return "congruent" if a.rank == b.rank else "similar"
+    if a.group == b.group:      # same members, same order
+        return "congruent"
+    if sorted(a.group) == sorted(b.group):  # same members, reordered
+        return "similar"
     return "unequal"
 
 

@@ -111,6 +111,8 @@ def wait_any(reqs: List[Request]) -> int:
 
 
 def wait_some(reqs: List[Request]) -> List[int]:
+    if not reqs:
+        return []
     while True:
         done = [i for i, r in enumerate(reqs) if r.complete or r.test()]
         if done:

@@ -473,3 +473,80 @@ def test_read_all_sparse_views(tmp_path):
     res = run_ranks(4, fn)
     for rank, got in enumerate(res):
         assert got == [float(rank + 4 * i) for i in range(8)]
+
+
+def test_view_resized_smaller_extent_than_true_ub():
+    # data at [8,16) with extent 8: legal resized type whose extent is
+    # below its true_ub — tiles stride by 8 and interleave cleanly
+    # (advisor round-1 finding: the stride must be extent, not true_ub)
+    ft = dt.resized(dt.indexed_block(1, [1], dt.DOUBLE), 0, 8)
+    v = FileView(0, dt.DOUBLE, ft)
+    assert v.tile_extent == 8
+    assert v.map_bytes(0, 8) == [(8, 8)]
+    assert v.map_bytes(1, 8) == [(16, 8)]
+
+
+def test_view_rejects_truly_overlapping_tiles():
+    # data at [0,16) but extent 8: tile 1's data starts at 8, inside
+    # tile 0's data — a genuine overlap, MPI_ERR_TYPE
+    with pytest.raises(ValueError):
+        FileView(0, dt.DOUBLE, dt.resized(dt.contiguous(2, dt.DOUBLE),
+                                          0, 8))
+
+
+def test_write_all_sparse_far_apart_offsets(tmp_path):
+    # 1 double at offset 0 and 1 double 256 GiB away: aggregation must
+    # allocate covered intervals only — a regression back to
+    # partition-span allocation would try a ~128 GiB bytearray per
+    # aggregator and die, so the distance itself pins the behavior
+    path = str(tmp_path / "sparse_far.bin")
+    FAR = 1 << 38
+
+    def fn(comm):
+        f = mpiio.open(comm, path, RW)
+        f.set_view(0, dt.DOUBLE)
+        f.write_at_all((comm.rank * FAR) // 8, np.full(1, comm.rank + 1.0))
+        f.sync()
+        comm.Barrier()
+        out0 = np.zeros(1)
+        out1 = np.zeros(1)
+        f.read_at(0, out0)
+        f.read_at(FAR // 8, out1)
+        f.close()
+        return (out0[0], out1[0])
+
+    res = run_ranks(2, fn)
+    assert res[0] == (1.0, 2.0)
+    assert res[1] == (1.0, 2.0)
+
+
+def test_read_all_true_eof_counts(tmp_path):
+    # collective read past EOF must report the true byte count, like
+    # the individual path (advisor round-1 finding)
+    path = str(tmp_path / "eofcnt.bin")
+
+    def fn(comm):
+        f = mpiio.open(comm, path, RW)
+        f.set_view(0, dt.DOUBLE)
+        if comm.rank == 0:
+            f.write_at(0, np.arange(6, dtype=np.float64))  # 48 bytes
+        f.sync()
+        comm.Barrier()
+        mine = np.zeros(4, dtype=np.float64)
+        # rank 0 reads [0,32) fully; rank 1 reads [32,64) but EOF=48
+        st = f.read_at_all(comm.rank * 4, mine)
+        f.close()
+        return st.count
+
+    res = run_ranks(2, fn)
+    assert res[0] == 32
+    assert res[1] == 16
+
+
+def test_view_legal_interleaved_tiles():
+    # data at [0,4)+[12,16) with extent 8: tile k's bytes fold to
+    # distinct residues mod 8, so consecutive tiles interleave without
+    # overlap — must be accepted, and map_bytes must walk it correctly
+    ft = dt.resized(dt.indexed_block(1, [0, 3], dt.INT32_T), 0, 8)
+    v = FileView(0, dt.INT32_T, ft)
+    assert v.map_bytes(0, 16) == [(0, 4), (12, 4), (8, 4), (20, 4)]
