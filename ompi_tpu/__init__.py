@@ -38,10 +38,20 @@ def init(device=None):
     if existing is not None and existing.initialized \
             and not existing.finalized:
         return existing.comm_world
+    from ompi_tpu.runtime.rte import HybridRTE
+
     rte = make_rte()
     st = statemod.ProcState(rte.rank, rte.size, rte)
+    if device is None:
+        # hybrid launch: the app shell pre-assigned this rank-thread a
+        # local chip (mpirun --ranks-per-proc, see tools/hostrun.py)
+        device = getattr(rte, "default_device", None)
     mpi_init(st, device=device)  # publishes into rte.world itself
-    statemod.set_current(st, process_wide=True)
+    # process-wide publication is a convenience for single-rank
+    # processes only; with co-resident rank-threads it would hand an
+    # arbitrary rank's state to non-rank threads (last writer wins)
+    # instead of the clean not-initialized error
+    statemod.set_current(st, process_wide=not isinstance(rte, HybridRTE))
     return st.comm_world
 
 

@@ -36,7 +36,9 @@ class InprocModule(BTLModule):
         self.max_send_size = 4 * 1024 * 1024
 
     def reaches(self, peer: int) -> bool:
-        return 0 <= peer < self.world.size
+        # HybridWorld: only the rank-threads of THIS process; remote
+        # ranks go through shm/tcp picked at wire_endpoints
+        return self.world.is_local(peer)
 
     def send(self, peer: int, frag: Any) -> None:
         peer_state = self.world.states[peer]

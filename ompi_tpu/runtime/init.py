@@ -33,11 +33,15 @@ def mpi_init(state: ProcState, device=None) -> ProcState:
     for c in btl_base.btl_framework.components():
         modules += c.init_modules(state)
     state.btls = modules
-    # publish our state for inproc peers, then fence (modex sync #1,
-    # ref: ompi_mpi_init.c:654-661)
+    # publish our state for inproc peers + our device assignment for
+    # the job (VERDICT r1 #2: device ids ride the modex so launchers /
+    # future cross-host device planes can see the chip map), then
+    # fence (modex sync #1, ref: ompi_mpi_init.c:654-661)
     world = getattr(state.rte, "world", None)
     if world is not None:
         world.states[state.rank] = state
+    if device is not None:
+        state.rte.modex_put("device_id", int(device.id))
     state.rte.fence()
     endpoints = btl_base.wire_endpoints(state, modules)
     state.pml.add_procs(endpoints)

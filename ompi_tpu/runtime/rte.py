@@ -57,8 +57,35 @@ class InprocWorld:
         self.shared: Dict[Any, Any] = {}
         self.shared_lock = threading.Lock()
 
+    def is_local(self, rank: int) -> bool:
+        """Is `rank` a thread in this process (inproc-btl reachable,
+        device-rendezvous capable)?"""
+        return 0 <= rank < self.size
+
     def make_rte(self, rank: int) -> "InprocRTE":
         return InprocRTE(self, rank)
+
+
+class HybridWorld(InprocWorld):
+    """Shared state for the hybrid launch model: one process per host
+    owning a contiguous block of rank-threads, with more such
+    processes elsewhere in the job (see docs/DESIGN.md).  `states` is
+    indexed by GLOBAL rank — entries for remote ranks stay None, which
+    is exactly what makes comm.mesh() refuse comms that span hosts
+    (they fall back to the host-staged p2p path until the DCN device
+    plane exists)."""
+
+    def __init__(self, world_size: int, rank_base: int, nlocal: int) -> None:
+        super().__init__(nlocal)
+        self.size = world_size
+        self.rank_base = rank_base
+        self.nlocal = nlocal
+        self.states = [None] * world_size
+        # local barrier deliberately sized nlocal (threading.Barrier in
+        # super().__init__) — global fences go through the KV server
+
+    def is_local(self, rank: int) -> bool:
+        return self.rank_base <= rank < self.rank_base + self.nlocal
 
 
 class InprocRTE(RTE):
@@ -133,12 +160,64 @@ class EnvRTE(RTE):
         self.kv.close()
 
 
+class HybridRTE(EnvRTE):
+    """Rank-thread runtime for the hybrid launch model: global modex /
+    fence / abort through the launcher's KV server (EnvRTE behavior),
+    plus a HybridWorld shared with co-resident rank-threads so the
+    inproc btl and the device-collective rendezvous work across them.
+    This is how coll/tpu becomes reachable from a real mpirun job: the
+    per-host app shell (ompi_tpu.tools.hostrun) builds one of these
+    per rank-thread (ref: the per-node orted owning its local procs,
+    orte/orted/orted_main.c — except local 'procs' are threads
+    driving local chips)."""
+
+    def __init__(self, world: HybridWorld, rank: int, kv_addr: str,
+                 node_id: int = 0, jobid: str = "job0",
+                 session_dir: str = "/tmp") -> None:
+        from .kvstore import KVClient  # noqa: PLC0415
+
+        # no super().__init__(): identity comes from the app shell's
+        # arguments, not per-process env vars (threads share env)
+        self.world = world
+        self.rank = rank
+        self.size = world.size
+        self.jobid = jobid
+        self.node_id = node_id
+        self.session_dir = session_dir
+        self.kv = KVClient(kv_addr)
+        self.default_device: Any = None
+        self._fence_count = 0
+
+    def abort(self, code: int, msg: str = "") -> None:
+        # flag local rank-threads first so parked rendezvous/progress
+        # loops see the abort before the process dies
+        self.world.aborted = (self.rank, code, msg)
+        for st in self.world.states:
+            if st is not None and getattr(st, "progress", None) is not None:
+                st.progress.wakeup()
+        EnvRTE.abort(self, code, msg)
+
+
+_tls_rte = threading.local()
+
+
+def set_thread_rte(rte: Optional[RTE]) -> None:
+    """Install the RTE the next make_rte() on THIS thread returns —
+    the hook the hostrun app shell uses to hand each rank-thread its
+    pre-built HybridRTE before running the user program."""
+    _tls_rte.rte = rte
+
+
 def make_rte() -> RTE:
     """Bootstrap this process's runtime (ess component selection
-    analog, ref: orte/mca/ess): launched by our mpirun → EnvRTE;
-    standalone → singleton world of size 1."""
+    analog, ref: orte/mca/ess): app-shell rank-thread → injected
+    HybridRTE; launched by our mpirun → EnvRTE; standalone →
+    singleton world of size 1."""
     import os
 
+    injected = getattr(_tls_rte, "rte", None)
+    if injected is not None:
+        return injected
     if "TPUMPI_KV_ADDR" in os.environ:
         return EnvRTE()
     world = InprocWorld(1)

@@ -54,9 +54,41 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--timeout", type=float, default=0.0,
                     help="Kill the job after SEC seconds")
     ap.add_argument("--wdir", default=None)
+    def _rpp_arg(v: str):
+        if v == "all":
+            return v
+        try:
+            n = int(v)
+        except ValueError:
+            raise argparse.ArgumentTypeError(
+                f"expected an integer or 'all', got {v!r}") from None
+        if n < 1:
+            raise argparse.ArgumentTypeError("must be >= 1")
+        return n
+
+    ap.add_argument("--ranks-per-proc", default=1, dest="rpp",
+                    type=_rpp_arg,
+                    help="Rank-threads per app-shell process: an int, "
+                         "or 'all' for one process owning every rank "
+                         "(the TPU-host model — required for coll/tpu "
+                         "device collectives; see docs/DESIGN.md)")
+    ap.add_argument("--devices", default="auto",
+                    choices=("auto", "none"),
+                    help="Assign local jax devices to rank-threads "
+                         "(hybrid mode only)")
     ap.add_argument("prog")
     ap.add_argument("args", nargs=argparse.REMAINDER)
     opts = ap.parse_args(argv)
+    rpp = opts.np if opts.rpp == "all" else opts.rpp
+    # 'all' always means hybrid (even -np 1: device assignment and the
+    # app shell still apply); an explicit integer 1 means one process
+    # per rank, the classic model
+    hybrid = opts.rpp == "all" or rpp > 1
+    if hybrid and not opts.prog.endswith(".py"):
+        sys.stderr.write(
+            "mpirun: --ranks-per-proc > 1 requires a Python "
+            "program (ranks run as threads of the app shell)\n")
+        return 2
 
     session = tempfile.mkdtemp(prefix="tpumpi-session-")
     server = KVServer(opts.np)
@@ -87,19 +119,46 @@ def main(argv: Optional[List[str]] = None) -> int:
     for key, value in opts.mca:
         env_base[f"TPUMPI_MCA_{key}"] = value
 
+    # hybrid mode: one app-shell process per block of rpp ranks, each
+    # running its ranks as threads (the TPU-host execution model)
+    if hybrid:
+        spawn_specs = []
+        base = 0
+        node = 0
+        while base < opts.np:
+            n = min(rpp, opts.np - base)
+            spawn_specs.append((base, n, node))
+            base += n
+            node += 1
+        env_base["TPUMPI_DEVICES"] = opts.devices
+    else:
+        spawn_specs = [(rank, 0, rank) for rank in range(opts.np)]
+
     try:
-        for rank in range(opts.np):
+        for base, nlocal, node in spawn_specs:
             env = dict(env_base)
-            env["TPUMPI_RANK"] = str(rank)
+            if nlocal:  # app shell owning ranks [base, base+nlocal)
+                env["TPUMPI_RANK_BASE"] = str(base)
+                env["TPUMPI_LOCAL_RANKS"] = str(nlocal)
+                env["TPUMPI_LOCAL_SIZE"] = str(nlocal)
+                env["TPUMPI_NODE"] = str(node)
+                cmd = [sys.executable, "-m", "ompi_tpu.tools.hostrun",
+                       opts.prog] + opts.args
+                tag = f"{base}-{base + nlocal - 1}" if nlocal > 1 \
+                    else f"{base}"
+            else:
+                env["TPUMPI_RANK"] = str(base)
+                cmd = base_cmd
+                tag = f"{base}"
             p = subprocess.Popen(
-                base_cmd, env=env, cwd=opts.wdir,
+                cmd, env=env, cwd=opts.wdir,
                 stdout=subprocess.PIPE, stderr=subprocess.PIPE)
             procs.append(p)
             for stream, out in ((p.stdout, sys.stdout.buffer),
                                 (p.stderr, sys.stderr.buffer)):
                 t = threading.Thread(
                     target=_forward,
-                    args=(stream, out, f"{rank}", opts.tag_output),
+                    args=(stream, out, tag, opts.tag_output),
                     daemon=True)
                 t.start()
                 fwd_threads.append(t)
@@ -120,9 +179,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             if failed:
                 p = failed[0]
                 exit_code = p.returncode if p.returncode > 0 else 1
-                rank = procs.index(p)
+                base, nlocal, _ = spawn_specs[procs.index(p)]
+                who = f"rank {base}" if nlocal <= 1 else \
+                    f"ranks {base}-{base + nlocal - 1}"
                 sys.stderr.write(
-                    f"mpirun: rank {rank} exited with status "
+                    f"mpirun: {who} exited with status "
                     f"{p.returncode}; terminating remaining "
                     f"{len(alive)} processes\n")
                 break

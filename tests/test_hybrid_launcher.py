@@ -1,0 +1,88 @@
+"""Hybrid-launch integration tests: mpirun --ranks-per-proc spawns
+per-host app shells (ompi_tpu.tools.hostrun) whose rank-threads drive
+devices, making coll/tpu reachable from a real launch (VERDICT r1 #2;
+ref: selection must work on real jobs, coll_base_comm_select.c:51-58).
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def mpirun(np, prog, *args, rpp="all", devices=None, timeout=150,
+           extra=()):
+    cmd = [sys.executable, "-m", "ompi_tpu.tools.mpirun", "-np", str(np),
+           "--ranks-per-proc", str(rpp)]
+    if devices:
+        cmd += ["--devices", devices]
+    cmd += list(extra)
+    cmd += [os.path.join(REPO, "examples", prog), *args]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    return subprocess.run(cmd, capture_output=True, timeout=timeout,
+                          env=env, cwd=REPO)
+
+
+def test_device_collectives_offloaded_under_mpirun():
+    """The north-star gate: a real mpirun job reports
+    coll_tpu_offloaded_collectives > 0."""
+    r = mpirun(8, "device_allreduce.py")
+    assert r.returncode == 0, (r.stdout.decode(), r.stderr.decode())
+    out = r.stdout.decode()
+    line = [ln for ln in out.splitlines()
+            if ln.startswith("coll_tpu_offloaded_collectives=")]
+    assert line, out
+    assert int(line[0].split("=")[1]) > 0
+    for k in range(8):
+        assert f"rank {k} ok" in out
+
+
+def test_hybrid_two_shells_ring():
+    """Two app shells (simulated hosts): cross-process p2p via tcp,
+    in-process via the inproc btl."""
+    r = mpirun(4, "ring.py", rpp=2, devices="none")
+    assert r.returncode == 0, r.stderr.decode()
+    assert "received token 7 from 3" in r.stdout.decode()
+
+
+def test_hybrid_two_shells_connectivity():
+    r = mpirun(4, "connectivity.py", rpp=2, devices="none")
+    assert r.returncode == 0, r.stderr.decode()
+    assert "PASSED" in r.stdout.decode()
+
+
+def test_hybrid_rank_failure_kills_job():
+    """A rank-thread failing is the thread analog of a rank process
+    dying: the shell reports it to the launcher, whose errmgr policy
+    terminates the job (nonzero) instead of hanging peers."""
+    import tempfile
+    import textwrap
+
+    with tempfile.TemporaryDirectory() as d:
+        prog = os.path.join(d, "fail_one.py")
+        with open(prog, "w") as f:
+            f.write(textwrap.dedent("""
+                import ompi_tpu
+                comm = ompi_tpu.init()
+                if comm.rank == 1:
+                    raise RuntimeError("boom on rank 1")
+                import numpy as np
+                x = np.zeros(1, np.int32)
+                comm.Allreduce(x, x)
+                ompi_tpu.finalize()
+            """))
+        cmd = [sys.executable, "-m", "ompi_tpu.tools.mpirun", "-np", "4",
+               "--ranks-per-proc", "all", "--devices", "none",
+               "--timeout", "60", prog]
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env["JAX_PLATFORMS"] = "cpu"
+        r = subprocess.run(cmd, capture_output=True, timeout=120,
+                           env=env, cwd=REPO)
+        assert r.returncode != 0
+        assert r.returncode != 124, "job hung until --timeout"
+        assert "boom on rank 1" in r.stderr.decode()
