@@ -35,6 +35,11 @@ _max_send_var = registry.register(
     "btl", "tcp", "max_send_size", 128 * 1024, int,
     help="Rendezvous segment size over TCP "
          "(ref: btl_tcp_component.c:304)")
+_if_ip_var = registry.register(
+    "btl", "tcp", "if_ip", "", str,
+    help="IP to advertise for inbound btl connections (the opal if/"
+         "reachable analog; set per-node by the tpud daemon from the "
+         "route toward the HNP).  Empty = loopback, single-host.")
 
 
 class _Conn:
@@ -58,15 +63,18 @@ class TcpModule(BTLModule):
         self.max_send_size = _max_send_var.value
         self.rank = state.rank
         self.sel = selectors.DefaultSelector()
+        if_ip = _if_ip_var.value or "127.0.0.1"
         self.listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self.listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self.listener.bind(("127.0.0.1", 0))
+        # bind the advertised IP itself: cross-host peers dial it, and
+        # loopback-only jobs never open a network-reachable port
+        self.listener.bind((if_ip, 0))
         self.listener.listen(state.size * 2)
         self.listener.setblocking(False)
         self.sel.register(self.listener, selectors.EVENT_READ,
                           ("accept", None))
         port = self.listener.getsockname()[1]
-        state.rte.modex_put("btl_tcp_addr", f"127.0.0.1:{port}")
+        state.rte.modex_put("btl_tcp_addr", f"{if_ip}:{port}")
         self._out: Dict[int, _Conn] = {}
         self._in: List[_Conn] = []
         state.progress.register(self.progress)

@@ -45,7 +45,11 @@ def _recv_msg(sock: socket.socket) -> Optional[dict]:
 class KVServer:
     """Runs inside the launcher (the HNP role)."""
 
-    def __init__(self, nprocs: int, host: str = "127.0.0.1") -> None:
+    def __init__(self, nprocs: int, host: str = "127.0.0.1",
+                 advertise: Optional[str] = None) -> None:
+        """``host`` is the bind address (0.0.0.0 for multi-host jobs);
+        ``advertise`` is the address clients are told to dial (the
+        HNP's reachable IP when binding wildcard)."""
         self.nprocs = nprocs
         self.data: Dict[str, Any] = {}
         self.lock = threading.Lock()
@@ -57,7 +61,8 @@ class KVServer:
         self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self.sock.bind((host, 0))
         self.sock.listen(nprocs * 4)
-        self.addr = f"{host}:{self.sock.getsockname()[1]}"
+        self.addr = (f"{advertise or host}:"
+                     f"{self.sock.getsockname()[1]}")
         self._threads: List[threading.Thread] = []
         self._stop = False
         self._accept_thread = threading.Thread(target=self._accept_loop,

@@ -1,0 +1,105 @@
+"""OOB/RML analog: tag-dispatched control messaging between the
+launcher (HNP) and per-node daemons.
+
+Re-design of orte/mca/oob/tcp + orte/mca/rml (tag-based async
+send_nb/recv_nb, ref: orte/mca/rml/rml.h:204,263): one TCP socket per
+daemon⇄HNP pair, frames of 4-byte big-endian length + JSON, a reader
+thread per channel dispatching on the message's "op" field.  The
+control plane never carries data-plane traffic (that is the btl's
+job), so JSON framing is fine; byte payloads (IOF lines) travel
+latin-1-escaped.
+
+Unlike the reference there is no routing overlay in the message path:
+daemons connect directly to the HNP (the routed/direct component
+model), while the *launch* may still fan out as a tree (plm tree
+spawn, see tools/plm.py).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Any, Callable, Optional
+
+from .kvstore import _recv_msg, _send_msg
+
+
+class Channel:
+    """One framed bidirectional control connection.  ``send`` is
+    thread-safe; inbound messages are dispatched from a dedicated
+    reader thread to ``handler(msg)``; EOF/error fires
+    ``on_close(exc_or_none)`` exactly once."""
+
+    def __init__(self, sock: socket.socket,
+                 handler: Callable[[dict], None],
+                 on_close: Optional[Callable[[Optional[Exception]], None]]
+                 = None) -> None:
+        self.sock = sock
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.handler = handler
+        self.on_close = on_close
+        self._wlock = threading.Lock()
+        self._closed = False
+        self._reader = threading.Thread(target=self._read_loop, daemon=True)
+        self._reader.start()
+
+    def _read_loop(self) -> None:
+        exc: Optional[Exception] = None
+        try:
+            while True:
+                msg = _recv_msg(self.sock)
+                if msg is None:
+                    break
+                self.handler(msg)
+        except OSError as e:
+            exc = e
+        finally:
+            closed_now = False
+            with self._wlock:
+                if not self._closed:
+                    self._closed = True
+                    closed_now = True
+            if closed_now and self.on_close is not None:
+                self.on_close(exc)
+
+    def send(self, msg: dict) -> None:
+        with self._wlock:
+            if self._closed:
+                raise ConnectionError("oob channel closed")
+            _send_msg(self.sock, msg)
+
+    def close(self) -> None:
+        with self._wlock:
+            self._closed = True
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+def connect(addr: str, handler: Callable[[dict], None],
+            on_close: Optional[Callable[[Optional[Exception]], None]] = None,
+            timeout: float = 60.0) -> Channel:
+    host, port = addr.rsplit(":", 1)
+    s = socket.create_connection((host, int(port)), timeout=timeout)
+    s.settimeout(None)
+    return Channel(s, handler, on_close)
+
+
+def local_ip_toward(addr: str) -> str:
+    """The IP this host would use to reach ``addr`` (the opal if/
+    reachable analog collapsed to the UDP-connect trick: no packet is
+    sent, the kernel just picks the route's source address)."""
+    host, port = addr.rsplit(":", 1)
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect((host, int(port)))
+        return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+    finally:
+        s.close()

@@ -1,0 +1,123 @@
+"""RMAPS analog: map ranks onto the allocated nodes.
+
+Re-design of orte/mca/rmaps (round_robin component's byslot/bynode
+policies, ref: orte/mca/rmaps/round_robin): the map is the launch
+blueprint shipped to each node's daemon.  Two shapes per node:
+
+  * classic — one process per rank (blocks of nlocal=0 below);
+  * hybrid  — rank-threads grouped into app shells of ``rpp`` ranks
+    (the TPU-host model; requires *contiguous* global ranks per shell,
+    which is why bynode mapping is rejected when rpp > 1).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List
+
+from .ras import Node
+
+
+@dataclass
+class ProcSpec:
+    """One local launch unit on a node: a single rank process
+    (nlocal == 0) or an app shell owning ranks
+    [rank_base, rank_base + nlocal)."""
+
+    rank_base: int
+    nlocal: int  # 0 = classic single-rank process
+
+
+@dataclass
+class NodeMap:
+    node: Node
+    procs: List[ProcSpec] = field(default_factory=list)
+
+    @property
+    def ranks(self) -> List[int]:
+        out: List[int] = []
+        for p in self.procs:
+            out += list(range(p.rank_base,
+                              p.rank_base + max(1, p.nlocal)))
+        return out
+
+
+def map_ranks(nodes: List[Node], np: int, rpp: int = 1,
+              policy: str = "byslot",
+              oversubscribe: bool = False) -> List[NodeMap]:
+    """Produce the job map.  ``rpp`` > 1 selects hybrid shells of that
+    many rank-threads (capped per node by its slot count and the ranks
+    assigned to it)."""
+    total_slots = sum(n.slots for n in nodes)
+    if np > total_slots and not oversubscribe:
+        raise ValueError(
+            f"not enough slots: {np} ranks > {total_slots} slots "
+            f"(use --oversubscribe)")
+    if policy not in ("byslot", "bynode"):
+        raise ValueError(f"unknown mapping policy {policy!r}")
+    if rpp > 1 and policy == "bynode":
+        raise ValueError(
+            "--ranks-per-proc > 1 requires byslot mapping (app shells "
+            "own contiguous rank blocks)")
+
+    # ranks → nodes
+    per_node: List[List[int]] = [[] for _ in nodes]
+    if policy == "byslot":
+        # within capacity: fill each node to its slots in order.
+        # oversubscribed: contiguous slot-proportional shares (largest-
+        # remainder), preserving the per-node contiguity the hybrid
+        # shells rely on.
+        if np <= total_slots:
+            shares = []
+            left = np
+            for n in nodes:
+                take = min(n.slots, left)
+                shares.append(take)
+                left -= take
+        else:
+            shares = [np * n.slots // total_slots for n in nodes]
+            rema = sorted(
+                range(len(nodes)),
+                key=lambda i: (-(np * nodes[i].slots % total_slots), i))
+            for i in rema[:np - sum(shares)]:
+                shares[i] += 1
+        rank = 0
+        for i, take in enumerate(shares):
+            per_node[i] = list(range(rank, rank + take))
+            rank += take
+    else:  # bynode round-robin
+        i = 0
+        counts = [0] * len(nodes)
+        for rank in range(np):
+            # next node with free slots, else plain round-robin when
+            # oversubscribed
+            tries = 0
+            while tries < len(nodes) and counts[i] >= nodes[i].slots \
+                    and any(c < n.slots for c, n in zip(counts, nodes)):
+                i = (i + 1) % len(nodes)
+                tries += 1
+            per_node[i].append(rank)
+            counts[i] += 1
+            i = (i + 1) % len(nodes)
+
+    # ranks → launch units
+    maps: List[NodeMap] = []
+    for node, ranks in zip(nodes, per_node):
+        nm = NodeMap(node=node)
+        if ranks:
+            if rpp > 1:
+                # contiguity invariant for HybridWorld
+                if ranks != list(range(ranks[0], ranks[0] + len(ranks))):
+                    raise ValueError(
+                        "hybrid shells need contiguous ranks per node")
+                base = ranks[0]
+                left = len(ranks)
+                while left > 0:
+                    n = min(rpp, left)
+                    nm.procs.append(ProcSpec(rank_base=base, nlocal=n))
+                    base += n
+                    left -= n
+            else:
+                nm.procs = [ProcSpec(rank_base=r, nlocal=0) for r in ranks]
+        maps.append(nm)
+    return maps

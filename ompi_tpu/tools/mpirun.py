@@ -1,18 +1,23 @@
-"""mpirun: launch N process-ranks with KV wireup, IO forwarding and
-failure propagation.
+"""mpirun: launch N ranks with KV wireup, IO forwarding and failure
+propagation — single-host directly, multi-host through per-node
+daemons.
 
 Re-design of orterun/HNP (ref: orte/tools/orterun/main.c:13,
 orted_submit.c job construction; odls fork/exec
 ref: odls_default_module.c:338-437; IOF ref: orte/mca/iof; errmgr
 default-HNP kill-job-on-proc-death policy ref:
-orte/mca/errmgr/default_hnp).  Single-host for now: the launcher IS
-the daemon (fork/exec local); the KV server it hosts is the PMIx
-server role.  Multi-host ssh tree launch is the next stage of the
-plm analog.
+orte/mca/errmgr/default_hnp).  On the default single-local-node
+allocation the launcher IS the daemon (fork/exec local).  With
+--hosts/--hostfile/--simulate-nodes the PLM takes over: a radix tree
+of tpud daemons is launched (ssh agent or local subprocesses), each
+daemon runs its slice of the rmaps job map and relays IOF/exits back
+(see tools/plm.py, tools/tpud.py).
 
 Usage:
     python -m ompi_tpu.tools.mpirun -np 4 [--mca k v] [--tag-output]
-        [--timeout SEC] prog [args...]
+        [--timeout SEC] [--hosts a,b:4 | --hostfile F |
+        --simulate-nodes NxM] [--map-by byslot|bynode]
+        [--ranks-per-proc N|all] prog [args...]
 """
 
 from __future__ import annotations
@@ -43,6 +48,84 @@ def _forward(stream, out, tag: str, tag_output: bool) -> None:
             out.flush()
     except (OSError, ValueError):
         pass
+
+
+def run_multinode(opts, nodes, rpp: int, hybrid: bool) -> int:
+    """The PLM path: per-node daemons, rmaps job map, tree launch."""
+    from ompi_tpu.runtime import oob, rmaps
+    from ompi_tpu.tools.plm import HNP
+
+    try:
+        maps = rmaps.map_ranks(nodes, opts.np, rpp if hybrid else 1,
+                               policy=opts.map_by,
+                               oversubscribe=opts.oversubscribe)
+    except ValueError as e:
+        sys.stderr.write(f"mpirun: {e}\n")
+        return 2
+
+    any_remote = any(not (n.simulated or n.local) for n in nodes)
+    if any_remote:
+        hnp_ip = opts.hnp_ip or oob.local_ip_toward(
+            next(n.name for n in nodes
+                 if not (n.simulated or n.local)) + ":22")
+    else:
+        hnp_ip = "127.0.0.1"
+
+    import ompi_tpu as _pkg
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(
+        _pkg.__file__)))
+
+    server = KVServer(opts.np,
+                      host="0.0.0.0" if any_remote else "127.0.0.1",
+                      advertise=hnp_ip if any_remote else None)
+    hnp = HNP(maps, agent=opts.agent, python=sys.executable,
+              pythonpath=pkg_root, tree_radix=opts.tree_radix,
+              bind_all=any_remote)
+    hnp.tag_output = opts.tag_output
+
+    # per-node daemon env: simulator nodes get a fake M-chip mesh via
+    # a forced M-device CPU platform (ras/simulator analog)
+    node_env = {}
+    for n in nodes:
+        env = {}
+        if n.simulated and opts.devices != "none":
+            env["JAX_PLATFORMS"] = "cpu"
+            flags = os.environ.get("XLA_FLAGS", "")
+            env["XLA_FLAGS"] = (flags + " " if flags else "") + \
+                f"--xla_force_host_platform_device_count={n.sim_devices}"
+        node_env[n.node_id] = env
+
+    job_env = {
+        "TPUMPI_SIZE": str(opts.np),
+        "TPUMPI_KV_ADDR": server.addr,
+        "TPUMPI_JOBID": f"job-{os.getpid()}",
+    }
+    if hybrid:
+        job_env["TPUMPI_DEVICES"] = opts.devices
+    for key, value in opts.mca:
+        job_env[f"TPUMPI_MCA_{key}"] = value
+
+    exit_code = 0
+    failed = False
+    try:
+        hnp.spawn_daemons(hnp_ip, node_env)
+        if not hnp.wait_registered(timeout=max(90.0, opts.timeout)):
+            missing = ({m.node.node_id for m in maps}
+                       - set(hnp.channels))
+            sys.stderr.write(
+                f"mpirun: daemons on node(s) {sorted(missing)} never "
+                f"registered (lost: {sorted(hnp.lost_daemons)})\n")
+            failed = True
+            return 1
+        prog = os.path.abspath(opts.prog) if os.path.exists(opts.prog) \
+            else opts.prog
+        hnp.launch(prog, opts.args, job_env, opts.wdir)
+        exit_code = hnp.supervise(server, timeout=opts.timeout)
+        failed = exit_code != 0
+    finally:
+        hnp.shutdown(failed)
+        server.close()
+    return exit_code
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -76,6 +159,27 @@ def main(argv: Optional[List[str]] = None) -> int:
                     choices=("auto", "none"),
                     help="Assign local jax devices to rank-threads "
                          "(hybrid mode only)")
+    ap.add_argument("--hosts", default=None,
+                    help="Comma list of nodes, optional :slots "
+                         "(a,b:4,c)")
+    ap.add_argument("--hostfile", default=None,
+                    help="File of 'name [slots=N]' lines")
+    ap.add_argument("--simulate-nodes", default=None, dest="simulate",
+                    help="NxM: fake N nodes with M chips each as local "
+                         "daemons on a forced M-device CPU platform "
+                         "(the ras/simulator analog)")
+    ap.add_argument("--map-by", default="byslot", dest="map_by",
+                    choices=("byslot", "bynode"),
+                    help="rmaps policy: fill nodes vs round-robin")
+    ap.add_argument("--oversubscribe", action="store_true")
+    ap.add_argument("--launch-agent", default="ssh", dest="agent",
+                    help="Remote daemon launcher (e.g. 'ssh' or "
+                         "'python -m ompi_tpu.tools.localssh')")
+    ap.add_argument("--tree-radix", type=int, default=32,
+                    help="PLM launch-tree fan-out per daemon")
+    ap.add_argument("--hnp-ip", default=None,
+                    help="IP remote nodes should dial for the HNP "
+                         "control + KV servers (default: auto-detect)")
     ap.add_argument("prog")
     ap.add_argument("args", nargs=argparse.REMAINDER)
     opts = ap.parse_args(argv)
@@ -89,6 +193,20 @@ def main(argv: Optional[List[str]] = None) -> int:
             "mpirun: --ranks-per-proc > 1 requires a Python "
             "program (ranks run as threads of the app shell)\n")
         return 2
+
+    from ompi_tpu.runtime import ras
+    try:
+        nodes = ras.allocate(opts.hosts, opts.hostfile, opts.simulate,
+                             opts.np)
+    except (ValueError, OSError) as e:
+        sys.stderr.write(f"mpirun: {e}\n")
+        return 2
+    # any EXPLICIT allocation goes through the PLM (slot counts and
+    # mapping policy enforced uniformly, even for one local node);
+    # only the implicit local default uses the direct fork/exec path
+    if any(x is not None for x in (opts.hosts, opts.hostfile,
+                                   opts.simulate)):
+        return run_multinode(opts, nodes, rpp, hybrid)
 
     session = tempfile.mkdtemp(prefix="tpumpi-session-")
     server = KVServer(opts.np)

@@ -1,0 +1,266 @@
+"""PLM/HNP: multi-node job orchestration inside mpirun.
+
+Re-design of orte/mca/plm + the HNP role of orterun (launch
+sequencing ref: plm_base_launch_support.c:270 setup_job, :550
+launch_apps, :855-1176 daemon report-in).  The HNP:
+
+  1. builds a radix **launch tree** over the allocated nodes and
+     spawns the root daemons (ssh agent for real hosts, local
+     subprocesses for simulated nodes); each daemon tree-spawns its
+     subtree (ref: plm_rsh_module.c tree launch) and every daemon
+     connects *directly* back here (routed/direct model);
+  2. waits for all daemons to register (report-in);
+  3. ships each daemon its slice of the job map (launch message);
+  4. relays IOF lines, collects proc-exit reports, and applies the
+     default-HNP errmgr policy: first abnormal exit, daemon loss or
+     KV abort kills the whole job everywhere.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ompi_tpu.runtime import oob
+from ompi_tpu.runtime.ras import Node
+from ompi_tpu.runtime.rmaps import NodeMap
+from ompi_tpu.tools.tpud import spawn_node_daemon
+
+
+def build_tree(nodes: List[Node], radix: int) -> List[dict]:
+    """Radix tree over the node list (ref: routed/radix layout used
+    for the launch fan-out): the HNP spawns nodes 0 … radix-1
+    directly; node i tree-spawns nodes [(i+1)*radix, (i+2)*radix).
+    Every node appears exactly once and depth is log_radix(N)."""
+    entries = [{"name": n.name, "node": n.node_id,
+                "simulated": n.simulated, "local": n.local,
+                "env": {}, "subtree": []} for n in nodes]
+
+    def attach(i: int) -> dict:
+        e = entries[i]
+        for c in range((i + 1) * radix,
+                       min((i + 2) * radix, len(entries))):
+            e["subtree"].append(attach(c))
+        return e
+
+    return [attach(i) for i in range(min(radix, len(entries)))]
+
+
+class HNP:
+    def __init__(self, maps: List[NodeMap], agent: str, python: str,
+                 pythonpath: str, tree_radix: int = 32,
+                 bind_all: bool = False) -> None:
+        self.maps = maps
+        self.agent = agent
+        self.python = python
+        self.pythonpath = pythonpath
+        self.tree_radix = max(1, tree_radix)
+        self.listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.listener.bind(("0.0.0.0" if bind_all else "127.0.0.1", 0))
+        self.listener.listen(len(maps) * 2 + 8)
+        self.port = self.listener.getsockname()[1]
+        self.channels: Dict[int, oob.Channel] = {}
+        self.lock = threading.Lock()
+        self.cv = threading.Condition(self.lock)
+        self.daemon_procs: List[subprocess.Popen] = []
+        self.failures: List[Tuple[str, int, str]] = []  # (tag, code, err)
+        self.nodes_done: set = set()
+        self.lost_daemons: List[int] = []
+        self.unregistered_losses = 0
+        self.tag_output = False
+        self._stop = False
+        self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               daemon=True)
+        self._accept_thread.start()
+
+    # ---- daemon spawn + registration -------------------------------
+    def addr_for(self, hnp_ip: str) -> str:
+        return f"{hnp_ip}:{self.port}"
+
+    def spawn_daemons(self, hnp_ip: str,
+                      node_env: Dict[int, Dict[str, str]]) -> None:
+        roots = build_tree([m.node for m in self.maps], self.tree_radix)
+
+        def set_env(entry: dict) -> None:
+            entry["env"] = node_env.get(entry["node"], {})
+            for c in entry["subtree"]:
+                set_env(c)
+
+        for r in roots:
+            set_env(r)
+            self.daemon_procs.append(spawn_node_daemon(
+                r, self.addr_for(hnp_ip), self.agent, self.python,
+                self.pythonpath))
+
+    def _accept_loop(self) -> None:
+        while not self._stop:
+            try:
+                conn, _ = self.listener.accept()
+            except OSError:
+                return
+            holder: List = [None]
+            ready = threading.Event()
+
+            def handle(msg: dict, _holder=holder, _ready=ready) -> None:
+                _ready.wait()  # until holder carries the Channel
+                self._dispatch(msg, _holder)
+
+            def on_close(_exc, _holder=holder) -> None:
+                node = _holder[0]
+                with self.cv:
+                    if node is None:
+                        # a connection died before registering — fail
+                        # registration fast, but never abort a running
+                        # job over it (could be a stray probe)
+                        self.unregistered_losses += 1
+                    else:
+                        if node not in self.nodes_done:
+                            self.lost_daemons.append(node)
+                        self.channels.pop(node, None)
+                    self.cv.notify_all()
+
+            ch = oob.Channel(conn, handle, on_close)
+            holder.append(ch)
+            ready.set()
+
+    def _dispatch(self, msg: dict, holder: List) -> None:
+        op = msg.get("op")
+        if op == "register":
+            node = msg["node"]
+            with self.cv:
+                holder[0] = node
+                # holder[1] is the Channel (appended in _accept_loop)
+                if len(holder) > 1:
+                    self.channels[node] = holder[1]
+                self.cv.notify_all()
+        elif op == "iof":
+            out = sys.stdout.buffer if msg["stream"] == "out" \
+                else sys.stderr.buffer
+            data = msg["data"].encode("latin-1")
+            if self.tag_output:
+                out.write(b"[" + msg["tag"].encode() + b"]" + data)
+            else:
+                out.write(data)
+            out.flush()
+        elif op == "proc_exit":
+            if msg["code"] != 0:
+                with self.cv:
+                    self.failures.append(
+                        (msg["tag"], msg["code"], msg.get("error", "")))
+                    self.cv.notify_all()
+        elif op == "node_done":
+            with self.cv:
+                self.nodes_done.add(msg["node"])
+                self.cv.notify_all()
+
+    def wait_registered(self, timeout: float = 90.0) -> bool:
+        want = {m.node.node_id for m in self.maps}
+        deadline = time.monotonic() + timeout
+        with self.cv:
+            while set(self.channels) != want:
+                left = deadline - time.monotonic()
+                if left <= 0 or self.lost_daemons \
+                        or self.unregistered_losses:
+                    return False
+                self.cv.wait(timeout=min(left, 0.5))
+        return True
+
+    # ---- job launch + supervision ----------------------------------
+    def launch(self, prog: str, args: List[str],
+               env: Dict[str, str], wdir: Optional[str]) -> None:
+        for m in self.maps:
+            if not m.procs:
+                self.nodes_done.add(m.node.node_id)
+                continue
+            nid = m.node.node_id
+            try:
+                with self.lock:
+                    ch = self.channels[nid]
+                ch.send({
+                    "op": "launch", "prog": prog, "args": args,
+                    "wdir": wdir, "env": env,
+                    "procs": [{"rank_base": p.rank_base,
+                               "nlocal": p.nlocal} for p in m.procs],
+                })
+            except (KeyError, ConnectionError, OSError):
+                # daemon died between report-in and launch: let the
+                # supervise loop apply the errmgr policy
+                with self.cv:
+                    if nid not in self.lost_daemons:
+                        self.lost_daemons.append(nid)
+                    self.cv.notify_all()
+
+    def supervise(self, kv_server, timeout: float = 0.0) -> int:
+        """The mpirun wait loop, multi-node edition."""
+        active = {m.node.node_id for m in self.maps if m.procs}
+        deadline = time.monotonic() + timeout if timeout else None
+        exit_code = 0
+        while True:
+            with self.cv:
+                if kv_server.aborted is not None:
+                    exit_code = kv_server.aborted[1] or 1
+                    sys.stderr.write(
+                        f"mpirun: rank {kv_server.aborted[0]} called "
+                        f"MPI_Abort({exit_code}): "
+                        f"{kv_server.aborted[2]}\n")
+                    break
+                if self.failures:
+                    tag, code, err = self.failures[0]
+                    exit_code = code if code > 0 else 1
+                    extra = f" ({err})" if err else ""
+                    sys.stderr.write(
+                        f"mpirun: {tag} exited with status "
+                        f"{code}{extra}; terminating job\n")
+                    break
+                if self.lost_daemons:
+                    exit_code = 1
+                    sys.stderr.write(
+                        f"mpirun: lost contact with daemon on node(s) "
+                        f"{sorted(self.lost_daemons)}; terminating "
+                        f"job\n")
+                    break
+                if active <= self.nodes_done:
+                    break
+                left = None if deadline is None \
+                    else deadline - time.monotonic()
+                if left is not None and left <= 0:
+                    sys.stderr.write(
+                        f"mpirun: job exceeded --timeout; killing\n")
+                    exit_code = 124
+                    break
+                self.cv.wait(timeout=0.2 if left is None
+                             else min(0.2, left))
+        return exit_code
+
+    def shutdown(self, failed: bool) -> None:
+        op = "kill" if failed else "exit"
+        with self.lock:
+            chans = list(self.channels.values())
+        for ch in chans:
+            try:
+                ch.send({"op": op})
+            except (ConnectionError, OSError):
+                pass
+        t_end = time.monotonic() + 5.0
+        for p in self.daemon_procs:
+            while p.poll() is None and time.monotonic() < t_end:
+                time.sleep(0.02)
+            if p.poll() is None:
+                p.terminate()
+        for p in self.daemon_procs:
+            if p.poll() is None:
+                try:
+                    p.wait(timeout=1.0)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+        self._stop = True
+        try:
+            self.listener.close()
+        except OSError:
+            pass

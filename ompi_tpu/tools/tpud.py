@@ -1,0 +1,268 @@
+"""tpud: the per-node daemon of the multi-host launch model.
+
+Re-design of the orted (ref: orte/orted/orted_main.c): started on each
+allocated node by the PLM (ssh agent, or a plain local subprocess for
+simulated nodes), it connects back to the HNP's control port (OOB),
+registers, optionally **tree-spawns** a subtree of further daemons
+(the plm_rsh tree-launch, ref: plm_rsh_module.c:169,328-387), then
+waits for a launch message, fork/execs its local launch units (odls
+analog, ref: odls_default_module.c:338-437), relays their stdio to
+the HNP (IOF analog), reports exits, and kills everything on command
+(errmgr kill path).
+
+Launch units are either classic single-rank processes or hybrid app
+shells (ompi_tpu.tools.hostrun) owning a contiguous block of
+rank-threads — the daemon does not care, it just execs what the map
+says and injects the right TPUMPI_* identity env.
+
+argv: --hnp HOST:PORT --node ID --name NAME [--subtree B64JSON]
+      [--agent CMD] [--python EXE]
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import os
+import shlex
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ompi_tpu.runtime import oob
+
+
+def daemon_cmd(python: str, hnp: str, name: str, node_id: int,
+               subtree: Optional[list], agent: str,
+               pythonpath: str) -> List[str]:
+    """The tpud argv for one node (used by both the HNP's plm and a
+    tree-spawning parent daemon)."""
+    cmd = [python, "-m", "ompi_tpu.tools.tpud",
+           "--hnp", hnp, "--node", str(node_id), "--name", name,
+           "--agent", agent, "--python", python]
+    if subtree:
+        blob = base64.b64encode(json.dumps(subtree).encode()).decode()
+        cmd += ["--subtree", blob]
+    if pythonpath:
+        cmd += ["--pythonpath", pythonpath]
+    return cmd
+
+
+def spawn_node_daemon(entry: dict, hnp: str, agent: str, python: str,
+                      pythonpath: str) -> subprocess.Popen:
+    """Start one daemon described by a tree entry
+    {name, node, simulated, env, subtree} — locally for simulated
+    nodes, through the launch agent (ssh ...) otherwise."""
+    cmd = daemon_cmd(python, hnp, entry["name"], entry["node"],
+                     entry.get("subtree"), agent, pythonpath)
+    env = dict(os.environ)
+    env.update(entry.get("env") or {})
+    if pythonpath:
+        env["PYTHONPATH"] = pythonpath + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+    if entry.get("simulated") or entry.get("local"):
+        return subprocess.Popen(cmd, env=env,
+                                stdout=subprocess.DEVNULL,
+                                stderr=None)
+    # remote: agent + host + a single shell command string that
+    # re-exports the env the daemon needs (homogeneous install paths
+    # assumed, like the reference's default --prefix behavior)
+    exports = " ".join(
+        f"{k}={shlex.quote(v)}"
+        for k, v in (entry.get("env") or {}).items())
+    if pythonpath:
+        exports += f" PYTHONPATH={shlex.quote(pythonpath)}"
+    remote = f"env {exports} " + " ".join(shlex.quote(c) for c in cmd)
+    return subprocess.Popen(shlex.split(agent) + [entry["name"], remote],
+                            stdout=subprocess.DEVNULL, stderr=None)
+
+
+class _Unit:
+    """One launched local unit (process) and its IOF plumbing."""
+
+    def __init__(self, proc: subprocess.Popen, tag: str,
+                 rank_base: int, nlocal: int) -> None:
+        self.proc = proc
+        self.tag = tag
+        self.rank_base = rank_base
+        self.nlocal = nlocal
+        self.reported = False
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(prog="tpud")
+    ap.add_argument("--hnp", required=True)
+    ap.add_argument("--node", type=int, required=True)
+    ap.add_argument("--name", required=True)
+    ap.add_argument("--subtree", default=None)
+    ap.add_argument("--agent", default="ssh")
+    ap.add_argument("--python", default=sys.executable)
+    ap.add_argument("--pythonpath", default="")
+    opts = ap.parse_args(argv)
+
+    units: List[_Unit] = []
+    units_lock = threading.Lock()
+    expected_units = [0]  # set from the launch message BEFORE spawning
+    children: List[subprocess.Popen] = []  # tree-spawned daemons
+    done = threading.Event()
+    killed = threading.Event()
+    session = tempfile.mkdtemp(prefix=f"tpumpi-node{opts.node}-")
+    # the address this node uses to reach the HNP == the address peers
+    # can reach *us* at (if/reachable analog)
+    if_ip = oob.local_ip_toward(opts.hnp)
+
+    chan_box: List[Optional[oob.Channel]] = [None]
+
+    def report(msg: dict) -> None:
+        ch = chan_box[0]
+        if ch is None:
+            return
+        try:
+            ch.send(msg)
+        except (ConnectionError, OSError):
+            pass
+
+    def forward_iof(stream, tag: str, which: str) -> None:
+        try:
+            for line in iter(stream.readline, b""):
+                report({"op": "iof", "tag": tag, "stream": which,
+                        "data": line.decode("latin-1")})
+        except (OSError, ValueError):
+            pass
+
+    def launch(msg: dict) -> None:
+        with units_lock:
+            expected_units[0] += len(msg["procs"])
+        env_base = dict(os.environ)
+        env_base.update(msg.get("env") or {})
+        env_base["TPUMPI_SESSION_DIR"] = session
+        env_base["TPUMPI_NODE"] = str(opts.node)
+        env_base.setdefault("TPUMPI_MCA_btl_tcp_if_ip", if_ip)
+        prog = msg["prog"]
+        args = msg.get("args") or []
+        node_ranks = sum(max(1, p["nlocal"]) for p in msg["procs"])
+        for spec in msg["procs"]:
+            env = dict(env_base)
+            base, nlocal = spec["rank_base"], spec["nlocal"]
+            if nlocal:  # hybrid app shell
+                env["TPUMPI_RANK_BASE"] = str(base)
+                env["TPUMPI_LOCAL_RANKS"] = str(nlocal)
+                env["TPUMPI_LOCAL_SIZE"] = str(nlocal)
+                cmd = [opts.python, "-m", "ompi_tpu.tools.hostrun",
+                       prog] + args
+                tag = f"{opts.name}:{base}-{base + nlocal - 1}" \
+                    if nlocal > 1 else f"{opts.name}:{base}"
+            else:
+                env["TPUMPI_RANK"] = str(base)
+                env["TPUMPI_LOCAL_SIZE"] = str(node_ranks)
+                cmd = ([opts.python, prog] + args
+                       if prog.endswith(".py") else [prog] + args)
+                tag = f"{opts.name}:{base}"
+            try:
+                p = subprocess.Popen(cmd, env=env, cwd=msg.get("wdir"),
+                                     stdout=subprocess.PIPE,
+                                     stderr=subprocess.PIPE)
+            except OSError as e:
+                with units_lock:
+                    expected_units[0] -= 1
+                report({"op": "proc_exit", "tag": tag, "code": 127,
+                        "error": f"exec failed: {e}"})
+                continue
+            u = _Unit(p, tag, base, nlocal)
+            with units_lock:
+                units.append(u)
+            for stream, which in ((p.stdout, "out"), (p.stderr, "err")):
+                threading.Thread(target=forward_iof,
+                                 args=(stream, tag, which),
+                                 daemon=True).start()
+
+    def kill_local(grace: float = 2.0) -> None:
+        killed.set()
+        with units_lock:
+            procs = [u.proc for u in units]
+        for p in procs + children:
+            if p.poll() is None:
+                p.terminate()
+        t_end = time.monotonic() + grace
+        for p in procs + children:
+            while p.poll() is None and time.monotonic() < t_end:
+                time.sleep(0.02)
+            if p.poll() is None:
+                p.kill()
+
+    def handle(msg: dict) -> None:
+        op = msg.get("op")
+        if op == "launch":
+            launch(msg)
+        elif op == "kill":
+            kill_local()
+            done.set()
+        elif op == "exit":
+            done.set()
+
+    def on_close(_exc) -> None:
+        # HNP died: orphaned daemons must not leak procs
+        kill_local()
+        done.set()
+
+    try:
+        chan = oob.connect(opts.hnp, handle, on_close)
+    except OSError as e:
+        sys.stderr.write(f"tpud[{opts.name}]: cannot reach HNP "
+                         f"{opts.hnp}: {e}\n")
+        return 1
+    chan_box[0] = chan
+
+    # tree spawn before registering: children registrations overlap
+    # with ours (the plm_rsh tree fan-out)
+    subtree = []
+    if opts.subtree:
+        subtree = json.loads(base64.b64decode(opts.subtree))
+    for entry in subtree:
+        children.append(spawn_node_daemon(
+            entry, opts.hnp, opts.agent, opts.python, opts.pythonpath))
+
+    chan.send({"op": "register", "node": opts.node, "name": opts.name,
+               "if_ip": if_ip})
+
+    # monitor loop: report unit exits; finish when every unit the
+    # launch message promised has been spawned AND exited (guards the
+    # race where the first unit dies while later ones are still being
+    # spawned on the OOB reader thread)
+    while not done.is_set():
+        time.sleep(0.02)
+        with units_lock:
+            snapshot = list(units)
+            expected = expected_units[0]
+        alive = 0
+        for u in snapshot:
+            code = u.proc.poll()
+            if code is None:
+                alive += 1
+            elif not u.reported:
+                u.reported = True
+                report({"op": "proc_exit", "tag": u.tag, "code": code})
+        if expected > 0 and len(snapshot) == expected and alive == 0 \
+                and not killed.is_set():
+            report({"op": "node_done", "node": opts.node})
+            break
+
+    # wait for tree children to finish on clean shutdown
+    for c in children:
+        if c.poll() is None and not killed.is_set():
+            try:
+                c.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                c.terminate()
+    import shutil
+    shutil.rmtree(session, ignore_errors=True)
+    chan.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

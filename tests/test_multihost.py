@@ -1,0 +1,188 @@
+"""Multi-node launch tests: ras/rmaps mapping, simulated-node
+daemons, tree launch through a local ssh-agent shim, IOF relay and
+failure propagation (ref: the reference's multi-node-on-one-machine
+strategies — ras/simulator fake allocations + oversubscribed local
+rsh launch, SURVEY §4)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from ompi_tpu.runtime import ras, rmaps
+from ompi_tpu.tools.plm import build_tree
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOCALSSH = f"{sys.executable} -m ompi_tpu.tools.localssh"
+
+
+def mpirun(np, prog, *extra, timeout=240):
+    cmd = [sys.executable, "-m", "ompi_tpu.tools.mpirun",
+           "-np", str(np), *extra, os.path.join(REPO, "examples", prog)]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.run(cmd, capture_output=True, timeout=timeout,
+                          env=env, cwd=REPO)
+
+
+# ---- ras: allocation parsing ---------------------------------------
+
+def test_parse_hosts_slots():
+    nodes = ras.parse_hosts("a,b:4,localhost:2")
+    assert [n.name for n in nodes] == ["a", "b", "localhost"]
+    assert [n.slots for n in nodes] == [1, 4, 2]
+    assert nodes[2].local and not nodes[0].local
+
+
+def test_parse_hostfile(tmp_path):
+    hf = tmp_path / "hosts"
+    hf.write_text("# cluster\nn0 slots=2\nn1 slots=3  # tail\n\nn2\n")
+    nodes = ras.parse_hostfile(str(hf))
+    assert [(n.name, n.slots) for n in nodes] == [
+        ("n0", 2), ("n1", 3), ("n2", 1)]
+
+
+def test_parse_simulate():
+    nodes = ras.parse_simulate("4x2")
+    assert len(nodes) == 4 and all(n.slots == 2 and n.simulated
+                                   for n in nodes)
+    assert ras.parse_simulate("3")[0].sim_devices == 1
+    with pytest.raises(ValueError):
+        ras.parse_simulate("0x2")
+
+
+def test_allocate_sources_exclusive():
+    with pytest.raises(ValueError):
+        ras.allocate("a,b", None, "2x2", 4)
+    default = ras.allocate(None, None, None, 6)
+    assert len(default) == 1 and default[0].local \
+        and default[0].slots == 6
+
+
+# ---- rmaps: mapping policies ---------------------------------------
+
+def _nodes(*slots):
+    return [ras.Node(name=f"n{i}", slots=s, node_id=i)
+            for i, s in enumerate(slots)]
+
+
+def test_map_byslot_fills_nodes():
+    maps = rmaps.map_ranks(_nodes(2, 2), 3)
+    assert maps[0].ranks == [0, 1] and maps[1].ranks == [2]
+
+
+def test_map_bynode_round_robin():
+    maps = rmaps.map_ranks(_nodes(2, 2), 4, policy="bynode")
+    assert maps[0].ranks == [0, 2] and maps[1].ranks == [1, 3]
+
+
+def test_map_oversubscribe_gate():
+    with pytest.raises(ValueError):
+        rmaps.map_ranks(_nodes(1, 1), 4)
+    maps = rmaps.map_ranks(_nodes(1, 1), 4, oversubscribe=True)
+    assert sorted(maps[0].ranks + maps[1].ranks) == [0, 1, 2, 3]
+
+
+def test_map_hybrid_shells():
+    maps = rmaps.map_ranks(_nodes(4, 2), 6, rpp=4)
+    assert [(p.rank_base, p.nlocal) for p in maps[0].procs] == [(0, 4)]
+    assert [(p.rank_base, p.nlocal) for p in maps[1].procs] == [(4, 2)]
+    with pytest.raises(ValueError):
+        rmaps.map_ranks(_nodes(2, 2), 4, rpp=2, policy="bynode")
+
+
+def test_map_hybrid_oversubscribed_contiguous():
+    """Oversubscribed byslot keeps per-node contiguity (slot-
+    proportional shares), so hybrid shells still map."""
+    maps = rmaps.map_ranks(_nodes(2, 2), 6, rpp=6, oversubscribe=True)
+    assert maps[0].ranks == [0, 1, 2] and maps[1].ranks == [3, 4, 5]
+    assert [(p.rank_base, p.nlocal) for p in maps[0].procs] == [(0, 3)]
+    # slot-proportional with largest-remainder: slots (3,1), np=6 →
+    # floors (4,1), one remainder unit to the larger-remainder node
+    maps = rmaps.map_ranks(_nodes(3, 1), 6, oversubscribe=True)
+    assert maps[0].ranks == [0, 1, 2, 3, 4] and maps[1].ranks == [5]
+
+
+def test_explicit_single_node_enforces_slots():
+    """--hosts localhost:2 must enforce the slot count even though
+    the allocation is one local node (PLM path, not the implicit
+    direct path)."""
+    r = mpirun(4, "ring.py", "--hosts", "localhost:2")
+    assert r.returncode == 2
+    assert "not enough slots" in r.stderr.decode()
+
+
+def test_launch_tree_covers_all_nodes_once():
+    nodes = [ras.Node(name=f"n{i}", slots=1, node_id=i)
+             for i in range(13)]
+    for radix in (1, 2, 3, 32):
+        roots = build_tree(nodes, radix)
+        seen = []
+
+        def walk(e):
+            seen.append(e["node"])
+            for c in e["subtree"]:
+                walk(c)
+
+        for r in roots:
+            walk(r)
+        assert sorted(seen) == list(range(13)), radix
+        if radix == 2:
+            assert len(roots) == 2  # HNP fan-out respects the radix
+
+
+# ---- end-to-end: simulated nodes + localssh tree launch ------------
+
+def test_sim_nodes_ring():
+    r = mpirun(4, "ring.py", "--simulate-nodes", "2x2", "--tag-output")
+    assert r.returncode == 0, r.stderr.decode()
+    out = r.stdout.decode()
+    assert "received token 7 from 3" in out
+    assert "[sim1:" in out  # IOF relays through the remote daemon
+
+
+def test_sim_nodes_connectivity():
+    r = mpirun(4, "connectivity.py", "--simulate-nodes", "4x1")
+    assert r.returncode == 0, r.stderr.decode()
+    assert "PASSED" in r.stdout.decode()
+
+
+def test_sim_nodes_bynode_mapping_runs():
+    r = mpirun(4, "connectivity.py", "--simulate-nodes", "2x2",
+               "--map-by", "bynode")
+    assert r.returncode == 0, r.stderr.decode()
+    assert "PASSED" in r.stdout.decode()
+
+
+def test_sim_nodes_hybrid_device_collective():
+    """The VERDICT r1 #3 gate: a device collective in a multi-node
+    job — intra-node XLA mesh allreduce + inter-node host combine."""
+    r = mpirun(4, "hier_allreduce.py", "--simulate-nodes", "2x2",
+               "--ranks-per-proc", "all")
+    assert r.returncode == 0, r.stderr.decode()
+    assert r.stdout.decode().count("hierarchical allreduce ok") == 4
+    assert "device-offloaded=0" not in r.stdout.decode()
+
+
+def test_hosts_localssh_tree_launch():
+    """--hosts with an ssh-style agent (shimmed local), tree radix 1
+    so the second daemon is launched BY the first (plm tree spawn)."""
+    r = mpirun(4, "ring.py", "--hosts", "A:2,B:2",
+               "--launch-agent", LOCALSSH, "--tree-radix", "1")
+    assert r.returncode == 0, r.stderr.decode()
+    assert "received token 7 from 3" in r.stdout.decode()
+
+
+def test_sim_nodes_abort_propagates():
+    r = mpirun(4, "abort_test.py", "--simulate-nodes", "2x2")
+    assert r.returncode == 42, (r.returncode, r.stderr.decode())
+    assert "MPI_Abort" in r.stderr.decode()
+
+
+def test_sim_nodes_nonzero_exit_kills_job():
+    r = mpirun(3, "exit_one.py", "--simulate-nodes", "3x1",
+               timeout=120)
+    assert r.returncode == 7, (r.returncode, r.stderr.decode())
+    assert "terminating job" in r.stderr.decode()
