@@ -1,0 +1,186 @@
+"""Device-path sweep: the coll/tpu / coll/hbm side of BASELINE.md.
+
+Runs thread-ranks in-process (the TPU-host execution model) and times
+allreduce/bcast/alltoall/reduce_scatter on device-resident arrays
+through the XLA collective path.  Used by bench.py; also runnable
+directly:  python benchmarks/device_sweep.py --max-ar 1048576
+
+Two-phase structure — TIME EVERYTHING FIRST, VERIFY AT THE END:
+on tunneled-TPU backends (the CI axon plugin) any device->host
+transfer permanently degrades subsequent dispatch latency by ~3
+orders of magnitude, so the timing phase performs zero host reads;
+results are held as device arrays and asserted afterwards (a
+fast-but-wrong bench is still worthless, the check just moves).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def _rank_devices(nranks: int):
+    import jax
+
+    ndev = len(jax.devices())
+    if ndev >= nranks:
+        return None, True
+    return (lambda r: jax.devices()[r % ndev]), False
+
+
+def sizes_upto(max_bytes: int, start: int = 4):
+    s = start
+    while s <= max_bytes:
+        yield s
+        s *= 2
+
+
+def should_continue(comm, deadline: float) -> bool:
+    """Collectively-agreed deadline check: rank 0 decides, everyone
+    follows — ranks must never diverge on whether the next size's
+    collectives run."""
+    flag = np.array(
+        [1 if (deadline <= 0 or time.perf_counter() < deadline) else 0],
+        dtype=np.int32)
+    comm.Bcast(flag, root=0)
+    return bool(flag[0])
+
+
+def _time_arr(comm, make_op, probe_s: float) -> float:
+    """Iteration count decided by rank 0 and broadcast — every rank
+    must run the same number of collectives; capped so one slow size
+    can never eat the whole budget."""
+    from ompi_tpu.op import op as mpi_op
+
+    it = np.array([max(2, min(50, int(0.2 / max(probe_s, 1e-6))))],
+                  dtype=np.int32)
+    comm.Bcast(it, root=0)
+    iters = int(it[0])
+    comm.Barrier()
+    t0 = time.perf_counter()
+    r = None
+    for _ in range(iters):
+        r = make_op()
+    r.block_until_ready()
+    mine = np.array([(time.perf_counter() - t0) / iters])
+    worst = np.empty_like(mine)
+    comm.Allreduce(mine, worst, mpi_op.MAX)
+    return float(worst[0])
+
+
+def run_device_sweep(nranks: int, max_ar: int, max_bcast: int,
+                     max_a2a: int, max_rsb: int,
+                     budget_s: float = 0.0) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from ompi_tpu.op import op as mpi_op
+    from ompi_tpu.testing import run_ranks
+
+    device_map, devices = _rank_devices(nranks)
+    deadline = time.perf_counter() + budget_s if budget_s else 0.0
+
+    def fn(comm):
+        out = {"allreduce": {}, "bcast": {}, "alltoall": {},
+               "reduce_scatter": {}, "truncated": False}
+        # deferred correctness checks: (kind, size_key, result,
+        # expected first element) — read ONLY in the verify phase
+        checks = []
+
+        def one(kind, size_key, make_op, expect0):
+            r = make_op()
+            r.block_until_ready()  # compile
+            t0 = time.perf_counter()
+            r = make_op()
+            r.block_until_ready()  # probe
+            probe = time.perf_counter() - t0
+            out[kind][size_key] = round(
+                _time_arr(comm, make_op, probe) * 1e6, 2)
+            checks.append((kind, size_key, r, expect0))
+
+        expect_sum = float(sum(range(1, nranks + 1)))
+        for nbytes in sizes_upto(max_ar):
+            if not should_continue(comm, deadline):
+                out["truncated"] = True
+                break
+            n = max(1, nbytes // 4)
+            x = jax.device_put(
+                jnp.full((n,), comm.rank + 1.0, jnp.float32), comm.device)
+            one("allreduce", str(n * 4),
+                lambda: comm.allreduce_arr(x, mpi_op.SUM), expect_sum)
+        if not out["truncated"]:
+            for nbytes in sizes_upto(max_bcast):
+                if not should_continue(comm, deadline):
+                    out["truncated"] = True
+                    break
+                n = max(1, nbytes // 4)
+                x = jax.device_put(
+                    jnp.full((n,), 7.0 if comm.rank == 0 else 0.0,
+                             jnp.float32), comm.device)
+                one("bcast", str(n * 4),
+                    lambda: comm.bcast_arr(x, root=0), 7.0)
+        if not out["truncated"]:
+            for nbytes in sizes_upto(max_a2a):
+                if not should_continue(comm, deadline):
+                    out["truncated"] = True
+                    break
+                per = max(1, nbytes // 4)
+                x = jax.device_put(
+                    jnp.full((per * nranks,), comm.rank + 1.0,
+                             jnp.float32), comm.device)
+                one("alltoall", str(per * 4),
+                    lambda: comm.alltoall_arr(x), 1.0)
+        if not out["truncated"]:
+            for nbytes in sizes_upto(max_rsb, start=64):
+                if not should_continue(comm, deadline):
+                    out["truncated"] = True
+                    break
+                per = max(1, nbytes // 4 // nranks)
+                x = jax.device_put(
+                    jnp.full((per * nranks,), comm.rank + 1.0,
+                             jnp.float32), comm.device)
+                # SUM: the op with a native scatter-reduce lowering on
+                # both device paths (psum_scatter / stacked sum); the
+                # software sweep keeps BASELINE config 5's exact
+                # MAX-on-DOUBLE-via-vector form
+                one("reduce_scatter", str(per * nranks * 4),
+                    lambda: comm.reduce_scatter_arr(x, mpi_op.SUM),
+                    expect_sum)
+
+        # verify phase: first host reads of the whole run.  Two ranks
+        # suffice (results are either identical across ranks or
+        # per-rank with identical element 0) and keep the slow
+        # post-read path off the other threads.
+        comm.Barrier()
+        if comm.rank in (0, nranks - 1):
+            for kind, size_key, r, expect0 in checks:
+                got = float(np.asarray(r).ravel()[0])
+                assert abs(got - expect0) < 1e-3, \
+                    (kind, size_key, got, expect0)
+        comm.Barrier()
+        return out
+
+    res = run_ranks(nranks, fn, devices=devices, device_map=device_map,
+                    timeout=3600)
+    return res[0]
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nranks", type=int, default=8)
+    ap.add_argument("--max-ar", type=int, default=256 * 1024 * 1024)
+    ap.add_argument("--max-bcast", type=int, default=64 * 1024 * 1024)
+    ap.add_argument("--max-a2a", type=int, default=4 * 1024 * 1024)
+    ap.add_argument("--max-rsb", type=int, default=16 * 1024 * 1024)
+    ap.add_argument("--budget", type=float, default=0.0)
+    opts = ap.parse_args()
+    print(json.dumps(run_device_sweep(
+        opts.nranks, opts.max_ar, opts.max_bcast, opts.max_a2a,
+        opts.max_rsb, budget_s=opts.budget)), flush=True)
+
+
+if __name__ == "__main__":
+    main()

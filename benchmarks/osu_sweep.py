@@ -1,0 +1,187 @@
+"""OSU-style microbenchmark sweep over the launched job: the
+software-baseline side of BASELINE.md (coll/tuned over a byte
+transport; ref: the external OSU suite SURVEY §4 delegates to).
+
+Run under mpirun (process-ranks; force TCP for the tuned-over-TCP
+configuration the north star names):
+
+    python -m ompi_tpu.tools.mpirun -np 8 --mca btl self,tcp \
+        benchmarks/osu_sweep.py --max-ar 268435456
+
+Rank 0 prints ONE JSON line mapping collective -> {bytes: usec}:
+allreduce (MPI_SUM float32), bcast (float32), alltoall (float32),
+reduce_scatter_block MPI_MAX on MPI_DOUBLE through a derived vector
+datatype (BASELINE config 5).
+
+Latency convention: barrier, time a fixed loop per rank, allreduce-MAX
+the per-rank averages (the OSU avg-of-max convention).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+import ompi_tpu
+from ompi_tpu.datatype import engine as dt
+from ompi_tpu.op import op as mpi_op
+
+
+def sizes_upto(max_bytes: int, start: int = 4):
+    s = start
+    while s <= max_bytes:
+        yield s
+        s *= 2
+
+
+_DEADLINE = [0.0]
+
+
+def _should_continue(comm) -> bool:
+    """Collectively-agreed budget check (rank 0 decides): ranks must
+    never diverge on whether the next size's collectives run."""
+    d = _DEADLINE[0]
+    flag = np.array([1 if (d <= 0 or time.perf_counter() < d) else 0],
+                    dtype=np.int32)
+    comm.Bcast(flag, root=0)
+    return bool(flag[0])
+
+
+def _timeit(comm, fn, dt_probe: float) -> float:
+    """Per-rank mean over an iteration count adapted to the probe
+    time (~0.25 s budget per size, rank-0-agreed), max-reduced
+    across ranks."""
+    it = np.array([max(2, min(100, int(0.25 / max(dt_probe, 1e-6))))],
+                  dtype=np.int32)
+    comm.Bcast(it, root=0)
+    iters = int(it[0])
+    comm.Barrier()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        fn()
+    mine = np.array([(time.perf_counter() - t0) / iters])
+    worst = np.empty_like(mine)
+    comm.Allreduce(mine, worst, mpi_op.MAX)
+    return float(worst[0])
+
+
+def bench_allreduce(comm, max_bytes: int) -> dict:
+    out = {}
+    for nbytes in sizes_upto(max_bytes):
+        if not _should_continue(comm):
+            out["truncated"] = True
+            return out
+        n = max(1, nbytes // 4)
+        x = np.full(n, comm.rank + 1.0, dtype=np.float32)
+        r = np.empty_like(x)
+        t0 = time.perf_counter()
+        comm.Allreduce(x, r, mpi_op.SUM)  # warmup + probe
+        probe = time.perf_counter() - t0
+        dt_s = _timeit(comm, lambda: comm.Allreduce(x, r, mpi_op.SUM),
+                       probe)
+        assert abs(r[0] - sum(range(1, comm.size + 1))) < 1e-3
+        out[str(n * 4)] = round(dt_s * 1e6, 2)
+    return out
+
+
+def bench_bcast(comm, max_bytes: int) -> dict:
+    out = {}
+    for nbytes in sizes_upto(max_bytes):
+        if not _should_continue(comm):
+            out["truncated"] = True
+            return out
+        n = max(1, nbytes // 4)
+        x = np.full(n, 7.0 if comm.rank == 0 else 0.0, dtype=np.float32)
+        t0 = time.perf_counter()
+        comm.Bcast(x, root=0)
+        probe = time.perf_counter() - t0
+        dt_s = _timeit(comm, lambda: comm.Bcast(x, root=0), probe)
+        assert x[0] == 7.0
+        out[str(n * 4)] = round(dt_s * 1e6, 2)
+    return out
+
+
+def bench_alltoall(comm, max_bytes: int) -> dict:
+    """max_bytes is the per-peer message size (OSU convention)."""
+    out = {}
+    for nbytes in sizes_upto(max_bytes):
+        if not _should_continue(comm):
+            out["truncated"] = True
+            return out
+        n = max(1, nbytes // 4) * comm.size
+        x = np.full(n, comm.rank + 1.0, dtype=np.float32)
+        r = np.empty_like(x)
+        t0 = time.perf_counter()
+        comm.Alltoall(x, r)
+        probe = time.perf_counter() - t0
+        dt_s = _timeit(comm, lambda: comm.Alltoall(x, r), probe)
+        assert r[0] == 1.0 and r[-1] == float(comm.size)
+        out[str(max(1, nbytes // 4) * 4)] = round(dt_s * 1e6, 2)
+    return out
+
+
+def bench_rsb_vector(comm, max_bytes: int) -> dict:
+    """Reduce_scatter_block, MPI_MAX on MPI_DOUBLE, send data viewed
+    through a derived vector type (BASELINE config 5): block of
+    `per` doubles per rank, sent as vector(count=per/2, blocklen=2,
+    stride=2) — contiguous coverage but exercising the derived-type
+    pack path."""
+    out = {}
+    for nbytes in sizes_upto(max_bytes, start=64):
+        if not _should_continue(comm):
+            out["truncated"] = True
+            return out
+        per = max(2, nbytes // 8 // 2 * 2)  # doubles per rank, even
+        total = per * comm.size
+        x = np.full(total, float(comm.rank + 1), dtype=np.float64)
+        r = np.empty(per, dtype=np.float64)
+        vec = dt.vector(per // 2, 2, 2, dt.DOUBLE)
+
+        def op_():
+            comm.Reduce_scatter_block((x, comm.size, vec), (r, 1, vec),
+                                      mpi_op.MAX)
+
+        t0 = time.perf_counter()
+        op_()
+        probe = time.perf_counter() - t0
+        dt_s = _timeit(comm, op_, probe)
+        assert r[0] == float(comm.size)
+        out[str(per * 8)] = round(dt_s * 1e6, 2)
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--max-ar", type=int, default=256 * 1024 * 1024)
+    ap.add_argument("--max-bcast", type=int, default=64 * 1024 * 1024)
+    ap.add_argument("--max-a2a", type=int, default=4 * 1024 * 1024)
+    ap.add_argument("--max-rsb", type=int, default=16 * 1024 * 1024)
+    ap.add_argument("--budget", type=float, default=0.0,
+                    help="Soft wall-clock budget in seconds; later "
+                         "sizes are dropped (and marked truncated) "
+                         "once exceeded")
+    opts = ap.parse_args()
+    if opts.budget:
+        _DEADLINE[0] = time.perf_counter() + opts.budget
+
+    comm = ompi_tpu.init()
+    results = {}
+    if opts.max_ar:
+        results["allreduce"] = bench_allreduce(comm, opts.max_ar)
+    if opts.max_bcast:
+        results["bcast"] = bench_bcast(comm, opts.max_bcast)
+    if opts.max_a2a:
+        results["alltoall"] = bench_alltoall(comm, opts.max_a2a)
+    if opts.max_rsb:
+        results["reduce_scatter_block_vector"] = bench_rsb_vector(
+            comm, opts.max_rsb)
+    if comm.rank == 0:
+        print(json.dumps(results), flush=True)
+    ompi_tpu.finalize()
+
+
+if __name__ == "__main__":
+    main()

@@ -30,8 +30,10 @@ _lp_ratio_var = registry.register(
 
 import os as _os
 
-# local ranks on THIS host vs local cores (multi-host jobs export
-# TPUMPI_LOCAL_SIZE per node; fall back to the world size single-host)
+# conservative import-time default: local ranks on THIS host vs local
+# cores (multi-host jobs export TPUMPI_LOCAL_SIZE per node).  mpi_init
+# refines per-state once the real local-rank count is known — env vars
+# can't see thread-rank worlds (run_ranks, hostrun app shells).
 _OVERSUBSCRIBED = (
     int(_os.environ.get("TPUMPI_LOCAL_SIZE",
                         _os.environ.get("TPUMPI_SIZE", "1")))
@@ -44,6 +46,7 @@ class Progress:
         self._lp_callbacks: List[Callable[[], int]] = []
         self._counter = 0
         self._lock = threading.Lock()
+        self.oversubscribed = _OVERSUBSCRIBED
         # Doorbell peers ring when they enqueue work for this rank, so
         # a rank parked in WaitSync wakes immediately instead of
         # polling (the wait_sync condvar signal in the reference).
@@ -123,12 +126,21 @@ class WaitSync:
                     # blocked rank burns a scheduler timeslice before
                     # the rank holding our message runs (the reference
                     # auto-sets yield_when_idle for oversubscription).
-                    if _OVERSUBSCRIBED:
+                    if progress.oversubscribed:
                         if spins > 4:
                             time.sleep(0)  # sched_yield to peers
                     elif spins > 5000:
                         time.sleep(0.0002)
                         spins = 0
+                elif progress.oversubscribed and spins > 4:
+                    # thread-ranks sharing too few cores: park early on
+                    # the doorbell instead of spinning down a shared
+                    # core (the convoy shows up as multi-ms latency
+                    # spikes on small messages)
+                    progress.doorbell.clear()
+                    if progress.progress() == 0 and not self._event.is_set():
+                        progress.doorbell.wait(0.005)
+                    spins = 0
                 elif spins > 200:
                     # Park on the doorbell; peers ring it when they
                     # enqueue frags for us (cross-thread wakeup).
