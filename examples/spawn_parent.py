@@ -1,0 +1,29 @@
+"""MPI_Comm_spawn demo: parents spawn 2 workers, allreduce across
+the bridge (intercomm semantics: each side receives the OTHER side's
+reduction), then everyone merges into one intracomm
+(ref: orte/test/mpi/loop_spawn.c family)."""
+import os
+
+import numpy as np
+
+import ompi_tpu
+from ompi_tpu.op import op as mpi_op
+
+comm = ompi_tpu.init()
+worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "spawn_worker.py")
+inter = comm.spawn(worker, maxprocs=2)
+assert inter.remote_size == 2
+
+mine = np.array([float(comm.rank + 1)], dtype=np.float64)
+got = np.empty(1, dtype=np.float64)
+inter.Allreduce(mine, got, mpi_op.SUM)
+# workers contribute 100 + their world rank each
+assert got[0] == sum(100.0 + r for r in range(2)), got
+
+merged = inter.merge(high=False)
+total = np.empty(1, dtype=np.float64)
+merged.Allreduce(mine, total, mpi_op.SUM)
+print(f"parent {comm.rank}: merged size {merged.size} "
+      f"total {total[0]}", flush=True)
+ompi_tpu.finalize()

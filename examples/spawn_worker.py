@@ -1,0 +1,23 @@
+"""Worker side of the Comm_spawn demo (see spawn_parent.py)."""
+import numpy as np
+
+import ompi_tpu
+from ompi_tpu.op import op as mpi_op
+
+comm = ompi_tpu.init()
+parent = ompi_tpu.get_parent()
+assert parent is not None, "worker must be spawned"
+
+mine = np.array([100.0 + comm.rank], dtype=np.float64)
+got = np.empty(1, dtype=np.float64)
+parent.Allreduce(mine, got, mpi_op.SUM)
+# we receive the parents' reduction
+nparents = parent.remote_size
+assert got[0] == sum(range(1, nparents + 1)), got
+
+merged = parent.merge(high=True)
+total = np.empty(1, dtype=np.float64)
+merged.Allreduce(mine, total, mpi_op.SUM)
+print(f"worker {comm.rank}: merged rank {merged.rank}/{merged.size}",
+      flush=True)
+ompi_tpu.finalize()

@@ -83,6 +83,36 @@ def detach_buffer() -> int:
     return _detach(statemod.current())
 
 
+def get_parent():
+    """MPI_Comm_get_parent: in a spawned job, the intercommunicator
+    to the spawning processes; None otherwise."""
+    from ompi_tpu.comm.dpm import get_parent as _gp
+    from ompi_tpu.runtime import state as statemod
+
+    return _gp(statemod.current().comm_world)
+
+
+def open_port() -> str:
+    from ompi_tpu.comm.dpm import open_port as _op
+    from ompi_tpu.runtime import state as statemod
+
+    return _op(statemod.current())
+
+
+def publish_name(service: str, port: str) -> None:
+    from ompi_tpu.comm.dpm import publish_name as _pn
+    from ompi_tpu.runtime import state as statemod
+
+    _pn(statemod.current(), service, port)
+
+
+def lookup_name(service: str) -> str:
+    from ompi_tpu.comm.dpm import lookup_name as _ln
+    from ompi_tpu.runtime import state as statemod
+
+    return _ln(statemod.current(), service)
+
+
 def initialized() -> bool:
     from ompi_tpu.runtime import state as statemod
 

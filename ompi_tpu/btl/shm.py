@@ -57,10 +57,18 @@ class Ring:
         self.cap = _ring_var.value
         total = _HDR + self.cap
         if create:
-            fd = os.open(path, os.O_CREAT | os.O_RDWR, 0o600)
+            # atomic create: size the file under a temp name, then
+            # rename — attachers poll for existence (dpm peers attach
+            # at arbitrary times) and must never see a short file
+            tmp = f"{path}.tmp.{os.getpid()}"
+            fd = os.open(tmp, os.O_CREAT | os.O_RDWR, 0o600)
             os.ftruncate(fd, total)
+            os.rename(tmp, path)
         else:
             fd = os.open(path, os.O_RDWR)
+            if os.fstat(fd).st_size < total:
+                os.close(fd)
+                raise FileNotFoundError(f"ring {path} not ready")
         self.mm = mmap.mmap(fd, total)
         os.close(fd)
         self.idx = np.frombuffer(self.mm, dtype=np.uint64, count=2)
@@ -185,9 +193,10 @@ class ShmModule(BTLModule):
         r = self._rx.get(peer)
         if r is None:
             path = self._path(peer, self.rank)
-            if not os.path.exists(path):
+            try:
+                r = Ring(path, create=False)
+            except FileNotFoundError:
                 return None  # peer not up yet
-            r = Ring(path, create=False)
             self._rx[peer] = r
         return r
 
@@ -199,6 +208,16 @@ class ShmModule(BTLModule):
             node = self.state.rte.modex_get(peer, "node_id")
             self._peer_nodes[peer] = node
         return node == self.node
+
+    def extend(self, new_size: int) -> None:
+        """Dynamic peers (dpm spawn): create my outbound rings toward
+        the new universe ranks; inbound rings attach lazily as usual
+        (progress polls up to state.size, which the caller updated)."""
+        for peer in range(new_size):
+            if peer != self.rank:
+                path = self._path(self.rank, peer)
+                if not os.path.exists(path):
+                    Ring(path, create=True)
 
     def send(self, peer: int, frag) -> None:
         frame = pickle.dumps(frag, protocol=pickle.HIGHEST_PROTOCOL)

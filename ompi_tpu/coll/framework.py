@@ -75,6 +75,13 @@ class CollComponent(Component):
 
 def comm_select(comm) -> None:
     """Stack modules on a communicator (coll_base_comm_select analog)."""
+    if getattr(comm, "is_inter", False):
+        # intercomms take the whole stack from coll/inter — two-group
+        # semantics are incompatible with every intracomm module
+        # (ref: the reference hard-requires coll/inter the same way)
+        from ompi_tpu.coll.inter import InterCollModule
+        comm.coll = InterCollModule()
+        return
     merged = MergedColl()
     candidates = coll_framework.select_all(comm)  # sorted high→low
     for pri, component, module in reversed(candidates):  # low→high overlay

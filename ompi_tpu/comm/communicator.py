@@ -254,6 +254,32 @@ class Communicator:
     def abort(self, errorcode: int = 1) -> None:
         self.state.rte.abort(errorcode, f"abort on {self.name}")
 
+    # -- intercommunicators + dynamic process management ----------------
+    @property
+    def is_inter(self) -> bool:
+        return False
+
+    def create_intercomm(self, local_leader: int, peer_comm,
+                         remote_leader: int, tag: int = 0):
+        """MPI_Intercomm_create (ref: ompi/mpi/c/intercomm_create.c)."""
+        from .intercomm import intercomm_create
+        return intercomm_create(self, local_leader, peer_comm,
+                                remote_leader, tag)
+
+    def spawn(self, cmd: str, args=(), maxprocs: int = 1,
+              root: int = 0):
+        """MPI_Comm_spawn (ref: ompi/dpm/dpm.c)."""
+        from .dpm import comm_spawn
+        return comm_spawn(self, cmd, list(args), maxprocs, root)
+
+    def accept(self, port: str, root: int = 0):
+        from .dpm import comm_accept
+        return comm_accept(self, port, root)
+
+    def connect(self, port: str, root: int = 0):
+        from .dpm import comm_connect
+        return comm_connect(self, port, root)
+
     # ------------------------------------------------------------------
     # Public MPI API (mpi4py-flavored buffer methods).  Buffer specs:
     # a numpy array (count/datatype inferred), or (buf, datatype), or

@@ -1,0 +1,193 @@
+"""Dynamic process management: spawn / connect / accept / ports.
+
+Re-design of ompi/dpm (ref: ompi/dpm/dpm.c — connect_accept builds
+the bridge and calls add_procs; spawn goes through the runtime's
+PMIx server).  Here the launcher's KV server is the universe
+authority: it allocates universe-rank blocks for spawned jobs and
+carries the port rendezvous records; mpirun drains spawn requests and
+fork/execs the new job with TPUMPI_WORLD_BASE/TPUMPI_UNIVERSE env
+identity (tools/mpirun.py).
+
+The cross-job handshake needs p2p before any shared communicator
+exists, so leaders meet on a **bridge**: a comm-shaped shim whose cid
+is derived from the accept/spawn record (universe-unique, negative so
+it can never collide with agreed cids) and whose 2-entry group is
+[side-A leader, side-B leader].  intercomm_create() then runs its
+normal leader exchange + bridged CID agreement over that bridge.
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import List, Optional
+
+from .communicator import Communicator, Group
+from .intercomm import Intercommunicator, intercomm_create
+
+# bridge cids live far below user/agreed cids and are derived from
+# universe-unique integers (a spawn's rank base; an accept's sequence)
+_SPAWN_CID_BASE = -1_000_000
+_PORT_CID_BASE = -2_000_000
+
+
+class _BridgeComm:
+    """Comm-shaped shim for leader-to-leader p2p before a real
+    communicator exists.  group = [leaderA_global, leaderB_global];
+    my rank is my index in it."""
+
+    def __init__(self, state, cid: int, leaders: List[int]) -> None:
+        self.state = state
+        self.cid = cid
+        self.group = list(leaders)
+        self.rank = self.group.index(state.rank)
+        self.size = len(self.group)
+
+    def _bridge_peer(self) -> int:
+        return 1 - self.rank
+
+
+def _kv(state):
+    kv = getattr(state.rte, "kv", None)
+    if kv is None:
+        raise RuntimeError(
+            "dynamic process management needs the launcher's KV "
+            "server (run under mpirun)")
+    return kv
+
+
+# ---------------------------------------------------------------------
+# ports + name service (ref: ompi/mpi/c/open_port.c, publish_name.c)
+# ---------------------------------------------------------------------
+
+def open_port(state) -> str:
+    return f"tpumpi-port-{state.rank}-{uuid.uuid4().hex[:12]}"
+
+
+def publish_name(state, service: str, port: str) -> None:
+    _kv(state).put(f"svc:{service}", port)
+
+
+def lookup_name(state, service: str) -> str:
+    return _kv(state).get(f"svc:{service}")
+
+
+def unpublish_name(state, service: str) -> None:
+    _kv(state).put(f"svc:{service}", None)
+
+
+# ---------------------------------------------------------------------
+# connect / accept (ref: dpm.c ompi_dpm_connect_accept)
+# ---------------------------------------------------------------------
+
+def comm_accept(comm: Communicator, port: str, root: int = 0
+                ) -> Intercommunicator:
+    """Collective over `comm`; the root posts the accept record and
+    waits for a connector."""
+    state = comm.state
+    import numpy as np
+    meta = np.empty(2, dtype=np.int64)
+    if comm.rank == root:
+        kv = _kv(state)
+        seq = abs(hash(port)) % 100_000
+        cid = _PORT_CID_BASE - seq
+        kv.put(f"port:{port}:accept",
+               {"leader": state.rank, "cid": cid})
+        peer = kv.get(f"port:{port}:connect", timeout=300.0)
+        meta[0] = cid
+        meta[1] = peer["leader"]
+    comm.Bcast(meta, root=root)
+    cid, remote_leader = int(meta[0]), int(meta[1])
+    return _bridged_create(comm, root, cid, remote_leader,
+                           accept_side=True)
+
+
+def comm_connect(comm: Communicator, port: str, root: int = 0
+                 ) -> Intercommunicator:
+    state = comm.state
+    import numpy as np
+    meta = np.empty(2, dtype=np.int64)
+    if comm.rank == root:
+        kv = _kv(state)
+        acc = kv.get(f"port:{port}:accept", timeout=300.0)
+        kv.put(f"port:{port}:connect", {"leader": state.rank})
+        meta[0] = acc["cid"]
+        meta[1] = acc["leader"]
+    comm.Bcast(meta, root=root)
+    cid, remote_leader = int(meta[0]), int(meta[1])
+    return _bridged_create(comm, root, cid, remote_leader,
+                           accept_side=False)
+
+
+def _bridged_create(comm: Communicator, root: int, bridge_cid: int,
+                    remote_leader: int, accept_side: bool
+                    ) -> Intercommunicator:
+    """Common tail: make dynamic peers addressable, build the bridge,
+    run the intercomm creation handshake over it."""
+    from ompi_tpu.runtime.init import extend_universe
+
+    state = comm.state
+    # make the remote LEADER addressable first (the handshake is
+    # leader-to-leader); the full remote group is learned during
+    # creation and covered right after
+    extend_universe(state, remote_leader + 1)
+    if comm.rank == root:
+        leaders = ([state.rank, remote_leader] if accept_side
+                   else [remote_leader, state.rank])
+        bridge = _BridgeComm(state, bridge_cid, leaders)
+        inter = intercomm_create(comm, root, bridge,
+                                 bridge._bridge_peer(), tag=0)
+    else:
+        inter = intercomm_create(comm, root, None, 0, tag=0)
+    # now every remote member is known: cover the whole remote group
+    extend_universe(state, max(inter.group) + 1)
+    return inter
+
+
+# ---------------------------------------------------------------------
+# spawn (ref: dpm.c ompi_dpm_spawn + MPI_Comm_spawn)
+# ---------------------------------------------------------------------
+
+def comm_spawn(comm: Communicator, cmd: str, args: List[str],
+               maxprocs: int, root: int = 0) -> Intercommunicator:
+    """Collective over `comm`: launch `maxprocs` new universe ranks
+    running `cmd` and return the parent-side intercomm."""
+    from ompi_tpu.runtime.init import extend_universe
+
+    state = comm.state
+    import numpy as np
+    meta = np.empty(1, dtype=np.int64)
+    if comm.rank == root:
+        base = _kv(state).spawn(cmd, list(args), maxprocs, state.rank)
+        meta[0] = base
+    comm.Bcast(meta, root=root)
+    base = int(meta[0])
+    extend_universe(state, base + maxprocs)
+    bridge_cid = _SPAWN_CID_BASE - base
+    if comm.rank == root:
+        bridge = _BridgeComm(state, bridge_cid, [state.rank, base])
+        return intercomm_create(comm, root, bridge, 1, tag=0)
+    return intercomm_create(comm, root, None, 1, tag=0)
+
+
+def get_parent(comm_world: Communicator) -> Optional[Intercommunicator]:
+    """MPI_Comm_get_parent analog: in a spawned job, the intercomm to
+    the spawning communicator (collective over comm_world on first
+    call)."""
+    state = comm_world.state
+    parent_root = getattr(state.rte, "parent_root", None)
+    if parent_root is None:
+        return None
+    cached = state.extra.get("parent_intercomm")
+    if cached is not None:
+        return cached
+    parent_root = int(parent_root)
+    base = getattr(state.rte, "world_base", 0)
+    bridge_cid = _SPAWN_CID_BASE - base
+    if comm_world.rank == 0:
+        bridge = _BridgeComm(state, bridge_cid,
+                             [parent_root, state.rank])
+        inter = intercomm_create(comm_world, 0, bridge, 0, tag=0)
+    else:
+        inter = intercomm_create(comm_world, 0, None, 0, tag=0)
+    state.extra["parent_intercomm"] = inter
+    return inter

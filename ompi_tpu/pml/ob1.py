@@ -140,10 +140,12 @@ class PmlOb1:
               mode=MODE_STANDARD, offset: int = 0) -> Request:
         if dst == PROC_NULL:
             return CompletedRequest(self.state.progress)
-        if not 0 <= dst < comm.size:
+        if not 0 <= dst < len(comm.group):
+            # comm.group is the p2p translation table: the membership
+            # for intracomms, the REMOTE group for intercomms
             raise ValueError(
-                f"invalid rank {dst} for {comm.size}-rank communicator "
-                "(MPI_ERR_RANK)")
+                f"invalid rank {dst} for {len(comm.group)}-rank "
+                "destination group (MPI_ERR_RANK)")
         gdst = comm.group[dst]
         ep = self._ep(gdst)
         btl = ep.btl

@@ -22,8 +22,19 @@ from .state import ProcState, clear_current, set_current
 
 
 def mpi_init(state: ProcState, device=None) -> ProcState:
+    import os
+
     set_current(state)
     state.device = device
+    # refine the oversubscription hint with the true local-rank count:
+    # thread-rank worlds (inproc/hybrid) know it exactly; process-ranks
+    # read the launcher's TPUMPI_LOCAL_SIZE (ref: the reference
+    # auto-enables yield_when_idle when ranks exceed cores)
+    world = getattr(state.rte, "world", None)
+    nlocal = getattr(world, "nlocal", None) or (
+        world.size if world is not None
+        else int(os.environ.get("TPUMPI_LOCAL_SIZE", "1")))
+    state.progress.oversubscribed = nlocal > (os.cpu_count() or 1)
     # 1. select the single pml engine (ref: ompi_mpi_init.c:640),
     # optionally interposed by pml/monitoring
     comp, pml_cls = _pml_ob1.pml_framework.select_one(state)
@@ -45,8 +56,13 @@ def mpi_init(state: ProcState, device=None) -> ProcState:
     state.rte.fence()
     endpoints = btl_base.wire_endpoints(state, modules)
     state.pml.add_procs(endpoints)
-    # 3. predefined communicators: world cid 0, self cid 1
-    state.comm_world = Communicator(state, 0, Group(range(state.size)),
+    # 3. predefined communicators: world cid 0, self cid 1.  The world
+    # group is this JOB's rank block — a spawned job's world starts at
+    # its universe base (dpm, ref: ompi/dpm)
+    wbase = getattr(state.rte, "world_base", 0)
+    wsize = getattr(state.rte, "world_size", state.size)
+    state.comm_world = Communicator(state, 0,
+                                    Group(range(wbase, wbase + wsize)),
                                     name="MPI_COMM_WORLD")
     state.comm_self = Communicator(state, 1, Group([state.rank]),
                                    name="MPI_COMM_SELF")
@@ -56,6 +72,31 @@ def mpi_init(state: ProcState, device=None) -> ProcState:
     state.rte.fence()
     state.initialized = True
     return state
+
+
+def extend_universe(state: ProcState, new_size: int) -> None:
+    """Make universe ranks [state.size, new_size) addressable: grow
+    the endpoint table and let each btl prepare for the new peers
+    (the dynamic-peer half of the reference's connect/accept
+    MCA_PML_CALL(add_procs) path, ref: ompi/dpm/dpm.c)."""
+    if new_size <= state.size:
+        return
+    old = state.size
+    state.size = new_size
+    for m in state.btls:
+        ext = getattr(m, "extend", None)
+        if ext is not None:
+            ext(new_size)
+    eps = list(state.pml.endpoints)
+    for peer in range(old, new_size):
+        best = None
+        for m in state.btls:
+            if m.reaches(peer) and (best is None
+                                    or m.exclusivity > best.exclusivity):
+                best = m
+        eps.append(btl_base.Endpoint(peer, best)
+                   if best is not None else None)
+    state.pml.add_procs(eps)
 
 
 def mpi_finalize(state: ProcState) -> None:

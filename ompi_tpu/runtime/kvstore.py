@@ -57,6 +57,12 @@ class KVServer:
         self.fences: Dict[str, int] = {}
         self.fence_waiters: Dict[str, List[socket.socket]] = {}
         self.aborted: Optional[Tuple[int, int, str]] = None
+        # dpm: the universe rank space grows as jobs are spawned
+        # (ref: ompi/dpm over the PMIx server); mpirun drains
+        # spawn_requests and launches when spawn_enabled
+        self.universe = nprocs
+        self.spawn_enabled = False
+        self.spawn_requests: List[dict] = []
         self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self.sock.bind((host, 0))
@@ -108,10 +114,11 @@ class KVServer:
                             _send_msg(conn, {"value": self.data[msg["key"]]})
                 elif op == "fence":
                     fid = msg["id"]
+                    want = int(msg.get("n", self.nprocs))
                     with self.cv:
                         self.fences[fid] = self.fences.get(fid, 0) + 1
                         self.fence_waiters.setdefault(fid, []).append(conn)
-                        if self.fences[fid] == self.nprocs:
+                        if self.fences[fid] == want:
                             for c in self.fence_waiters[fid]:
                                 try:
                                     _send_msg(c, {"fence_done": fid})
@@ -128,6 +135,26 @@ class KVServer:
                                             msg.get("msg", ""))
                         self.cv.notify_all()
                     _send_msg(conn, {"ok": True})
+                elif op == "spawn":
+                    # allocate a universe-rank block and hand the
+                    # launch to mpirun's supervision loop
+                    with self.cv:
+                        if not self.spawn_enabled:
+                            _send_msg(conn, {
+                                "error": "dynamic spawn is not "
+                                         "supported by this launcher"})
+                            continue
+                        base = self.universe
+                        self.universe += int(msg["maxprocs"])
+                        self.spawn_requests.append({
+                            "base": base,
+                            "maxprocs": int(msg["maxprocs"]),
+                            "cmd": msg["cmd"],
+                            "args": msg.get("args") or [],
+                            "parent_root": int(msg["parent_root"]),
+                        })
+                        self.cv.notify_all()
+                    _send_msg(conn, {"base": base})
         except OSError:
             return
 
@@ -178,12 +205,30 @@ class KVClient:
             raise TimeoutError(f"kv get({key}) timed out")
         return resp["value"]
 
-    def fence(self, fence_id: str) -> None:
+    def fence(self, fence_id: str, n: Optional[int] = None) -> None:
         with self._lock:
-            _send_msg(self._sock, {"op": "fence", "id": fence_id})
+            msg = {"op": "fence", "id": fence_id}
+            if n is not None:
+                msg["n"] = n
+            _send_msg(self._sock, msg)
             resp = _recv_msg(self._sock)
         if resp is None or "fence_done" not in resp:
             raise RuntimeError(f"fence {fence_id} failed: {resp}")
+
+    def spawn(self, cmd: str, args: List[str], maxprocs: int,
+              parent_root: int) -> int:
+        """Ask the launcher for `maxprocs` new universe ranks running
+        `cmd`; returns the allocated rank base."""
+        with self._lock:
+            _send_msg(self._sock, {"op": "spawn", "cmd": cmd,
+                                   "args": args, "maxprocs": maxprocs,
+                                   "parent_root": parent_root})
+            resp = _recv_msg(self._sock)
+        if resp is None:
+            raise ConnectionError("kv server closed")
+        if "error" in resp:
+            raise RuntimeError(f"MPI_Comm_spawn: {resp['error']}")
+        return int(resp["base"])
 
     def abort(self, rank: int, code: int, msg: str = "") -> None:
         with self._lock:

@@ -130,7 +130,17 @@ class EnvRTE(RTE):
         from .kvstore import KVClient  # noqa: PLC0415
 
         self.rank = int(os.environ["TPUMPI_RANK"])
-        self.size = int(os.environ["TPUMPI_SIZE"])
+        # world = this job's ranks; universe = every rank launched so
+        # far (dpm: spawned jobs extend the universe, ref: ompi/dpm).
+        # `size` is the universe extent — it sizes endpoint tables so
+        # dynamic peers are addressable; comm_world uses world_base/
+        # world_size.
+        self.world_size = int(os.environ.get(
+            "TPUMPI_WORLD_SIZE", os.environ["TPUMPI_SIZE"]))
+        self.world_base = int(os.environ.get("TPUMPI_WORLD_BASE", "0"))
+        self.size = int(os.environ.get(
+            "TPUMPI_UNIVERSE", os.environ["TPUMPI_SIZE"]))
+        self.parent_root = os.environ.get("TPUMPI_PARENT_ROOT")
         self.jobid = os.environ.get("TPUMPI_JOBID", "job0")
         self.node_id = int(os.environ.get("TPUMPI_NODE", "0"))
         self.session_dir = os.environ.get("TPUMPI_SESSION_DIR", "/tmp")
@@ -144,8 +154,11 @@ class EnvRTE(RTE):
         return self.kv.get(f"modex:{peer}:{key}")
 
     def fence(self) -> None:
+        # namespaced by job and sized to the job's world: spawned
+        # jobs fence among themselves, never with the parent job
         self._fence_count += 1
-        self.kv.fence(f"f{self._fence_count}")
+        self.kv.fence(f"{self.jobid}:f{self._fence_count}",
+                      n=self.world_size)
 
     def abort(self, code: int, msg: str = "") -> None:
         import os
@@ -181,6 +194,8 @@ class HybridRTE(EnvRTE):
         self.world = world
         self.rank = rank
         self.size = world.size
+        self.world_base = 0
+        self.world_size = world.size
         self.jobid = jobid
         self.node_id = node_id
         self.session_dir = session_dir

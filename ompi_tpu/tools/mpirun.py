@@ -282,9 +282,50 @@ def main(argv: Optional[List[str]] = None) -> int:
                 fwd_threads.append(t)
 
         deadline = time.monotonic() + opts.timeout if opts.timeout else None
+        server.spawn_enabled = True  # dpm supported on the local path
+
+        def drain_spawns() -> None:
+            """Launch dynamically spawned jobs (ompi/dpm analog)."""
+            with server.cv:
+                reqs, server.spawn_requests = server.spawn_requests, []
+            for rq in reqs:
+                base, k = rq["base"], rq["maxprocs"]
+                prog = rq["cmd"]
+                cmd0 = [sys.executable, prog] + rq["args"] \
+                    if prog.endswith(".py") else [prog] + rq["args"]
+                for i in range(k):
+                    env = dict(env_base)
+                    env.update({
+                        "TPUMPI_RANK": str(base + i),
+                        "TPUMPI_SIZE": str(k),
+                        "TPUMPI_WORLD_BASE": str(base),
+                        "TPUMPI_WORLD_SIZE": str(k),
+                        "TPUMPI_UNIVERSE": str(base + k),
+                        "TPUMPI_LOCAL_SIZE": str(k),
+                        "TPUMPI_JOBID": f"job-{os.getpid()}-s{base}",
+                        "TPUMPI_PARENT_ROOT": str(rq["parent_root"]),
+                    })
+                    env.pop("TPUMPI_RANK_BASE", None)
+                    env.pop("TPUMPI_LOCAL_RANKS", None)
+                    p = subprocess.Popen(
+                        cmd0, env=env, cwd=opts.wdir,
+                        stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+                    procs.append(p)
+                    spawn_specs.append((base + i, 0, -1))
+                    for stream, out in ((p.stdout, sys.stdout.buffer),
+                                        (p.stderr, sys.stderr.buffer)):
+                        t = threading.Thread(
+                            target=_forward,
+                            args=(stream, out, f"s{base + i}",
+                                  opts.tag_output),
+                            daemon=True)
+                        t.start()
+                        fwd_threads.append(t)
+
         # errmgr default-HNP policy: first abnormal exit (or KV abort)
         # kills the job and its code is the job's code
         while True:
+            drain_spawns()
             alive = [p for p in procs if p.poll() is None]
             failed = [p for p in procs
                       if p.returncode not in (None, 0)]

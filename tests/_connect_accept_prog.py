@@ -1,0 +1,23 @@
+"""Connect/accept between two halves of one job through a named port
+(run under mpirun by test_intercomm.py)."""
+import numpy as np
+
+import ompi_tpu
+from ompi_tpu.comm import dpm
+from ompi_tpu.op import op as mpi_op
+
+comm = ompi_tpu.init()
+half = comm.size // 2
+low = comm.rank < half
+local = comm.split(0 if low else 1)
+if low:
+    inter = dpm.comm_accept(local, "ca-test-port")
+else:
+    inter = dpm.comm_connect(local, "ca-test-port")
+s = np.array([1.0 if low else 2.0])
+r = np.empty(1)
+inter.Allreduce(s, r, mpi_op.SUM)
+expect = 2.0 * (comm.size - half) if low else 1.0 * half
+assert r[0] == expect, (comm.rank, r[0], expect)
+print("ok", flush=True)
+ompi_tpu.finalize()

@@ -1,0 +1,167 @@
+"""Intercommunicator + dynamic process management tests
+(ref: orte/test/mpi/intercomm_create.c, loop_spawn.c;
+ompi/communicator/comm.c intercomm paths; ompi/dpm/dpm.c)."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from ompi_tpu.comm.intercomm import ROOT, intercomm_create
+from ompi_tpu.op import op as mpi_op
+from ompi_tpu.pml.request import PROC_NULL
+from ompi_tpu.testing import run_ranks
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _mk_inter(comm, half):
+    """Split world into [0,half) and [half,n); bridge leaders 0 and
+    half over comm."""
+    low = comm.rank < half
+    local = comm.split(0 if low else 1)
+    inter = intercomm_create(local, 0, comm, half if low else 0, tag=9)
+    return inter, local, low
+
+
+def test_create_sizes_and_groups():
+    def fn(comm):
+        inter, local, low = _mk_inter(comm, 2)
+        assert inter.is_inter
+        assert inter.size == local.size
+        assert inter.remote_size == comm.size - local.size
+        locals_ = inter.local_group_obj().ranks
+        remotes = inter.remote_group_obj().ranks
+        assert sorted(locals_ + remotes) == list(range(comm.size))
+        return True
+
+    assert run_ranks(5, fn) == [True] * 5
+
+
+def test_p2p_across_bridge():
+    def fn(comm):
+        from ompi_tpu.datatype import engine as dt
+        inter, local, low = _mk_inter(comm, 3)
+        pml = comm.state.pml
+        if local.rank < min(inter.size, inter.remote_size):
+            x = np.array([comm.rank], dtype=np.int64)
+            y = np.empty(1, dtype=np.int64)
+            s = pml.isend(x, 1, dt.INT64_T, local.rank, -60, inter)
+            pml.recv(y, 1, dt.INT64_T, local.rank, -60, inter)
+            s.wait()
+            expect = comm.rank + 3 if low else comm.rank - 3
+            assert int(y[0]) == expect
+        inter.Barrier()
+        return True
+
+    assert run_ranks(6, fn) == [True] * 6
+
+
+def test_rooted_bcast_and_reduce():
+    def fn(comm):
+        inter, local, low = _mk_inter(comm, 2)
+        # bcast: global rank 0 (low leader) -> high group
+        buf = np.array([7.5 if comm.rank == 0 else 0.0])
+        if low:
+            inter.Bcast(buf, root=ROOT if comm.rank == 0 else PROC_NULL)
+        else:
+            inter.Bcast(buf, root=0)
+            assert buf[0] == 7.5
+        # reduce: high group's data lands at low leader
+        s = np.array([float(comm.rank)])
+        r = np.zeros(1)
+        if low:
+            inter.Reduce(s, r, mpi_op.SUM,
+                         root=ROOT if comm.rank == 0 else PROC_NULL)
+            if comm.rank == 0:
+                assert r[0] == sum(range(2, comm.size))
+        else:
+            inter.Reduce(s, None, mpi_op.SUM, root=0)
+        return True
+
+    assert run_ranks(5, fn) == [True] * 5
+
+
+def test_allreduce_exchanges_groups():
+    def fn(comm):
+        inter, local, low = _mk_inter(comm, 3)
+        s = np.array([float(comm.rank + 1)])
+        r = np.empty(1)
+        inter.Allreduce(s, r, mpi_op.SUM)
+        low_sum = sum(range(1, 4))
+        high_sum = sum(range(4, comm.size + 1))
+        assert r[0] == (high_sum if low else low_sum)
+        return True
+
+    assert run_ranks(6, fn) == [True] * 6
+
+
+def test_allgather_and_alltoall():
+    def fn(comm):
+        inter, local, low = _mk_inter(comm, 2)
+        rs = inter.remote_size
+        s = np.array([float(comm.rank)], dtype=np.float64)
+        r = np.empty(rs, dtype=np.float64)
+        inter.Allgather(s, r)
+        remote = inter.remote_group_obj().ranks
+        assert list(r) == [float(g) for g in remote]
+        # alltoall: block i goes to remote rank i
+        sb = np.array([comm.rank * 10.0 + i for i in range(rs)])
+        rb = np.empty(rs)
+        inter.Alltoall(sb, rb)
+        for i, g in enumerate(remote):
+            assert rb[i] == g * 10.0 + local.rank
+        return True
+
+    assert run_ranks(4, fn) == [True] * 4
+
+
+def test_merge_orders_low_first():
+    def fn(comm):
+        inter, local, low = _mk_inter(comm, 2)
+        merged = inter.merge(high=not low)
+        assert merged.size == comm.size
+        assert merged.rank == comm.rank
+        r = np.empty(1)
+        merged.Allreduce(np.array([1.0]), r, mpi_op.SUM)
+        assert r[0] == comm.size
+        return True
+
+    assert run_ranks(4, fn) == [True] * 4
+
+
+def test_connect_accept_same_job():
+    """Two halves of one job rendezvous through a named port (needs
+    the launcher's KV server, so it runs under mpirun)."""
+    prog = os.path.join(REPO, "tests", "_connect_accept_prog.py")
+    r = _mpirun(4, prog)
+    assert r.returncode == 0, r.stderr.decode()
+    assert r.stdout.decode().count("ok") == 4
+
+
+def _mpirun(np_, prog, *args, timeout=120):
+    cmd = [sys.executable, "-m", "ompi_tpu.tools.mpirun", "-np",
+           str(np_), "--timeout", "90", prog, *args]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.run(cmd, capture_output=True, timeout=timeout,
+                          env=env, cwd=REPO)
+
+
+def test_spawn_under_mpirun():
+    r = _mpirun(3, os.path.join(REPO, "examples", "spawn_parent.py"))
+    assert r.returncode == 0, r.stderr.decode()
+    out = r.stdout.decode()
+    assert out.count("merged size 5") == 3
+    assert "worker 1: merged rank 4/5" in out
+
+
+def test_spawn_loop_under_mpirun():
+    """Repeated spawns extend the universe each time
+    (loop_spawn.c analog, small loop)."""
+    r = _mpirun(2, os.path.join(REPO, "tests", "_loop_spawn_prog.py"))
+    assert r.returncode == 0, r.stderr.decode()
+    assert "loop-spawn done 3 rounds" in r.stdout.decode()
