@@ -85,9 +85,13 @@ class Communicator:
         self.rank = group.rank_of(state.rank)
         self.size = group.size
         self.coll: Any = None       # collective module stack (coll framework)
-        self.errhandler = None
+        # Python surface default is ERRORS_RETURN (raising IS the
+        # error return; install ERRORS_ARE_FATAL for C semantics —
+        # see ompi_tpu/errhandler.py, ref: ompi/errhandler)
+        from ompi_tpu import errhandler as _eh
+        self.errhandler = _eh.ERRORS_RETURN
         self.attrs: Dict[int, Any] = {}
-        self.info: Dict[str, str] = {}
+        self.info = None  # MPI_Info hints (Set_info/Get_info)
         self.topo = None
         self._mesh = None
         state.comms[cid] = self
@@ -149,10 +153,15 @@ class Communicator:
 
     # -- management operations ------------------------------------------
     def dup(self, name: str = "") -> "Communicator":
+        from ompi_tpu import attrs as _attrs
         cid = self.next_cid()
         new = Communicator(self.state, cid, Group(self.group),
                            name or f"{self.name}-dup")
         new.topo = self.topo  # MPI_Comm_dup carries the topology over
+        new.errhandler = self.errhandler
+        if self.info is not None:
+            new.info = self.info.dup()
+        _attrs.copy_all(self, new)  # attribute copy callbacks
         return new
 
     def create(self, group: Group) -> Optional["Communicator"]:
@@ -215,6 +224,8 @@ class Communicator:
         return self.split(UNDEFINED, key)
 
     def free(self) -> None:
+        from ompi_tpu import attrs as _attrs
+        _attrs.delete_all(self)  # attribute delete callbacks
         self.state.comms.pop(self.cid, None)
         # keep the cid burned so in-flight traffic can't alias it
         self.state.comms.setdefault(self.cid, None)
@@ -253,6 +264,38 @@ class Communicator:
 
     def abort(self, errorcode: int = 1) -> None:
         self.state.rte.abort(errorcode, f"abort on {self.name}")
+
+    # -- error handlers (ref: ompi/errhandler) --------------------------
+    def Set_errhandler(self, handler) -> None:
+        self.errhandler = handler
+
+    def Get_errhandler(self):
+        return self.errhandler
+
+    def Call_errhandler(self, errorcode: int) -> None:
+        from ompi_tpu import errhandler as _eh
+        _eh.dispatch(self, _eh.MPIException(errorcode))
+
+    # -- attributes (ref: ompi/attribute/attribute.c) -------------------
+    def Set_attr(self, keyval: int, value: Any) -> None:
+        from ompi_tpu import attrs as _attrs
+        _attrs.set_attr(self, keyval, value)
+
+    def Get_attr(self, keyval: int):
+        from ompi_tpu import attrs as _attrs
+        return _attrs.get_attr(self, keyval)
+
+    def Delete_attr(self, keyval: int) -> None:
+        from ompi_tpu import attrs as _attrs
+        _attrs.delete_attr(self, keyval)
+
+    # -- info hints (ref: ompi/info/info.c) -----------------------------
+    def Set_info(self, info) -> None:
+        self.info = info
+
+    def Get_info(self):
+        from ompi_tpu.info import Info
+        return self.info.dup() if self.info is not None else Info()
 
     # -- intercommunicators + dynamic process management ----------------
     @property
@@ -804,3 +847,42 @@ class Communicator:
     def __repr__(self) -> str:
         return (f"Communicator({self.name}, cid={self.cid}, "
                 f"rank={self.rank}/{self.size})")
+
+
+# ---------------------------------------------------------------------------
+# errhandler-guarded dispatch: every public operation routes raised
+# errors through the communicator's installed handler
+# (ref: OMPI_ERRHANDLER_INVOKE wrapping each ompi/mpi/c binding).
+# With the default ERRORS_RETURN this re-raises unchanged; with
+# ERRORS_ARE_FATAL the job aborts; user handlers run first.
+# ---------------------------------------------------------------------------
+
+def _guard(method):
+    import functools
+
+    @functools.wraps(method)
+    def wrapped(self, *args, **kwargs):
+        try:
+            return method(self, *args, **kwargs)
+        except (SystemExit, KeyboardInterrupt):
+            raise
+        except BaseException as exc:  # noqa: BLE001
+            from ompi_tpu import errhandler as _eh
+            _eh.dispatch(self, exc)
+
+    return wrapped
+
+
+_GUARDED = (
+    "Send", "Recv", "Isend", "Irecv", "Ssend", "Rsend", "Bsend",
+    "Sendrecv", "Probe", "Iprobe", "Mprobe", "Mrecv",
+    "Barrier", "Bcast", "Reduce", "Allreduce", "Allgather",
+    "Allgatherv", "Gather", "Gatherv", "Scatter", "Scatterv",
+    "Alltoall", "Alltoallv", "Reduce_scatter", "Reduce_scatter_block",
+    "Scan", "Exscan",
+)
+for _name in _GUARDED:
+    _m = getattr(Communicator, _name, None)
+    if _m is not None:
+        setattr(Communicator, _name, _guard(_m))
+del _name, _m

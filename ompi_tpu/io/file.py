@@ -65,10 +65,16 @@ class File:
 
     def __init__(self, comm, filename: str, amode: int,
                  info=None) -> None:
+        from ompi_tpu import errhandler as _eh
         self.comm = comm
         self.filename = filename
         self.amode = amode
-        self.info = dict(info or {})
+        # accepts an ompi_tpu.info.Info or a plain mapping
+        self.info = dict(info.items()) if hasattr(info, "items") \
+            else dict(info or {})
+        self.errhandler = _eh.ERRORS_RETURN
+        self.attrs = {}
+        self.state = comm.state
         self._lock = threading.Lock()
         # fs: open is collective; every rank opens its own descriptor
         # (ufs model), errors surfaced on all ranks via an agreement
@@ -351,3 +357,8 @@ def open(comm, filename: str, amode: int = MODE_RDONLY,
 
 def delete(filename: str) -> None:
     os.unlink(filename)
+
+
+from ompi_tpu import errhandler as _eh_mod  # noqa: E402
+
+_eh_mod.attach_api(File)

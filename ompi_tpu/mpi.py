@@ -595,6 +595,250 @@ def MPI_File_iwrite_at(fh, offset, buf, count, datatype):
     return fh.iwrite_at(offset, (buf, count, datatype))
 
 
+# -- error handlers (ref: ompi/errhandler, ompi/mpi/c/comm_set_errhandler.c)
+from ompi_tpu.errhandler import (  # noqa: E402,F401
+    ERRORS_ARE_FATAL as MPI_ERRORS_ARE_FATAL,
+    ERRORS_RETURN as MPI_ERRORS_RETURN,
+    ERRORS_ABORT as MPI_ERRORS_ABORT,
+    Errhandler, MPIException, error_string as _error_string,
+    classify as _classify,
+)
+from ompi_tpu import errhandler as _eh_mod  # noqa: E402
+
+MPI_ERR_LASTCODE = _eh_mod.ERR_LASTCODE
+for _k in dir(_eh_mod):
+    if _k.startswith("ERR_"):
+        globals()["MPI_" + _k] = getattr(_eh_mod, _k)
+
+
+def MPI_Comm_create_errhandler(fn):
+    return Errhandler(fn)
+
+
+MPI_Win_create_errhandler = MPI_Comm_create_errhandler
+MPI_File_create_errhandler = MPI_Comm_create_errhandler
+
+
+def MPI_Errhandler_free(handler):
+    return None
+
+
+def MPI_Comm_set_errhandler(comm, handler):
+    comm.Set_errhandler(handler)
+
+
+def MPI_Comm_get_errhandler(comm):
+    return comm.Get_errhandler()
+
+
+def MPI_Comm_call_errhandler(comm, errorcode: int):
+    comm.Call_errhandler(errorcode)
+
+
+def MPI_Win_set_errhandler(win, handler):
+    win.Set_errhandler(handler)
+
+
+def MPI_Win_get_errhandler(win):
+    return win.Get_errhandler()
+
+
+def MPI_Win_call_errhandler(win, errorcode: int):
+    win.Call_errhandler(errorcode)
+
+
+def MPI_File_set_errhandler(fh, handler):
+    fh.Set_errhandler(handler)
+
+
+def MPI_File_get_errhandler(fh):
+    return fh.Get_errhandler()
+
+
+def MPI_File_call_errhandler(fh, errorcode: int):
+    fh.Call_errhandler(errorcode)
+
+
+def MPI_Error_class(errorcode: int) -> int:
+    return errorcode  # codes ARE classes here (ref: errcode.c identity)
+
+
+def MPI_Error_string(errorcode: int) -> str:
+    return _error_string(errorcode)
+
+
+# -- attributes (ref: ompi/attribute/attribute.c) ----------------------------
+from ompi_tpu import attrs as _attrs_mod  # noqa: E402
+
+MPI_TAG_UB = _attrs_mod.TAG_UB
+MPI_WTIME_IS_GLOBAL = _attrs_mod.WTIME_IS_GLOBAL
+MPI_UNIVERSE_SIZE = _attrs_mod.UNIVERSE_SIZE
+MPI_APPNUM = _attrs_mod.APPNUM
+MPI_KEYVAL_INVALID = -1
+
+
+def MPI_Comm_create_keyval(copy_fn=None, delete_fn=None,
+                           extra_state=None) -> int:
+    return _attrs_mod.create_keyval(copy_fn, delete_fn, extra_state)
+
+
+MPI_Win_create_keyval = MPI_Comm_create_keyval
+MPI_Type_create_keyval = MPI_Comm_create_keyval
+
+
+def MPI_Comm_free_keyval(keyval: int):
+    _attrs_mod.free_keyval(keyval)
+
+
+MPI_Win_free_keyval = MPI_Comm_free_keyval
+MPI_Type_free_keyval = MPI_Comm_free_keyval
+
+
+def MPI_Comm_set_attr(comm, keyval: int, value):
+    _attrs_mod.set_attr(comm, keyval, value)
+
+
+def MPI_Comm_get_attr(comm, keyval: int):
+    return _attrs_mod.get_attr(comm, keyval)
+
+
+def MPI_Comm_delete_attr(comm, keyval: int):
+    _attrs_mod.delete_attr(comm, keyval)
+
+
+MPI_Win_set_attr = MPI_Comm_set_attr
+MPI_Win_get_attr = MPI_Comm_get_attr
+MPI_Win_delete_attr = MPI_Comm_delete_attr
+# deprecated MPI-1 names
+MPI_Attr_put = MPI_Comm_set_attr
+MPI_Attr_get = MPI_Comm_get_attr
+MPI_Attr_delete = MPI_Comm_delete_attr
+MPI_Keyval_create = MPI_Comm_create_keyval
+MPI_Keyval_free = MPI_Comm_free_keyval
+
+
+# -- info objects (ref: ompi/info/info.c) ------------------------------------
+from ompi_tpu.info import Info as _Info, info_env as _info_env  # noqa: E402
+
+MPI_INFO_NULL = None
+MPI_MAX_INFO_KEY = 255
+MPI_MAX_INFO_VAL = 1024
+
+
+def MPI_Info_create() -> _Info:
+    return _Info()
+
+
+def MPI_Info_set(info: _Info, key: str, value: str):
+    info.set(key, value)
+
+
+def MPI_Info_get(info: _Info, key: str):
+    return info.get(key)
+
+
+def MPI_Info_delete(info: _Info, key: str):
+    info.delete(key)
+
+
+def MPI_Info_get_nkeys(info: _Info) -> int:
+    return info.nkeys()
+
+
+def MPI_Info_get_nthkey(info: _Info, n: int) -> str:
+    return info.nthkey(n)
+
+
+def MPI_Info_dup(info: _Info) -> _Info:
+    return info.dup()
+
+
+def MPI_Info_free(info: _Info):
+    return None
+
+
+def MPI_Info_env() -> _Info:
+    from ompi_tpu.runtime import state as _st
+    return _info_env(_st.maybe_current())
+
+
+def MPI_Comm_set_info(comm, info):
+    comm.Set_info(info)
+
+
+def MPI_Comm_get_info(comm):
+    return comm.Get_info()
+
+
+# -- intercommunicators + dpm (ref: ompi/mpi/c/intercomm_create.c,
+# ompi/dpm/dpm.c) -------------------------------------------------------------
+from ompi_tpu.comm.intercomm import ROOT as MPI_ROOT  # noqa: E402,F401
+
+
+def MPI_Intercomm_create(local_comm, local_leader, peer_comm,
+                         remote_leader, tag=0):
+    return local_comm.create_intercomm(local_leader, peer_comm,
+                                       remote_leader, tag)
+
+
+def MPI_Intercomm_merge(intercomm, high: bool = False):
+    return intercomm.merge(high)
+
+
+def MPI_Comm_test_inter(comm) -> bool:
+    return comm.is_inter
+
+
+def MPI_Comm_remote_size(comm) -> int:
+    return comm.remote_size
+
+
+def MPI_Comm_remote_group(comm):
+    return comm.remote_group_obj()
+
+
+def MPI_Comm_spawn(command, argv, maxprocs, info=None, root=0,
+                   comm=None):
+    comm = comm if comm is not None else MPI_COMM_WORLD()
+    return comm.spawn(command, argv or (), maxprocs, root)
+
+
+def MPI_Comm_get_parent():
+    return _top.get_parent()
+
+
+def MPI_Open_port(info=None) -> str:
+    return _top.open_port()
+
+
+def MPI_Close_port(port: str):
+    return None
+
+
+def MPI_Comm_accept(port, info=None, root=0, comm=None):
+    comm = comm if comm is not None else MPI_COMM_WORLD()
+    return comm.accept(port, root)
+
+
+def MPI_Comm_connect(port, info=None, root=0, comm=None):
+    comm = comm if comm is not None else MPI_COMM_WORLD()
+    return comm.connect(port, root)
+
+
+def MPI_Publish_name(service, info, port):
+    _top.publish_name(service, port)
+
+
+def MPI_Lookup_name(service, info=None) -> str:
+    return _top.lookup_name(service)
+
+
+def MPI_Unpublish_name(service, info, port):
+    from ompi_tpu.comm.dpm import unpublish_name as _un
+    from ompi_tpu.runtime import state as _st
+    _un(_st.current(), service)
+
+
 # -- PMPI aliases (profiling layer, ref: ompi/mpi/c/init.c:35-37) -----------
 
 _mod = _sys.modules[__name__]

@@ -87,11 +87,17 @@ class Window:
     """MPI_Win over a local memory region (ref: ompi/win/win.c)."""
 
     def __init__(self, comm, memory: Optional[np.ndarray],
-                 disp_unit: int = 1, name: str = "") -> None:
+                 disp_unit: int = 1, name: str = "",
+                 info=None) -> None:
+        from ompi_tpu import errhandler as _eh
         base = comm.dup(name or f"win-{id(self):x}")
         self.comm = base
         self.rank = base.rank
         self.size = base.size
+        self.errhandler = _eh.ERRORS_RETURN
+        self.attrs = {}
+        self.info = info
+        self.state = comm.state  # errhandler dispatch needs the rte
         if memory is None:
             memory = np.zeros(0, dtype=np.uint8)
         if not (isinstance(memory, np.ndarray) and memory.flags.c_contiguous):
@@ -509,13 +515,18 @@ class Window:
 
 
 def create(comm, memory: np.ndarray, disp_unit: Optional[int] = None,
-           name: str = "") -> Window:
+           name: str = "", info=None) -> Window:
     """MPI_Win_create (ref: ompi/mpi/c/win_create.c)."""
     if disp_unit is None:
         disp_unit = memory.dtype.itemsize if memory.size else 1
-    return Window(comm, memory, disp_unit, name)
+    return Window(comm, memory, disp_unit, name, info=info)
 
 
 def allocate(comm, nbytes: int, disp_unit: int = 1, name: str = "") -> Window:
     """MPI_Win_allocate: window-owned zeroed memory."""
     return Window(comm, np.zeros(nbytes, dtype=np.uint8), disp_unit, name)
+
+
+from ompi_tpu import errhandler as _eh_mod  # noqa: E402
+
+_eh_mod.attach_api(Window)

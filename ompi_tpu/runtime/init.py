@@ -64,6 +64,8 @@ def mpi_init(state: ProcState, device=None) -> ProcState:
     state.comm_world = Communicator(state, 0,
                                     Group(range(wbase, wbase + wsize)),
                                     name="MPI_COMM_WORLD")
+    from ompi_tpu import attrs as _attrs
+    _attrs.init_world_attrs(state.comm_world)
     state.comm_self = Communicator(state, 1, Group([state.rank]),
                                    name="MPI_COMM_SELF")
     # 4. collective module stacks are installed by Communicator

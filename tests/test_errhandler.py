@@ -1,0 +1,212 @@
+"""Errhandler / attribute-keyval / Info machinery tests
+(ref: ompi/errhandler/errhandler.h, ompi/attribute/attribute.c,
+ompi/info/info.c)."""
+
+import numpy as np
+import pytest
+
+from ompi_tpu import attrs, errhandler, mpi
+from ompi_tpu.errhandler import (ERRORS_ARE_FATAL, ERRORS_RETURN,
+                                 Errhandler, MPIException)
+from ompi_tpu.info import Info, info_env
+from ompi_tpu.op import op as mpi_op
+from ompi_tpu.testing import run_ranks
+
+
+# ---- error classes / dispatch --------------------------------------
+
+def test_error_classify_and_string():
+    assert errhandler.classify(ValueError("x (MPI_ERR_RANK)")) \
+        == errhandler.ERR_RANK
+    assert errhandler.classify(FileNotFoundError("f")) \
+        == errhandler.ERR_NO_SUCH_FILE
+    assert errhandler.classify(MPIException(errhandler.ERR_TRUNCATE)) \
+        == errhandler.ERR_TRUNCATE
+    assert errhandler.error_string(errhandler.ERR_RANK) == "MPI_ERR_RANK"
+
+
+def test_errors_return_reraises():
+    def fn(comm):
+        assert comm.Get_errhandler() is ERRORS_RETURN
+        with pytest.raises(ValueError):
+            comm.Send(np.zeros(1), dest=99)  # invalid rank
+        return True
+
+    assert run_ranks(2, fn) == [True, True]
+
+
+def test_user_handler_invoked_before_raise():
+    def fn(comm):
+        seen = []
+        comm.Set_errhandler(Errhandler(
+            lambda c, code: seen.append((c.name, code))))
+        with pytest.raises(ValueError):
+            comm.Send(np.zeros(1), dest=99)
+        assert seen == [("MPI_COMM_WORLD", errhandler.ERR_RANK)]
+        # dup carries the handler over
+        d = comm.dup()
+        assert d.Get_errhandler().fn is not None
+        return True
+
+    assert run_ranks(2, fn) == [True, True]
+
+
+def test_errors_are_fatal_aborts():
+    """FATAL routes through rte.abort → SystemExit in thread worlds."""
+    def fn(comm):
+        if comm.rank == 0:
+            comm.Set_errhandler(ERRORS_ARE_FATAL)
+            try:
+                comm.Send(np.zeros(1), dest=99)
+            except SystemExit:
+                return "aborted"
+            return "no-abort"
+        return "peer"
+
+    res = run_ranks(1, fn)
+    assert res == ["aborted"]
+
+
+def test_call_errhandler_explicit():
+    def fn(comm):
+        hits = []
+        comm.Set_errhandler(Errhandler(lambda c, code: hits.append(code)))
+        with pytest.raises(MPIException):
+            comm.Call_errhandler(errhandler.ERR_IO)
+        assert hits == [errhandler.ERR_IO]
+        return True
+
+    assert run_ranks(1, fn) == [True]
+
+
+# ---- attributes -----------------------------------------------------
+
+def test_predefined_world_attrs():
+    def fn(comm):
+        flag, tag_ub = comm.Get_attr(attrs.TAG_UB)
+        assert flag and tag_ub == 2**31 - 1
+        flag, us = comm.Get_attr(attrs.UNIVERSE_SIZE)
+        assert flag and us == comm.size
+        return True
+
+    assert run_ranks(2, fn) == [True, True]
+
+
+def test_keyval_copy_delete_callbacks():
+    def fn(comm):
+        log = []
+        kv = attrs.create_keyval(
+            copy_fn=lambda obj, k, extra, v: v * 2,
+            delete_fn=lambda obj, k, v, extra: log.append(("del", v)),
+            extra_state="xs")
+        comm.Set_attr(kv, 21)
+        assert comm.Get_attr(kv) == (True, 21)
+        d = comm.dup()
+        assert d.Get_attr(kv) == (True, 42)  # copy callback ran
+        # overwrite runs the delete callback on the old value
+        comm.Set_attr(kv, 5)
+        assert ("del", 21) in log
+        d.free()  # delete_all on free
+        assert ("del", 42) in log
+        comm.Delete_attr(kv)
+        assert ("del", 5) in log
+        assert comm.Get_attr(kv) == (False, None)
+        attrs.free_keyval(kv)
+        return True
+
+    assert run_ranks(2, fn) == [True, True]
+
+
+def test_null_copy_fn_not_propagated():
+    def fn(comm):
+        kv = attrs.create_keyval()  # MPI_NULL_COPY_FN
+        comm.Set_attr(kv, "private")
+        d = comm.dup()
+        assert d.Get_attr(kv) == (False, None)
+        return True
+
+    assert run_ranks(1, fn) == [True]
+
+
+def test_invalid_keyval_rejected():
+    def fn(comm):
+        with pytest.raises(ValueError):
+            comm.Set_attr(424242, 1)
+        return True
+
+    assert run_ranks(1, fn) == [True]
+
+
+# ---- info -----------------------------------------------------------
+
+def test_info_basic():
+    inf = Info()
+    inf.set("cb_buffer_size", "1048576")
+    inf.set("striping_factor", "4")
+    assert inf.get("cb_buffer_size") == (True, "1048576")
+    assert inf.get("nope") == (False, None)
+    assert inf.nkeys() == 2
+    assert inf.nthkey(0) == "cb_buffer_size"
+    d = inf.dup()
+    inf.delete("striping_factor")
+    assert inf.nkeys() == 1 and d.nkeys() == 2
+    with pytest.raises(KeyError):
+        inf.delete("striping_factor")
+
+
+def test_info_limits():
+    inf = Info()
+    with pytest.raises(ValueError):
+        inf.set("", "v")
+    with pytest.raises(ValueError):
+        inf.set("k" * 300, "v")
+
+
+def test_info_env():
+    inf = info_env()
+    assert inf.get("thread_level")[0]
+    assert inf.get("host")[0]
+
+
+def test_comm_set_get_info():
+    def fn(comm):
+        inf = Info()
+        inf.set("hint", "on")
+        comm.Set_info(inf)
+        got = comm.Get_info()
+        assert got.get("hint") == (True, "on")
+        d = comm.dup()
+        assert d.Get_info().get("hint") == (True, "on")
+        return True
+
+    assert run_ranks(1, fn) == [True]
+
+
+def test_info_threads_into_file_open(tmp_path):
+    def fn(comm):
+        from ompi_tpu.io import file as iomod
+        inf = Info()
+        inf.set("cb_buffer_size", "65536")
+        f = iomod.open(comm, str(tmp_path / "t.bin"),
+                       iomod.MODE_CREATE | iomod.MODE_RDWR, info=inf)
+        assert f.info["cb_buffer_size"] == "65536"
+        assert f.Get_errhandler() is ERRORS_RETURN
+        f.close()
+        return True
+
+    assert run_ranks(2, fn) == [True, True]
+
+
+# ---- flat bindings --------------------------------------------------
+
+def test_flat_bindings_surface():
+    assert mpi.MPI_Error_string(mpi.MPI_ERR_RANK) == "MPI_ERR_RANK"
+    assert mpi.MPI_Error_class(mpi.MPI_ERR_IO) == mpi.MPI_ERR_IO
+    inf = mpi.MPI_Info_create()
+    mpi.MPI_Info_set(inf, "a", "b")
+    assert mpi.MPI_Info_get(inf, "a") == (True, "b")
+    assert mpi.MPI_Info_get_nkeys(inf) == 1
+    kv = mpi.MPI_Comm_create_keyval()
+    assert kv > 0
+    mpi.MPI_Comm_free_keyval(kv)
+    assert callable(mpi.PMPI_Info_set)  # PMPI aliases cover new names
