@@ -1,0 +1,27 @@
+"""OpenSHMEM-style ring: each PE puts a token into its right
+neighbor's symmetric flag and waits on its own (the oshmem
+ring_oshmem.c analog).
+
+Run: python -m ompi_tpu.tools.mpirun -np 4 examples/shmem_ring.py
+"""
+import numpy as np
+
+from ompi_tpu import shmem
+
+shmem.init()
+me, n = shmem.my_pe(), shmem.n_pes()
+flag = shmem.malloc(1, np.int64)
+flag.local[0] = -1
+shmem.barrier_all()
+
+if me == 0:
+    shmem.p(flag, 0, 42, (me + 1) % n)  # inject the token
+shmem.wait_until(flag, 0, "ge", 0)
+token = int(flag.local[0])
+if me != 0:
+    shmem.p(flag, 0, token + 1, (me + 1) % n)
+shmem.barrier_all()
+if me == 0:
+    assert token == 42 + n - 1, token  # full circle incremented n-1 times
+    print(f"shmem ring complete: PE 0 ended with {token}", flush=True)
+shmem.finalize()

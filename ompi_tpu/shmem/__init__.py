@@ -1,0 +1,430 @@
+"""OpenSHMEM-analog PGAS layer.
+
+Re-design of the oshmem project (ref: oshmem/runtime/
+oshmem_shmem_init.c:142,233,272-328 — init opens spml → scoll →
+sshmem → memheap; §2.7): a symmetric heap + one-sided put/get/
+atomics + PE collectives.  The tpu-native collapse: the **spml data
+plane is the osc window machinery** (active messages over the pml,
+every transport the btl framework has), the **sshmem backing segment
+is the window's memory**, scoll reuses the per-communicator coll
+stack, and remote atomics are window fetch-ops (applied serially in
+the target's progress loop — the atomic/basic contract).
+
+Symmetry: every PE performs the same allocation sequence
+(shmem_malloc is collective in OpenSHMEM), so a deterministic
+first-fit allocator yields identical offsets everywhere — a remote
+address is (pe, my_offset), exactly the memheap model
+(ref: oshmem/mca/memheap).
+
+    from ompi_tpu import shmem
+    shmem.init()
+    x = shmem.malloc(8, np.int64)
+    shmem.put(x, np.arange(8), pe=(shmem.my_pe() + 1) % shmem.n_pes())
+    shmem.barrier_all()
+    print(x.local)
+    shmem.finalize()
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ompi_tpu.mca.params import registry
+from ompi_tpu.op.op import (BAND, BOR, BXOR, MAX, MIN, PROD, SUM)
+
+_heap_var = registry.register(
+    "shmem", "memheap", "size", 1 << 22, int,
+    help="Symmetric heap size in bytes (memheap analog)")
+
+_ALIGN = 64
+
+
+class SymArray:
+    """A symmetric allocation: same offset on every PE."""
+
+    __slots__ = ("ctx", "offset", "shape", "dtype")
+
+    def __init__(self, ctx: "ShmemCtx", offset: int, shape, dtype) -> None:
+        self.ctx = ctx
+        self.offset = offset
+        self.shape = tuple(shape)
+        self.dtype = np.dtype(dtype)
+
+    @property
+    def nbytes(self) -> int:
+        return int(np.prod(self.shape)) * self.dtype.itemsize
+
+    @property
+    def local(self) -> np.ndarray:
+        """My PE's backing memory (writable view into the heap)."""
+        raw = self.ctx.heap[self.offset: self.offset + self.nbytes]
+        return raw.view(self.dtype).reshape(self.shape)
+
+    def _disp(self, index: int = 0) -> int:
+        return self.offset + index * self.dtype.itemsize
+
+
+class ShmemCtx:
+    """One PE's shmem state (the oshmem_group_all-rooted world)."""
+
+    def __init__(self, comm=None, heap_size: Optional[int] = None) -> None:
+        import ompi_tpu
+        from ompi_tpu.osc import window as oscmod
+
+        self.comm = comm if comm is not None else ompi_tpu.init()
+        self.heap_size = heap_size or _heap_var.value
+        self.heap = np.zeros(self.heap_size, dtype=np.uint8)
+        self.win = oscmod.Window(self.comm, self.heap, disp_unit=1,
+                                 name="shmem-heap")
+        self.win.lock_all()  # passive epoch for the life of the ctx
+        # deterministic first-fit free list: [(offset, size)] of holes
+        self._holes: List[Tuple[int, int]] = [(0, self.heap_size)]
+        self._live: Dict[int, int] = {}  # offset -> size
+        self._finalized = False
+
+    # -- memheap allocator (ref: oshmem/mca/memheap) --------------------
+    def malloc(self, shape, dtype=np.uint8) -> SymArray:
+        shape = (shape,) if isinstance(shape, int) else tuple(shape)
+        nbytes = int(np.prod(shape)) * np.dtype(dtype).itemsize
+        # zero-size allocations still get a distinct slot, else they
+        # alias the next malloc and free() releases live memory
+        want = max((nbytes + _ALIGN - 1) // _ALIGN * _ALIGN, _ALIGN)
+        for i, (off, size) in enumerate(self._holes):
+            if size >= want:
+                self._holes[i] = (off + want, size - want)
+                if self._holes[i][1] == 0:
+                    del self._holes[i]
+                self._live[off] = want
+                return SymArray(self, off, shape, dtype)
+        raise MemoryError(
+            f"symmetric heap exhausted ({nbytes} wanted; raise "
+            f"--mca shmem_memheap_size)")
+
+    def free(self, arr: SymArray) -> None:
+        size = self._live.pop(arr.offset, None)
+        if size is None:
+            return
+        self._holes.append((arr.offset, size))
+        self._holes.sort()
+        # coalesce adjacent holes
+        merged: List[Tuple[int, int]] = []
+        for off, sz in self._holes:
+            if merged and merged[-1][0] + merged[-1][1] == off:
+                merged[-1] = (merged[-1][0], merged[-1][1] + sz)
+            else:
+                merged.append((off, sz))
+        self._holes = merged
+
+    # -- spml data plane (ref: oshmem/mca/spml) -------------------------
+    @staticmethod
+    def _check_fit(dest: SymArray, nbytes: int, index: int = 0) -> None:
+        room = dest.nbytes - index * dest.dtype.itemsize
+        if nbytes > room:
+            raise ValueError(
+                f"put of {nbytes} bytes overruns the {room}-byte "
+                f"symmetric allocation (would corrupt the target's "
+                f"heap)")
+
+    def put(self, dest: SymArray, value, pe: int) -> None:
+        a = np.ascontiguousarray(np.asarray(value, dtype=dest.dtype))
+        self._check_fit(dest, a.nbytes)
+        self.win.put(a, pe, disp=dest._disp())
+        self.win.flush_local(pe)
+
+    def get(self, src: SymArray, pe: int) -> np.ndarray:
+        out = np.empty(src.shape, dtype=src.dtype)
+        self.win.get(out.reshape(-1), pe, disp=src._disp())
+        return out
+
+    def p(self, dest: SymArray, index: int, value, pe: int) -> None:
+        """Single-element put (shmem_p)."""
+        a = np.array([value], dtype=dest.dtype)
+        self._check_fit(dest, a.nbytes, index)
+        self.win.put(a, pe, disp=dest._disp(index))
+        self.win.flush_local(pe)
+
+    def g(self, src: SymArray, index: int, pe: int):
+        """Single-element get (shmem_g)."""
+        out = np.empty(1, dtype=src.dtype)
+        self.win.get(out, pe, disp=src._disp(index))
+        return out[0]
+
+    # -- ordering (ref: oshmem quiet/fence semantics) -------------------
+    def quiet(self) -> None:
+        """Remote completion of all my puts/atomics everywhere."""
+        self.win.flush_all()
+
+    def fence(self) -> None:
+        """Ordering between my puts to each PE.  The osc AM rides the
+        pml's per-(src,dst) FIFO, so delivery order already matches
+        issue order; fence is a no-op kept for API fidelity."""
+
+    def barrier_all(self) -> None:
+        self.quiet()
+        self.comm.Barrier()
+
+    # -- atomics (ref: oshmem/mca/atomic) -------------------------------
+    def atomic_add(self, dest: SymArray, index: int, value, pe: int) -> None:
+        a = np.array([value], dtype=dest.dtype)
+        self.win.accumulate(a, pe, disp=dest._disp(index), op=SUM)
+        self.win.flush_local(pe)
+
+    def atomic_fetch_add(self, dest: SymArray, index: int, value,
+                         pe: int):
+        old = np.empty(1, dtype=dest.dtype)
+        self.win.fetch_and_op(np.array([value], dtype=dest.dtype), old,
+                              pe, disp=dest._disp(index), op=SUM)
+        return old[0]
+
+    def atomic_inc(self, dest: SymArray, index: int, pe: int) -> None:
+        self.atomic_add(dest, index, 1, pe)
+
+    def atomic_fetch_inc(self, dest: SymArray, index: int, pe: int):
+        return self.atomic_fetch_add(dest, index, 1, pe)
+
+    def atomic_fetch(self, dest: SymArray, index: int, pe: int):
+        return self.g(dest, index, pe)
+
+    def atomic_set(self, dest: SymArray, index: int, value, pe: int) -> None:
+        self.p(dest, index, value, pe)
+        self.win.flush(pe)  # remote completion at the one target only
+
+    def atomic_swap(self, dest: SymArray, index: int, value, pe: int):
+        from ompi_tpu.op.op import REPLACE
+        old = np.empty(1, dtype=dest.dtype)
+        self.win.fetch_and_op(np.array([value], dtype=dest.dtype), old,
+                              pe, disp=dest._disp(index), op=REPLACE)
+        return old[0]
+
+    def atomic_compare_swap(self, dest: SymArray, index: int, cond,
+                            value, pe: int):
+        old = np.empty(1, dtype=dest.dtype)
+        self.win.compare_and_swap(
+            np.array([cond], dtype=dest.dtype),
+            np.array([value], dtype=dest.dtype), old, pe,
+            disp=dest._disp(index))
+        return old[0]
+
+    # -- wait (ref: shmem_wait_until) -----------------------------------
+    def wait_until(self, arr: SymArray, index: int, cmp: str, value,
+                   timeout: float = 60.0) -> None:
+        ops = {"eq": np.equal, "ne": np.not_equal, "gt": np.greater,
+               "ge": np.greater_equal, "lt": np.less,
+               "le": np.less_equal}[cmp]
+        deadline = time.monotonic() + timeout
+        progress = self.comm.state.progress
+        while not bool(ops(arr.local.reshape(-1)[index], value)):
+            if progress.progress() == 0:
+                time.sleep(0)
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"shmem_wait_until({cmp}, {value}) timed out")
+
+    # -- scoll (ref: oshmem/mca/scoll — reuses the comm coll stack) -----
+    def broadcast(self, dest: SymArray, src: SymArray, root: int) -> None:
+        buf = src.local.copy() if self.comm.rank == root \
+            else np.empty(src.shape, dtype=src.dtype)
+        self.comm.Bcast(buf, root=root)
+        dest.local[...] = buf
+
+    def collect(self, dest: SymArray, src: SymArray) -> None:
+        """fcollect: concatenation of every PE's src block."""
+        self.comm.Allgather(np.ascontiguousarray(src.local.reshape(-1)),
+                            dest.local.reshape(-1))
+
+    def alltoall(self, dest: SymArray, src: SymArray) -> None:
+        self.comm.Alltoall(np.ascontiguousarray(src.local.reshape(-1)),
+                           dest.local.reshape(-1))
+
+    def _to_all(self, dest: SymArray, src: SymArray, op) -> None:
+        self.comm.Allreduce(np.ascontiguousarray(src.local.reshape(-1)),
+                            dest.local.reshape(-1), op)
+
+    def sum_to_all(self, dest, src):
+        self._to_all(dest, src, SUM)
+
+    def max_to_all(self, dest, src):
+        self._to_all(dest, src, MAX)
+
+    def min_to_all(self, dest, src):
+        self._to_all(dest, src, MIN)
+
+    def prod_to_all(self, dest, src):
+        self._to_all(dest, src, PROD)
+
+    def and_to_all(self, dest, src):
+        self._to_all(dest, src, BAND)
+
+    def or_to_all(self, dest, src):
+        self._to_all(dest, src, BOR)
+
+    def xor_to_all(self, dest, src):
+        self._to_all(dest, src, BXOR)
+
+    # -- teardown --------------------------------------------------------
+    def finalize(self) -> None:
+        if self._finalized:
+            return
+        self.barrier_all()
+        self.win.unlock_all()
+        self.win.free()
+        self._finalized = True
+
+
+# -- module-level API (the flat shmem_* C surface) ---------------------------
+
+_tls = threading.local()
+
+
+def _ctx() -> ShmemCtx:
+    ctx = getattr(_tls, "ctx", None)
+    if ctx is None:
+        raise RuntimeError("shmem is not initialized (call shmem.init())")
+    return ctx
+
+
+def init(comm=None, heap_size: Optional[int] = None) -> ShmemCtx:
+    ctx = getattr(_tls, "ctx", None)
+    if ctx is not None and not ctx._finalized:
+        # explicit arguments that conflict with the live ctx must not
+        # be silently ignored
+        if (comm is not None and comm is not ctx.comm) or \
+                (heap_size is not None and heap_size != ctx.heap_size):
+            raise RuntimeError(
+                "shmem is already initialized with a different "
+                "comm/heap_size; finalize() first")
+        return ctx
+    ctx = ShmemCtx(comm, heap_size)
+    _tls.ctx = ctx
+    return ctx
+
+
+def finalize() -> None:
+    ctx = getattr(_tls, "ctx", None)
+    if ctx is not None:
+        ctx.finalize()
+        _tls.ctx = None
+
+
+def my_pe() -> int:
+    return _ctx().comm.rank
+
+
+def n_pes() -> int:
+    return _ctx().comm.size
+
+
+def malloc(shape, dtype=np.uint8) -> SymArray:
+    return _ctx().malloc(shape, dtype)
+
+
+def free(arr: SymArray) -> None:
+    _ctx().free(arr)
+
+
+def put(dest, value, pe):
+    _ctx().put(dest, value, pe)
+
+
+def get(src, pe):
+    return _ctx().get(src, pe)
+
+
+def p(dest, index, value, pe):
+    _ctx().p(dest, index, value, pe)
+
+
+def g(src, index, pe):
+    return _ctx().g(src, index, pe)
+
+
+def quiet():
+    _ctx().quiet()
+
+
+def fence():
+    _ctx().fence()
+
+
+def barrier_all():
+    _ctx().barrier_all()
+
+
+def atomic_add(dest, index, value, pe):
+    _ctx().atomic_add(dest, index, value, pe)
+
+
+def atomic_fetch_add(dest, index, value, pe):
+    return _ctx().atomic_fetch_add(dest, index, value, pe)
+
+
+def atomic_inc(dest, index, pe):
+    _ctx().atomic_inc(dest, index, pe)
+
+
+def atomic_fetch_inc(dest, index, pe):
+    return _ctx().atomic_fetch_inc(dest, index, pe)
+
+
+def atomic_fetch(dest, index, pe):
+    return _ctx().atomic_fetch(dest, index, pe)
+
+
+def atomic_set(dest, index, value, pe):
+    _ctx().atomic_set(dest, index, value, pe)
+
+
+def atomic_swap(dest, index, value, pe):
+    return _ctx().atomic_swap(dest, index, value, pe)
+
+
+def atomic_compare_swap(dest, index, cond, value, pe):
+    return _ctx().atomic_compare_swap(dest, index, cond, value, pe)
+
+
+def wait_until(arr, index, cmp, value, timeout: float = 60.0):
+    _ctx().wait_until(arr, index, cmp, value, timeout)
+
+
+def broadcast(dest, src, root):
+    _ctx().broadcast(dest, src, root)
+
+
+def collect(dest, src):
+    _ctx().collect(dest, src)
+
+
+def alltoall(dest, src):
+    _ctx().alltoall(dest, src)
+
+
+def sum_to_all(dest, src):
+    _ctx().sum_to_all(dest, src)
+
+
+def max_to_all(dest, src):
+    _ctx().max_to_all(dest, src)
+
+
+def min_to_all(dest, src):
+    _ctx().min_to_all(dest, src)
+
+
+def prod_to_all(dest, src):
+    _ctx().prod_to_all(dest, src)
+
+
+def and_to_all(dest, src):
+    _ctx().and_to_all(dest, src)
+
+
+def or_to_all(dest, src):
+    _ctx().or_to_all(dest, src)
+
+
+def xor_to_all(dest, src):
+    _ctx().xor_to_all(dest, src)

@@ -97,3 +97,31 @@ def run_ranks(n: int, fn: Callable, devices: bool = False,
         if e is not None:
             raise e
     return results
+
+
+def mpirun_run(np_, prog, *args, mca=(), extra=(), timeout=120,
+               job_timeout=90, cwd=None):
+    """Run `prog` under our mpirun as a subprocess and return the
+    CompletedProcess — the one shared recipe for integration tests
+    (PYTHONPATH for children, JAX pinned to CPU so examples never
+    touch the real chip, belt-and-braces timeouts)."""
+    import os
+    import subprocess
+    import sys
+
+    import ompi_tpu as _pkg
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(
+        _pkg.__file__)))
+    cmd = [sys.executable, "-m", "ompi_tpu.tools.mpirun",
+           "-np", str(np_)]
+    if job_timeout:
+        cmd += ["--timeout", str(job_timeout)]
+    for k, v in mca:
+        cmd += ["--mca", k, v]
+    cmd += [*extra, prog if os.path.isabs(prog)
+            else os.path.join(repo, prog), *args]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.run(cmd, capture_output=True, timeout=timeout,
+                          env=env, cwd=cwd or repo)

@@ -142,13 +142,8 @@ def test_connect_accept_same_job():
 
 
 def _mpirun(np_, prog, *args, timeout=120):
-    cmd = [sys.executable, "-m", "ompi_tpu.tools.mpirun", "-np",
-           str(np_), "--timeout", "90", prog, *args]
-    env = dict(os.environ)
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    env["JAX_PLATFORMS"] = "cpu"
-    return subprocess.run(cmd, capture_output=True, timeout=timeout,
-                          env=env, cwd=REPO)
+    from ompi_tpu.testing import mpirun_run
+    return mpirun_run(np_, prog, *args, timeout=timeout)
 
 
 def test_spawn_under_mpirun():

@@ -18,13 +18,9 @@ LOCALSSH = f"{sys.executable} -m ompi_tpu.tools.localssh"
 
 
 def mpirun(np, prog, *extra, timeout=240):
-    cmd = [sys.executable, "-m", "ompi_tpu.tools.mpirun",
-           "-np", str(np), *extra, os.path.join(REPO, "examples", prog)]
-    env = dict(os.environ)
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    env["JAX_PLATFORMS"] = "cpu"
-    return subprocess.run(cmd, capture_output=True, timeout=timeout,
-                          env=env, cwd=REPO)
+    from ompi_tpu.testing import mpirun_run
+    return mpirun_run(np, os.path.join("examples", prog),
+                      extra=extra, timeout=timeout, job_timeout=0)
 
 
 # ---- ras: allocation parsing ---------------------------------------
