@@ -48,6 +48,14 @@ _prio_tpu = registry.register(
 _prio_hbm = registry.register(
     "coll", "hbm", "priority", 70, int,
     help="Selection priority of the intra-chip collective component")
+_rv_poll_var = registry.register(
+    "coll", "device", "rendezvous_poll", 0.25, float,
+    help="Rendezvous wait poll interval in seconds (bounds abort "
+         "latency for device collectives)")
+_rv_timeout_var = registry.register(
+    "coll", "device", "rendezvous_timeout", 300.0, float,
+    help="Seconds a device-collective rendezvous may stall before "
+         "raising (dead/diverged peer diagnosis)")
 
 # ops with a native XLA cross-replica lowering
 _XLA_REDUCERS = {"MPI_SUM", "MPI_MAX", "MPI_MIN"}
@@ -112,12 +120,31 @@ class Rendezvous:
 
     def run(self, rank: int, value: Any, fn: Callable[[List[Any]], List[Any]],
             abort_check: Optional[Callable[[], None]] = None) -> Any:
-        """Deposit `value`; last arriver runs fn(slots) -> outputs."""
+        """Deposit `value`; last arriver runs fn(slots) -> outputs.
+        Waits poll at ``coll_device_rendezvous_poll`` (abort flags are
+        checked each tick, bounding abort latency) and fail after
+        ``coll_device_rendezvous_timeout`` of no progress — a stuck
+        peer must become a diagnosable error, not a silent hang."""
+        import time
+
+        poll = _rv_poll_var.value
+        stall = _rv_timeout_var.value
+
+        def tick(t_start: float, what: str) -> None:
+            if abort_check:
+                abort_check()
+            if time.monotonic() - t_start > stall:
+                raise RuntimeError(
+                    f"device-collective rendezvous stalled >{stall}s "
+                    f"({what}; peers dead or diverged? tune "
+                    f"coll_device_rendezvous_timeout)")
+
         with self.cv:
             # wait until my slot from the previous generation is consumed
+            t0 = time.monotonic()
             while self.slots[rank] is not self._SENTINEL:
-                if not self.cv.wait(timeout=1.0) and abort_check:
-                    abort_check()
+                if not self.cv.wait(timeout=poll):
+                    tick(t0, "previous generation unconsumed")
             gen = self.gen
             self.slots[rank] = value
             self.count += 1
@@ -133,9 +160,11 @@ class Rendezvous:
                 self.gen += 1
                 self.cv.notify_all()
             else:
+                t0 = time.monotonic()
                 while gen not in self.results:
-                    if not self.cv.wait(timeout=1.0) and abort_check:
-                        abort_check()
+                    if not self.cv.wait(timeout=poll):
+                        tick(t0, f"waiting for {self.size - self.count} "
+                                 f"peers")
             err = self.errors.get(gen)
             out = self.results[gen][rank]
             self.readers[gen] -= 1

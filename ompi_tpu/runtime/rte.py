@@ -21,6 +21,16 @@ import os
 import threading
 from typing import Any, Dict, List, Optional
 
+from ompi_tpu.mca.params import registry
+
+_modex_timeout_var = registry.register(
+    "rte", "base", "modex_timeout", 30.0, float,
+    help="Seconds a modex_get waits for a peer's business card "
+         "before failing (raise under debuggers / huge jobs)")
+_fence_timeout_var = registry.register(
+    "rte", "base", "fence_timeout", 60.0, float,
+    help="Seconds a fence waits for all ranks before failing")
+
 
 class RTE:
     rank: int
@@ -104,13 +114,14 @@ class InprocRTE(RTE):
             while (peer, key) not in self.world.modex:
                 if self.world.aborted:
                     raise RuntimeError(f"job aborted: {self.world.aborted}")
-                if not self.world.modex_cv.wait(timeout=30):
+                if not self.world.modex_cv.wait(
+                        timeout=_modex_timeout_var.value):
                     raise TimeoutError(
                         f"modex_get({peer},{key}) timed out")
             return self.world.modex[(peer, key)]
 
     def fence(self) -> None:
-        self.world.barrier.wait(timeout=60)
+        self.world.barrier.wait(timeout=_fence_timeout_var.value)
 
     def abort(self, code: int, msg: str = "") -> None:
         self.world.aborted = (self.rank, code, msg)
@@ -151,7 +162,8 @@ class EnvRTE(RTE):
         self.kv.put(f"modex:{self.rank}:{key}", value)
 
     def modex_get(self, peer: int, key: str) -> Any:
-        return self.kv.get(f"modex:{peer}:{key}")
+        return self.kv.get(f"modex:{peer}:{key}",
+                           timeout=_modex_timeout_var.value)
 
     def fence(self) -> None:
         # namespaced by job and sized to the job's world: spawned

@@ -1,0 +1,17 @@
+"""Waits on a modex key nobody publishes: must fail after the
+registry-tuned rte_base_modex_timeout, not the built-in default."""
+import time
+
+import ompi_tpu
+from ompi_tpu.runtime import state as statemod
+
+comm = ompi_tpu.init()
+t0 = time.monotonic()
+try:
+    statemod.current().rte.modex_get((comm.rank + 1) % comm.size,
+                                     "never-published-key")
+except (TimeoutError, Exception) as e:  # noqa: BLE001
+    dt = time.monotonic() - t0
+    assert dt < 15, f"timeout not tuned down: {dt}s"
+    raise SystemExit(3)
+print("should not get here", flush=True)

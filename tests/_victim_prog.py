@@ -1,0 +1,17 @@
+"""Failure-injection target: rank 1 prints its pid and sleeps (the
+test SIGKILLs it) while every other rank blocks in a collective."""
+import os
+import time
+
+import numpy as np
+
+import ompi_tpu
+from ompi_tpu.op import op as mpi_op
+
+comm = ompi_tpu.init()
+if comm.rank == 1:
+    print(f"victim pid {os.getpid()}", flush=True)
+    time.sleep(120)
+buf = np.zeros(1)
+comm.Allreduce(buf, buf.copy(), mpi_op.SUM)
+print("should not get here", flush=True)
