@@ -110,4 +110,4 @@ def init_world_attrs(comm) -> None:
     comm.attrs[TAG_UB] = 2**31 - 1
     comm.attrs[WTIME_IS_GLOBAL] = False
     comm.attrs[UNIVERSE_SIZE] = comm.state.size
-    comm.attrs[APPNUM] = 0
+    comm.attrs[APPNUM] = getattr(comm.state.rte, "appnum", 0)

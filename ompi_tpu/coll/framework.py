@@ -30,7 +30,8 @@ COLL_FUNCS = (
     "alltoallw", "reduce_scatter", "reduce_scatter_block", "scan", "exscan",
     # nonblocking
     "ibarrier", "ibcast", "ireduce", "iallreduce", "iallgather",
-    "iallgatherv", "igather", "iscatter", "ialltoall", "ialltoallv",
+    "iallgatherv", "igather", "igatherv", "iscatter", "iscatterv",
+    "ialltoall", "ialltoallv",
     "ireduce_scatter", "ireduce_scatter_block", "iscan", "iexscan",
     # device-array collectives (jax arrays in, jax arrays out) — the
     # coll/tpu + coll/hbm surface; ppermute is the mesh-neighbor

@@ -500,6 +500,50 @@ class NbcModule(CollModule):
         rounds = sched_allgatherv(comm, sarr, rb.arr, pc, pd, _nbc_tag(comm))
         return NBCRequest(comm, rounds, self._finish(rb))
 
+    def igatherv(self, comm, sbuf, scount, sdt, rbuf, rcounts, displs,
+                 rdt, root):
+        """Linear schedule with per-rank counts/displacements."""
+        tag = _nbc_tag(comm)
+        if comm.rank == root:
+            total = max(d + c for d, c in zip(displs, rcounts))
+            rb = typed(rbuf, total, rdt, writable=True)
+            scale = rdt.size // rb.prim.itemsize
+            pc = [c * scale for c in rcounts]
+            pd = [d * scale for d in displs]
+            sarr = rb.arr[pd[root]: pd[root] + pc[root]].copy() \
+                if sbuf is IN_PLACE else typed(sbuf, scount, sdt).arr
+            me = rb.arr[pd[root]: pd[root] + pc[root]]
+            rnd = [_recv(comm, rb.arr[pd[r]: pd[r] + pc[r]], r, tag)
+                   for r in range(comm.size) if r != root and pc[r]]
+            rounds = [[_local(lambda: me.__setitem__(slice(None),
+                                                     sarr))] + rnd]
+            return NBCRequest(comm, rounds, self._finish(rb))
+        sarr = typed(sbuf, scount, sdt).arr
+        if sarr.size == 0:  # root skips zero-count recvs symmetrically
+            return NBCRequest(comm, [[]])
+        return NBCRequest(comm, [[_send(comm, sarr, root, tag)]])
+
+    def iscatterv(self, comm, sbuf, scounts, displs, sdt, rbuf, rcount,
+                  rdt, root):
+        tag = _nbc_tag(comm)
+        rb = typed(rbuf, rcount, rdt, writable=True)
+        if comm.rank == root:
+            total = max(d + c for d, c in zip(displs, scounts))
+            sb = typed(sbuf, total, sdt)
+            scale = sdt.size // sb.prim.itemsize
+            pc = [c * scale for c in scounts]
+            pd = [d * scale for d in displs]
+            mine = sb.arr[pd[root]: pd[root] + pc[root]]
+            rnd = [_send(comm, sb.arr[pd[r]: pd[r] + pc[r]], r, tag)
+                   for r in range(comm.size) if r != root and pc[r]]
+            rounds = [[_local(lambda: rb.arr.__setitem__(slice(None),
+                                                         mine))] + rnd]
+        elif rb.arr.size == 0:  # root skips zero-count sends
+            rounds = [[]]
+        else:
+            rounds = [[_recv(comm, rb.arr, root, tag)]]
+        return NBCRequest(comm, rounds, self._finish(rb))
+
     def igather(self, comm, sbuf, scount, sdt, rbuf, rcount, rdt, root):
         if comm.rank == root:
             rb = typed(rbuf, rcount * comm.size, rdt, writable=True)

@@ -164,6 +164,58 @@ class Communicator:
         _attrs.copy_all(self, new)  # attribute copy callbacks
         return new
 
+    def idup(self, name: str = ""):
+        """MPI_Comm_idup (ref: ompi/mpi/c/comm_idup.c): returns
+        (newcomm, request).  The CID agreement runs eagerly — every
+        member is inside idup anyway (it is collective), so the
+        request is born complete; the value of the nonblocking form
+        is API fidelity, not overlap, at this altitude."""
+        from ompi_tpu.pml.request import CompletedRequest
+        new = self.dup(name)
+        return new, CompletedRequest(self.state.progress)
+
+    def create_group(self, group: Group, tag: int = 0
+                     ) -> Optional["Communicator"]:
+        """MPI_Comm_create_group (ref: ompi/mpi/c/comm_create_group.c):
+        collective only over `group`'s members — the agreement rides a
+        shim translating group ranks over the parent's cid with a
+        dedicated tag, so non-members never participate."""
+        my_pos = group.rank_of(self.state.rank)
+        if my_pos == UNDEFINED:
+            return None
+
+        parent = self
+        grp_ranks = list(group.ranks)
+
+        class _GroupShim:
+            """Comm-shaped view of `group` over the parent's cid."""
+            cid = parent.cid
+            state = parent.state
+            size = len(grp_ranks)
+            rank = my_pos
+            group = grp_ranks  # the p2p translation table
+
+            psend = Communicator.psend
+            precv = Communicator.precv
+            _pml = Communicator._pml
+            _allreduce_max_int = Communicator._allreduce_max_int
+
+        shim = _GroupShim()
+        # multi-round agreement among group members only; the wire tag
+        # lives in a dedicated [-400, -1399] block so no user tag can
+        # land it on another internal protocol's tag (concurrent
+        # create_group calls with tags 1000 apart would collide — the
+        # comm/tag pair disambiguates real uses)
+        wire_tag = -400 - (tag % 1000)
+        while True:
+            proposal = self.state.next_cid_local()
+            agreed = shim._allreduce_max_int(proposal, wire_tag)
+            ok = 1 if agreed not in self.state.comms else 0
+            all_ok = shim._allreduce_max_int(-ok, wire_tag)
+            if all_ok == -1:
+                return Communicator(self.state, agreed, group)
+            self.state.comms.setdefault(agreed, None)
+
     def create(self, group: Group) -> Optional["Communicator"]:
         """MPI_Comm_create: collective over the parent; ranks outside
         `group` get None (MPI_COMM_NULL)."""
@@ -315,6 +367,17 @@ class Communicator:
         from .dpm import comm_spawn
         return comm_spawn(self, cmd, list(args), maxprocs, root)
 
+    def spawn_multiple(self, specs, root: int = 0):
+        """MPI_Comm_spawn_multiple: specs = [(cmd, args, n), ...]."""
+        from .dpm import comm_spawn_multiple
+        return comm_spawn_multiple(self, specs, root)
+
+    def disconnect(self) -> None:
+        """MPI_Comm_disconnect (ref: ompi/mpi/c/comm_disconnect.c):
+        barrier (pending communication must drain) then free."""
+        self.Barrier()
+        self.free()
+
     def accept(self, port: str, root: int = 0):
         from .dpm import comm_accept
         return comm_accept(self, port, root)
@@ -445,6 +508,27 @@ class Communicator:
         rreq = self.Irecv(rspec, source, rtag)
         self.Send(sspec, dest, stag)
         return rreq.wait()
+
+    def Sendrecv_replace(self, spec, dest: int, stag: int, source: int,
+                         rtag: int = -1) -> Status:
+        """MPI_Sendrecv_replace (ref: ompi/mpi/c/sendrecv_replace.c —
+        the send side snapshots the buffer through the convertor
+        before the receive overwrites it)."""
+        buf, count, dt = self._spec(spec)
+        from ompi_tpu.datatype.convertor import Convertor
+        snapshot = bytearray(Convertor(dt, count, buf).pack())
+        rreq = self.Irecv(spec, source, rtag)
+        self.Send((np.frombuffer(snapshot, dtype=np.uint8),
+                   count * dt.size if dt.size else 0,
+                   dtmod.BYTE), dest, stag)
+        return rreq.wait()
+
+    # -- names ----------------------------------------------------------
+    def Set_name(self, name: str) -> None:
+        self.name = name
+
+    def Get_name(self) -> str:
+        return self.name
 
     def Probe(self, source: int = -1, tag: int = -1) -> Status:
         return self.state.pml.probe(source, tag, self)
@@ -622,6 +706,24 @@ class Communicator:
                                       rbuf, rcount, rdt, root)
         return self.coll.iscatter(self, None, 0, rdt, rbuf, rcount, rdt,
                                   root)
+
+    def Igatherv(self, sspec, rspec, rcounts, displs, root: int = 0):
+        sbuf, scount, sdt = self._spec(sspec)
+        if self.rank == root:
+            rbuf, _, rdt = self._spec(rspec)
+        else:
+            rbuf, rdt = None, sdt
+        return self.coll.igatherv(self, sbuf, scount, sdt, rbuf,
+                                  rcounts, displs, rdt, root)
+
+    def Iscatterv(self, sspec, scounts, displs, rspec, root: int = 0):
+        rbuf, rcount, rdt = self._spec(rspec)
+        if self.rank == root:
+            sbuf, _, sdt = self._spec(sspec)
+        else:
+            sbuf, sdt = None, rdt
+        return self.coll.iscatterv(self, sbuf, scounts, displs, sdt,
+                                   rbuf, rcount, rdt, root)
 
     def Ialltoall(self, sspec, rspec):
         sbuf, scount, sdt = self._spec(sspec)
@@ -801,6 +903,14 @@ class Communicator:
         return nb.ineighbor_allgather(
             self, sbuf, scount, sdt, rbuf,
             self._nbr_block(rcount, nin, "recv"), rdt)
+
+    def Ineighbor_allgatherv(self, sspec, rspec, rcounts, displs):
+        from ompi_tpu.topo import neighbor as nb
+        sbuf, scount, sdt = self._spec(sspec)
+        rbuf, _, rdt = self._spec(rspec)
+        self._require_topo()
+        return nb.ineighbor_allgatherv(self, sbuf, scount, sdt, rbuf,
+                                       rcounts, displs, rdt)
 
     def Ineighbor_alltoall(self, sspec, rspec):
         from ompi_tpu.topo import neighbor as nb

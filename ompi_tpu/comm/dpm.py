@@ -151,13 +151,24 @@ def comm_spawn(comm: Communicator, cmd: str, args: List[str],
                maxprocs: int, root: int = 0) -> Intercommunicator:
     """Collective over `comm`: launch `maxprocs` new universe ranks
     running `cmd` and return the parent-side intercomm."""
+    return comm_spawn_multiple(
+        comm, [(cmd, list(args), maxprocs)], root)
+
+
+def comm_spawn_multiple(comm: Communicator, specs, root: int = 0
+                        ) -> Intercommunicator:
+    """MPI_Comm_spawn_multiple: specs = [(cmd, args, maxprocs), ...],
+    all children in ONE world (per-segment MPI_APPNUM set)."""
     from ompi_tpu.runtime.init import extend_universe
 
     state = comm.state
     import numpy as np
+    maxprocs = sum(int(n) for _c, _a, n in specs)
     meta = np.empty(1, dtype=np.int64)
     if comm.rank == root:
-        base = _kv(state).spawn(cmd, list(args), maxprocs, state.rank)
+        base = _kv(state).spawn_multiple(
+            [{"cmd": c, "args": list(a), "n": int(n)}
+             for c, a, n in specs], state.rank)
         meta[0] = base
     comm.Bcast(meta, root=root)
     base = int(meta[0])

@@ -500,8 +500,12 @@ def darray(size: int, rank: int, gsizes: Sequence[int],
            distribs: Sequence[int], dargs: Sequence[int],
            psizes: Sequence[int], order: int, oldtype: Datatype) -> Datatype:
     """HPF-style distributed array type
-    (ref: ompi/datatype/ompi_datatype_create_darray.c).  Only BLOCK and
-    NONE distributions are supported (CYCLIC rarely used; raises)."""
+    (ref: ompi/datatype/ompi_datatype_create_darray.c).  Built by
+    per-dimension recursion — innermost dimension first — where each
+    level selects this rank's blocks along that dimension (hindexed
+    over the previous level's type) and resizes to the dimension's
+    full global span, so BLOCK, CYCLIC(b) and NONE all share one
+    mechanism."""
     ndims = len(gsizes)
     # rank → grid coords is row-major regardless of `order` (MPI-3.1
     # §4.1.4: "the process grid is always assumed to be row-major";
@@ -511,28 +515,38 @@ def darray(size: int, rank: int, gsizes: Sequence[int],
     for d in range(ndims - 1, -1, -1):
         coords.insert(0, r % psizes[d])
         r //= psizes[d]
-    sizes = list(gsizes)
-    subsizes = []
-    starts = []
-    for d in range(ndims):
-        if distribs[d] == DISTRIBUTE_NONE or psizes[d] == 1:
-            subsizes.append(gsizes[d])
-            starts.append(0)
+    t = oldtype
+    dims_iter = range(ndims - 1, -1, -1) if order == ORDER_C \
+        else range(ndims)
+    for d in dims_iter:
+        ext = t.extent
+        g, p, c = gsizes[d], psizes[d], coords[d]
+        if distribs[d] == DISTRIBUTE_NONE or p == 1:
+            lens, offs = [g], [0]
         elif distribs[d] == DISTRIBUTE_BLOCK:
             b = dargs[d]
             if b == DISTRIBUTE_DFLT_DARG:
-                b = -(-gsizes[d] // psizes[d])
-            s = coords[d] * b
-            e = min(s + b, gsizes[d])
-            subsizes.append(max(0, e - s))
-            starts.append(min(s, gsizes[d]))
+                b = -(-g // p)
+            s = min(c * b, g)
+            lens, offs = [max(0, min(s + b, g) - s)], [s * ext]
+        elif distribs[d] == DISTRIBUTE_CYCLIC:
+            b = dargs[d]
+            if b == DISTRIBUTE_DFLT_DARG:
+                b = 1
+            total_blocks = -(-g // b)
+            lens, offs = [], []
+            for tb in range(c, total_blocks, p):
+                lens.append(min(b, g - tb * b))
+                offs.append(tb * b * ext)
         else:
-            raise NotImplementedError("DISTRIBUTE_CYCLIC not supported")
-    dt = subarray(sizes, subsizes, starts, ORDER_C if order == ORDER_C
-                  else ORDER_FORTRAN, oldtype)
-    dt.envelope = ("DARRAY", [size, rank, ndims, *gsizes, *distribs,
-                              *dargs, *psizes, order], [], [oldtype])
-    return dt
+            raise ValueError(f"unknown distribution {distribs[d]}")
+        lens = [x for x in lens if x > 0] or [0]
+        offs = offs[:len(lens)] if lens != [0] else [0]
+        t = hindexed(lens, offs, t)
+        t = resized(t, 0, g * ext)
+    t.envelope = ("DARRAY", [size, rank, ndims, *gsizes, *distribs,
+                             *dargs, *psizes, order], [], [oldtype])
+    return t
 
 
 def resized(oldtype: Datatype, lb: int, extent: int) -> Datatype:

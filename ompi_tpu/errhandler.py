@@ -69,9 +69,38 @@ _CLASS_NAMES = {
 }
 
 
+_user_classes: dict = {}
+_next_user_code = [ERR_LASTCODE + 1]
+
+
 def error_string(code: int) -> str:
     """MPI_Error_string analog (ref: ompi/errhandler/errcode.c)."""
+    if code in _user_classes:
+        return _user_classes[code]
     return f"MPI_{_CLASS_NAMES.get(code, 'ERR_UNKNOWN')}"
+
+
+def add_error_class() -> int:
+    """MPI_Add_error_class (ref: ompi/mpi/c/add_error_class.c)."""
+    code = _next_user_code[0]
+    _next_user_code[0] += 1
+    _user_classes[code] = f"user error class {code}"
+    return code
+
+
+def add_error_code(errorclass: int) -> int:
+    """MPI_Add_error_code: a new code within an existing class (codes
+    and classes share the registry here, like our identity
+    Error_class mapping)."""
+    code = _next_user_code[0]
+    _next_user_code[0] += 1
+    _user_classes[code] = _user_classes.get(
+        errorclass, f"user error class {errorclass}")
+    return code
+
+
+def add_error_string(code: int, text: str) -> None:
+    _user_classes[code] = text
 
 
 class MPIException(Exception):

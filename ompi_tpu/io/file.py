@@ -130,6 +130,43 @@ class File:
     def get_size(self) -> int:
         return os.fstat(self.fd).st_size
 
+    def get_amode(self) -> int:
+        return self.amode
+
+    def get_group(self):
+        return self.comm.group_obj()
+
+    def get_info(self):
+        from ompi_tpu.info import Info
+        out = Info()
+        for k, v in self.info.items():
+            out.set(k, v)
+        return out
+
+    def set_info(self, info) -> None:
+        items = info.items() if hasattr(info, "items") else \
+            dict(info or {}).items()
+        for k, v in items:
+            self.info[k] = v
+
+    def get_byte_offset(self, offset: int) -> int:
+        """MPI_File_get_byte_offset: view-relative etype offset ->
+        absolute byte offset."""
+        segs = self.view.map_bytes(offset, max(1, self.view.etype.size))
+        return segs[0][0] if segs else self.view.disp
+
+    def get_type_extent(self, datatype) -> int:
+        return datatype.extent
+
+    def get_atomicity(self) -> bool:
+        return False  # per-op posix pread/pwrite; no cross-rank atomic mode
+
+    def set_atomicity(self, flag: bool) -> None:
+        if flag:
+            raise ValueError(
+                "atomic mode is not supported (MPI_ERR_UNSUPPORTED_"
+                "OPERATION)")
+
     def set_size(self, size: int) -> None:
         os.ftruncate(self.fd, size)
 
@@ -342,6 +379,83 @@ class File:
         st = self.write_at_all(self.pos, spec)
         self.pos += st.count // max(1, self.view.etype.size)
         return st
+
+    # -- nonblocking collectives + shared-fp -------------------------
+    # (the fcoll exchange is synchronous inside, like romio's
+    # deferred-open collectives at this altitude; the request is born
+    # complete)
+    def iread_all(self, spec):
+        return _done_req(self.comm, self.read_all(spec))
+
+    def iwrite_all(self, spec):
+        return _done_req(self.comm, self.write_all(spec))
+
+    def iread_at_all(self, offset: int, spec):
+        return _done_req(self.comm, self.read_at_all(offset, spec))
+
+    def iwrite_at_all(self, offset: int, spec):
+        return _done_req(self.comm, self.write_at_all(offset, spec))
+
+    def iread_shared(self, spec):
+        return _done_req(self.comm, self.read_shared(spec))
+
+    def iwrite_shared(self, spec):
+        return _done_req(self.comm, self.write_shared(spec))
+
+    # -- split-phase collectives (ref: ompi/mpi/c/file_read_all_begin.c
+    # family): begin runs the collective, end returns its status; at
+    # most one split collective may be active per file handle (the
+    # MPI rule), which we enforce.
+    def _begin(self, kind: str, st: Status) -> None:
+        if getattr(self, "_split", None) is not None:
+            raise RuntimeError(
+                "a split collective is already active on this file "
+                "(MPI_ERR_OTHER)")
+        self._split = (kind, st)
+
+    def _end(self, kind: str) -> Status:
+        cur = getattr(self, "_split", None)
+        if cur is None or cur[0] != kind:
+            raise RuntimeError(
+                f"no matching {kind}_begin active (MPI_ERR_OTHER)")
+        self._split = None
+        return cur[1]
+
+    def read_all_begin(self, spec) -> None:
+        self._begin("read_all", self.read_all(spec))
+
+    def read_all_end(self, buf=None) -> Status:
+        return self._end("read_all")
+
+    def write_all_begin(self, spec) -> None:
+        self._begin("write_all", self.write_all(spec))
+
+    def write_all_end(self, buf=None) -> Status:
+        return self._end("write_all")
+
+    def read_at_all_begin(self, offset: int, spec) -> None:
+        self._begin("read_at_all", self.read_at_all(offset, spec))
+
+    def read_at_all_end(self, buf=None) -> Status:
+        return self._end("read_at_all")
+
+    def write_at_all_begin(self, offset: int, spec) -> None:
+        self._begin("write_at_all", self.write_at_all(offset, spec))
+
+    def write_at_all_end(self, buf=None) -> Status:
+        return self._end("write_at_all")
+
+    def read_ordered_begin(self, spec) -> None:
+        self._begin("read_ordered", self.read_ordered(spec))
+
+    def read_ordered_end(self, buf=None) -> Status:
+        return self._end("read_ordered")
+
+    def write_ordered_begin(self, spec) -> None:
+        self._begin("write_ordered", self.write_ordered(spec))
+
+    def write_ordered_end(self, buf=None) -> Status:
+        return self._end("write_ordered")
 
 
 def _done_req(comm, st: Status) -> CompletedRequest:

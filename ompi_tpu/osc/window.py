@@ -98,6 +98,8 @@ class Window:
         self.attrs = {}
         self.info = info
         self.state = comm.state  # errhandler dispatch needs the rte
+        self._dynamic = False
+        self._attached: List[Tuple[int, np.ndarray]] = []
         if memory is None:
             memory = np.zeros(0, dtype=np.uint8)
         if not (isinstance(memory, np.ndarray) and memory.flags.c_contiguous):
@@ -191,9 +193,40 @@ class Window:
 
     def _region(self, disp: int, count: int, dtnum: int) -> np.ndarray:
         dt = _WIRE_DTYPES[dtnum]
+        need = count * dt.itemsize
+        if self._dynamic:
+            # dynamic windows (ref: osc MPI_Win_create_dynamic):
+            # disp is the target-side ABSOLUTE address (from
+            # MPI_Get_address); resolve against attached regions
+            for base, arr in self._attached:
+                if base <= disp and disp + need <= base + arr.nbytes:
+                    off = disp - base
+                    return arr.reshape(-1).view(np.uint8)[
+                        off:off + need].view(dt)
+            raise ValueError(
+                f"RMA at address {disp} hits no attached region "
+                "(MPI_ERR_RMA_RANGE)")
         off = disp * self.disp_unit
-        view = self._mem[off: off + count * dt.itemsize]
+        view = self._mem[off: off + need]
         return view.view(dt)
+
+    # -- dynamic windows (ref: ompi/mpi/c/win_create_dynamic.c) ---------
+    def attach(self, memory: np.ndarray) -> None:
+        if not self._dynamic:
+            raise ValueError("attach on a non-dynamic window "
+                             "(MPI_ERR_RMA_ATTACH)")
+        if not (isinstance(memory, np.ndarray)
+                and memory.flags.c_contiguous):
+            # a non-contiguous view would make _region's flat view a
+            # COPY and remote stores would vanish silently
+            raise ValueError("attached memory must be a contiguous "
+                             "ndarray (MPI_ERR_RMA_ATTACH)")
+        self._attached.append((memory.ctypes.data, memory))
+
+    def detach(self, memory: np.ndarray) -> None:
+        base = memory.ctypes.data
+        self._attached = [(b, a) for b, a in self._attached
+                          if b != base]
 
     def _apply(self, hdr: np.ndarray, src: int,
                payload: Optional[np.ndarray]) -> None:
@@ -349,8 +382,25 @@ class Window:
                        payload=a)
         self._ops_sent[target] += 1
 
-    def get_accumulate(self, arr, result: np.ndarray, target: int,
-                       disp: int = 0, op: opmod.Op = opmod.SUM) -> None:
+    # request-form RMA (ref: ompi/mpi/c/rput.c, raccumulate.c): the AM
+    # payload is snapshotted at issue, so local completion is
+    # immediate — the returned request is born complete (stronger than
+    # MPI requires; remote completion still needs flush/unlock)
+    def rput(self, arr, target: int, disp: int = 0):
+        from ompi_tpu.pml.request import CompletedRequest
+        self.put(arr, target, disp)
+        return CompletedRequest(self._progress)
+
+    def raccumulate(self, arr, target: int, disp: int = 0,
+                    op: opmod.Op = opmod.SUM):
+        from ompi_tpu.pml.request import CompletedRequest
+        self.accumulate(arr, target, disp, op)
+        return CompletedRequest(self._progress)
+
+    def rget_accumulate(self, arr, result: np.ndarray, target: int,
+                        disp: int = 0, op: opmod.Op = opmod.SUM):
+        """Returns the reply request (completes when `result` holds
+        the pre-accumulate target data)."""
         self._check_target(target)
         a, count, code = self._as_wire(arr)
         tag = self._new_reply_tag()
@@ -360,7 +410,11 @@ class Window:
         self._send_hdr(target, GET_ACC, disp, count, code, _op_code(op),
                        reply_tag=tag, payload=a)
         self._ops_sent[target] += 1
-        req.wait()
+        return req
+
+    def get_accumulate(self, arr, result: np.ndarray, target: int,
+                       disp: int = 0, op: opmod.Op = opmod.SUM) -> None:
+        self.rget_accumulate(arr, result, target, disp, op).wait()
 
     def fetch_and_op(self, value, result: np.ndarray, target: int,
                      disp: int = 0, op: opmod.Op = opmod.SUM) -> None:
@@ -525,6 +579,68 @@ def create(comm, memory: np.ndarray, disp_unit: Optional[int] = None,
 def allocate(comm, nbytes: int, disp_unit: int = 1, name: str = "") -> Window:
     """MPI_Win_allocate: window-owned zeroed memory."""
     return Window(comm, np.zeros(nbytes, dtype=np.uint8), disp_unit, name)
+
+
+def create_dynamic(comm, info=None, name: str = "") -> Window:
+    """MPI_Win_create_dynamic: no initial memory; regions come and go
+    via attach/detach, addressed by absolute address."""
+    win = Window(comm, np.zeros(0, dtype=np.uint8), 1, name, info=info)
+    win._dynamic = True
+    return win
+
+
+def allocate_shared(comm, nbytes: int, disp_unit: int = 1,
+                    name: str = "") -> Window:
+    """MPI_Win_allocate_shared (ref: osc/sm): one file-backed segment
+    mapped by every co-located rank; rank r's window memory is its
+    slice, and shared_query exposes any peer's slice for direct
+    load/store."""
+    import mmap as mmap_mod
+    import os
+
+    rte = comm.state.rte
+    # must be a shared-memory domain (same node)
+    my_node = getattr(rte, "node_id", 0)
+    for g in comm.group:
+        st = comm._peer_state(g)
+        if st is None:
+            node = rte.modex_get(g, "node_id") \
+                if hasattr(rte, "kv") else my_node
+            if node != my_node:
+                raise ValueError(
+                    "MPI_Win_allocate_shared needs co-located ranks "
+                    "(MPI_ERR_RMA_SHARED)")
+    session = getattr(rte, "session_dir", "/tmp")
+    path = os.path.join(
+        session, f"winshared_{getattr(rte, 'jobid', 'job')}_"
+                 f"{min(comm.group)}_{comm.cid}.buf")
+    total = max(1, nbytes) * comm.size
+    if comm.rank == 0:
+        tmp = f"{path}.tmp"
+        fd = os.open(tmp, os.O_CREAT | os.O_RDWR, 0o600)
+        os.ftruncate(fd, total)
+        os.close(fd)
+        os.rename(tmp, path)
+    comm.Barrier()
+    fd = os.open(path, os.O_RDWR)
+    mm = mmap_mod.mmap(fd, total)
+    os.close(fd)
+    seg = np.frombuffer(mm, dtype=np.uint8)
+    mine = seg[comm.rank * nbytes: comm.rank * nbytes + nbytes]
+    win = Window(comm, mine, disp_unit, name)
+    win._shared_seg = seg
+    win._shared_nbytes = nbytes
+    win._shared_disp_unit = disp_unit
+    return win
+
+
+def shared_query(win: Window, rank: int):
+    """(size, disp_unit, local view of `rank`'s segment)."""
+    seg = getattr(win, "_shared_seg", None)
+    if seg is None:
+        raise ValueError("not a shared window (MPI_ERR_WIN)")
+    n = win._shared_nbytes
+    return n, win._shared_disp_unit, seg[rank * n: rank * n + n]
 
 
 from ompi_tpu import errhandler as _eh_mod  # noqa: E402

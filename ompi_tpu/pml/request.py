@@ -121,3 +121,58 @@ def wait_some(reqs: List[Request]) -> List[int]:
 
 def test_all(reqs: List[Request]) -> bool:
     return all(r.complete or r.test() for r in reqs)
+
+
+def test_any(reqs: List[Request]):
+    """MPI_Testany analog: (index, status) of one completed request,
+    or (-1, None) when none is ready ((-1, None) also for [] like
+    wait_any's empty guard)."""
+    if not reqs:
+        return -1, None
+    for i, r in enumerate(reqs):
+        if r.complete or r.test():
+            return i, r.status
+    return -1, None
+
+
+def test_some(reqs: List[Request]) -> List[int]:
+    """MPI_Testsome analog: indices completed right now (may be
+    empty; never blocks)."""
+    return [i for i, r in enumerate(reqs) if r.complete or r.test()]
+
+
+def request_get_status(req: Request):
+    """MPI_Request_get_status: (flag, status) without freeing."""
+    done = req.complete or req.test()
+    return done, (req.status if done else None)
+
+
+class Grequest(Request):
+    """Generalized request (ref: ompi/mpi/c/grequest_start.c): the
+    user signals completion via .complete_now(); query_fn fills the
+    status at completion-query time, free_fn/cancel_fn at the
+    respective lifecycle points."""
+
+    def __init__(self, progress: Progress, query_fn=None, free_fn=None,
+                 cancel_fn=None, extra_state=None) -> None:
+        super().__init__(progress)
+        self._query_fn = query_fn
+        self._free_fn = free_fn
+        self._cancel_fn = cancel_fn
+        self._extra = extra_state
+
+    def complete_now(self) -> None:
+        """MPI_Grequest_complete."""
+        if self._query_fn is not None:
+            self._query_fn(self._extra, self.status)
+        self._complete()
+
+    def cancel(self) -> None:
+        if self._cancel_fn is not None:
+            self._cancel_fn(self._extra, self.complete)
+        super().cancel()
+
+    def free(self) -> None:
+        if self._free_fn is not None:
+            self._free_fn(self._extra)
+        super().free()

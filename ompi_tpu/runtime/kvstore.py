@@ -137,7 +137,14 @@ class KVServer:
                     _send_msg(conn, {"ok": True})
                 elif op == "spawn":
                     # allocate a universe-rank block and hand the
-                    # launch to mpirun's supervision loop
+                    # launch to mpirun's supervision loop.  segments =
+                    # [{cmd, args, n}] — one world spanning every
+                    # segment (MPI_Comm_spawn_multiple shape; plain
+                    # spawn is one segment)
+                    segments = msg.get("segments") or [{
+                        "cmd": msg["cmd"], "args": msg.get("args") or [],
+                        "n": int(msg["maxprocs"])}]
+                    total = sum(int(s["n"]) for s in segments)
                     with self.cv:
                         if not self.spawn_enabled:
                             _send_msg(conn, {
@@ -145,12 +152,11 @@ class KVServer:
                                          "supported by this launcher"})
                             continue
                         base = self.universe
-                        self.universe += int(msg["maxprocs"])
+                        self.universe += total
                         self.spawn_requests.append({
                             "base": base,
-                            "maxprocs": int(msg["maxprocs"]),
-                            "cmd": msg["cmd"],
-                            "args": msg.get("args") or [],
+                            "maxprocs": total,
+                            "segments": segments,
                             "parent_root": int(msg["parent_root"]),
                         })
                         self.cv.notify_all()
@@ -219,9 +225,15 @@ class KVClient:
               parent_root: int) -> int:
         """Ask the launcher for `maxprocs` new universe ranks running
         `cmd`; returns the allocated rank base."""
+        return self.spawn_multiple(
+            [{"cmd": cmd, "args": args, "n": maxprocs}], parent_root)
+
+    def spawn_multiple(self, segments: List[dict],
+                       parent_root: int) -> int:
+        """Spawn one world made of several (cmd, args, n) segments
+        (MPI_Comm_spawn_multiple)."""
         with self._lock:
-            _send_msg(self._sock, {"op": "spawn", "cmd": cmd,
-                                   "args": args, "maxprocs": maxprocs,
+            _send_msg(self._sock, {"op": "spawn", "segments": segments,
                                    "parent_root": parent_root})
             resp = _recv_msg(self._sock)
         if resp is None:

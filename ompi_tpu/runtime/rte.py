@@ -152,6 +152,7 @@ class EnvRTE(RTE):
         self.size = int(os.environ.get(
             "TPUMPI_UNIVERSE", os.environ["TPUMPI_SIZE"]))
         self.parent_root = os.environ.get("TPUMPI_PARENT_ROOT")
+        self.appnum = int(os.environ.get("TPUMPI_APPNUM", "0"))
         self.jobid = os.environ.get("TPUMPI_JOBID", "job0")
         self.node_id = int(os.environ.get("TPUMPI_NODE", "0"))
         self.session_dir = os.environ.get("TPUMPI_SESSION_DIR", "/tmp")

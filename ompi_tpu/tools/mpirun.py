@@ -290,12 +290,18 @@ def main(argv: Optional[List[str]] = None) -> int:
                 reqs, server.spawn_requests = server.spawn_requests, []
             for rq in reqs:
                 base, k = rq["base"], rq["maxprocs"]
-                prog = rq["cmd"]
-                cmd0 = [sys.executable, prog] + rq["args"] \
-                    if prog.endswith(".py") else [prog] + rq["args"]
+                seg_of = []  # (segment index, cmd) per local index
+                for si, seg in enumerate(rq["segments"]):
+                    prog = seg["cmd"]
+                    c = [sys.executable, prog] + list(seg["args"]) \
+                        if prog.endswith(".py") \
+                        else [prog] + list(seg["args"])
+                    seg_of += [(si, c)] * int(seg["n"])
                 for i in range(k):
+                    appnum, cmd0 = seg_of[i]
                     env = dict(env_base)
                     env.update({
+                        "TPUMPI_APPNUM": str(appnum),
                         "TPUMPI_RANK": str(base + i),
                         "TPUMPI_SIZE": str(k),
                         "TPUMPI_WORLD_BASE": str(base),

@@ -196,6 +196,28 @@ def ineighbor_alltoall(comm, sbuf, sbcount, sdt, rbuf, rbcount, rdt):
         lambda tag: _reqs_alltoall(comm, sb.arr, sc, rb.arr, rc, tag), rb)
 
 
+def ineighbor_allgatherv(comm, sbuf, scount, sdt, rbuf, rcounts, displs,
+                         rdt):
+    sb = typed(sbuf, scount, sdt)
+    total = max((d + c for d, c in zip(displs, rcounts)), default=0)
+    rb = typed(rbuf, total, rdt, writable=True)
+    rs = _scale(rb, rdt)
+
+    def reqs_fn(tag):
+        topo = _topo(comm)
+        pml = comm.state.pml
+        reqs = [pml.irecv(
+            rb.arr[displs[i] * rs:(displs[i] + rcounts[i]) * rs],
+            rcounts[i] * rs, _dt(rb.arr), src, tag, comm)
+            for i, src in enumerate(topo.in_neighbors(comm.rank))]
+        reqs += [pml.isend(sb.arr, sb.arr.size, _dt(sb.arr), dst, tag,
+                           comm)
+                 for dst in topo.out_neighbors(comm.rank)]
+        return reqs
+
+    return _ineighbor(comm, reqs_fn, rb)
+
+
 def ineighbor_alltoallv(comm, sbuf, scounts, sdispls, sdt, rbuf, rcounts,
                         rdispls, rdt):
     stotal = max((d + c for d, c in zip(sdispls, scounts)), default=0)

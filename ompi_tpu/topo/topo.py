@@ -209,7 +209,24 @@ def cart_create(comm, dims: Sequence[int], periods=None,
     if len(periods) != len(dims):
         raise ValueError(
             f"periods length {len(periods)} != ndims {len(dims)}")
-    sub = comm.split(0 if comm.rank < n else UNDEFINED_TOPO, comm.rank)
+    key = comm.rank
+    if reorder:
+        # treematch analog with the device mesh as the distance metric
+        # (ref: ompi/mca/topo/treematch — reorder ranks against the
+        # hardware distance): order ranks by device id so row-major
+        # grid coordinates walk the ICI chain and last-dim neighbors
+        # (the hot halo axis) sit on adjacent chips.
+        devs = []
+        for g in comm.group:
+            st = comm._peer_state(g)
+            if st is None or st.device is None:
+                devs = None
+                break
+            devs.append(int(st.device.id))
+        if devs is not None and len(set(devs)) == len(devs):
+            key = sorted(range(comm.size),
+                         key=lambda r: devs[r]).index(comm.rank)
+    sub = comm.split(0 if comm.rank < n else UNDEFINED_TOPO, key)
     if sub is None:
         return None
     sub.topo = CartTopo(dims, periods, sub.rank)
@@ -227,6 +244,42 @@ def graph_create(comm, index: Sequence[int], edges: Sequence[int],
     if sub is None:
         return None
     sub.topo = GraphTopo(index, edges)
+    return sub
+
+
+def dist_graph_create(comm, sources, degrees, destinations,
+                      weights=None, reorder: bool = False):
+    """MPI_Dist_graph_create (general form, ref:
+    ompi/mpi/c/dist_graph_create.c): every rank may declare edges for
+    ANY source; the union is distributed by an allgather of the flat
+    (src, dst) pairs, then each rank extracts its own adjacency."""
+    import numpy as np
+
+    pairs = []
+    off = 0
+    for i, s in enumerate(sources):
+        for _ in range(degrees[i]):
+            w = int(weights[off]) if weights is not None else 1
+            pairs.append((int(s), int(destinations[off]), w))
+            off += 1
+    flat = np.array([x for p in pairs for x in p], dtype=np.int64)
+    counts = np.zeros(comm.size, dtype=np.int64)
+    mine = np.array([flat.size], dtype=np.int64)
+    comm.Allgather(mine, counts)
+    total = int(counts.sum())
+    allflat = np.empty(total, dtype=np.int64)
+    displs = [int(counts[:r].sum()) for r in range(comm.size)]
+    comm.Allgatherv(flat, allflat, [int(c) for c in counts], displs)
+    edges = allflat.reshape(-1, 3)
+    me = comm.rank
+    # edge multiplicity is significant (MPI-3 §7.5.4): keep duplicates
+    ins = sorted((int(s), int(w)) for s, d, w in edges if d == me)
+    outs = sorted((int(d), int(w)) for s, d, w in edges if s == me)
+    sub = comm.dup()
+    sub.topo = DistGraphTopo(
+        [s for s, _w in ins], [d for d, _w in outs],
+        [w for _s, w in ins] if weights is not None else None,
+        [w for _d, w in outs] if weights is not None else None)
     return sub
 
 
