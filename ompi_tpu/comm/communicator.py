@@ -466,7 +466,10 @@ class Communicator:
         return pers.ibsend(self, buf, count, dt, dest, tag)
 
     # a ready send is correct whenever a standard send is; the
-    # reference's rsend is likewise standard-send under ob1
+    # reference's rsend is likewise standard-send under ob1.  This
+    # silently legalizes erroneous programs (no matching-recv check),
+    # so the behavior is declared in the registry
+    # (pml_ob1_rsend_is_standard) for ompi_info discoverability.
     def Rsend(self, spec, dest: int, tag: int = 0) -> None:
         self.Send(spec, dest, tag)
 

@@ -39,6 +39,13 @@ from .request import (ANY_SOURCE, ANY_TAG, PROC_NULL, ERR_TRUNCATE,
 
 pml_framework = frameworks.create("ompi", "pml")
 
+registry.register(
+    "pml", "ob1", "rsend_is_standard", True, bool,
+    help="Ready sends are executed as standard sends (the reference's "
+         "ob1 behavior): a missing matching receive is NOT detected, "
+         "so erroneous ready-mode programs run silently.  Read-only "
+         "declaration for ompi_info.")
+
 # Send modes
 MODE_STANDARD = 0
 MODE_SYNC = 1
