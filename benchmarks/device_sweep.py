@@ -5,12 +5,26 @@ allreduce/bcast/alltoall/reduce_scatter on device-resident arrays
 through the XLA collective path.  Used by bench.py; also runnable
 directly:  python benchmarks/device_sweep.py --max-ar 1048576
 
-Two-phase structure — TIME EVERYTHING FIRST, VERIFY AT THE END:
-on tunneled-TPU backends (the CI axon plugin) any device->host
-transfer permanently degrades subsequent dispatch latency by ~3
-orders of magnitude, so the timing phase performs zero host reads;
-results are held as device arrays and asserted afterwards (a
-fast-but-wrong bench is still worthless, the check just moves).
+Timing methodology (forced completion — r3 redesign):
+on the tunneled TPU backend ``jax.Array.block_until_ready()`` returns
+WITHOUT awaiting execution (measured: 10 dispatched 8-MiB 8-way sums
+"complete" in 0.37 ms), so any timing that relies on it reports the
+dispatch floor, not the op.  Every timed point here instead:
+
+  1. warms up the op AND a tiny per-shape probe read (first read
+     compiles; ~1 s on the tunnel), verifying the numeric result;
+  2. measures the tunnel-RPC read constant (min of several 4-byte
+     d2h reads, ~100 ms on the tunnel);
+  3. dispatches N back-to-back collectives (N chosen so
+     N*op >= max(0.3 s, 4x read constant), never < 30) and forces
+     completion with ONE 4-byte d2h read of the LAST result —
+     in-order device execution makes that await all N;
+  4. reports (elapsed - read_const) / N, rank 0 as the timekeeper
+     (concurrent per-rank reads would serialize on the tunnel).
+
+A physical sanity gate then aborts the sweep if any implied bandwidth
+exceeds the chip's HBM peak — a number faster than the hardware is a
+measurement bug, not a result.
 """
 
 from __future__ import annotations
@@ -20,6 +34,19 @@ import json
 import time
 
 import numpy as np
+
+MIB = 1024 * 1024
+
+# HBM peak bytes/s by device kind (generous: judge-gate, not a claim)
+_HBM_PEAK = {
+    "TPU v5 lite": 0.82e12,
+    "TPU v5e": 0.82e12,
+    "TPU v4": 1.23e12,
+    "TPU v5p": 2.77e12,
+    "TPU v6 lite": 1.64e12,
+    "TPU v6e": 1.64e12,
+}
+_HBM_PEAK_DEFAULT = 3.5e12
 
 
 def _rank_devices(nranks: int):
@@ -49,26 +76,92 @@ def should_continue(comm, deadline: float) -> bool:
     return bool(flag[0])
 
 
-def _time_arr(comm, make_op, probe_s: float) -> float:
-    """Iteration count decided by rank 0 and broadcast — every rank
-    must run the same number of collectives; capped so one slow size
-    can never eat the whole budget."""
-    from ompi_tpu.op import op as mpi_op
+def _measure_read_const(probe) -> float:
+    """Tunnel-RPC constant of one tiny d2h read (min of 5)."""
+    best = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter()
+        probe()
+        best = min(best, time.perf_counter() - t0)
+    return best
 
-    it = np.array([max(2, min(50, int(0.2 / max(probe_s, 1e-6))))],
-                  dtype=np.int32)
-    comm.Bcast(it, root=0)
-    iters = int(it[0])
-    comm.Barrier()
-    t0 = time.perf_counter()
-    r = None
-    for _ in range(iters):
-        r = make_op()
-    r.block_until_ready()
-    mine = np.array([(time.perf_counter() - t0) / iters])
-    worst = np.empty_like(mine)
-    comm.Allreduce(mine, worst, mpi_op.MAX)
-    return float(worst[0])
+
+def _forced_time(comm, make_op, read_token, read_const: float,
+                 deadline: float) -> float:
+    """One timed point: N back-to-back dispatches + ONE forced read.
+
+    All ranks dispatch (the collective requires it); rank 0 is the
+    timekeeper and performs the single completion-forcing read, then
+    broadcasts the per-op seconds.  N is picked from a small forced
+    probe so N*op >= max(0.3 s, 4x read_const): the read constant's
+    jitter (~20 ms on the tunnel) must be amortized into the noise.
+    """
+    target = max(0.3, 4.0 * read_const)
+    max_iters = 1_000_000
+    iters = 64 if read_const > 1e-3 else 30  # fast local backends: small N
+    while True:
+        comm.Barrier()
+        t0 = time.perf_counter()
+        r = None
+        for _ in range(iters):
+            r = make_op()
+        if comm.rank == 0:
+            read_token(r)
+            work = time.perf_counter() - t0 - read_const
+            over_deadline = (deadline > 0
+                             and time.perf_counter() >= deadline)
+            if work >= target or iters >= max_iters or over_deadline:
+                # deadline-forced acceptance of a jitter-dominated
+                # point is reported as unmeasurable, never as a number
+                per_op = (work / iters
+                          if work > max(0.0, 0.2 * read_const)
+                          else -1.0)
+                ctl = np.array([1.0, per_op])
+            else:
+                # project N from the measured round (clamped growth)
+                grow = target / max(work, 0.01)
+                iters = int(min(max_iters, max(iters * 2, iters * grow)))
+                ctl = np.array([0.0, float(iters)])
+        else:
+            ctl = np.empty(2)
+        comm.Bcast(ctl, root=0)
+        if ctl[0] == 1.0:
+            comm.Barrier()
+            return float(ctl[1])
+        iters = int(ctl[1])
+
+
+def _sanity_gate(out: dict, nranks: int, single_chip: bool) -> None:
+    """Abort if any implied bandwidth beats the hardware: on a single
+    chip every stacked collective must READ all P input shards from
+    HBM, so P*n/t <= HBM peak; on a mesh the OSU busbw
+    2(P-1)/P * n/t cannot beat HBM peak either (ICI is slower).
+    A violation means the timing is a dispatch artifact."""
+    import jax
+
+    if jax.default_backend() != "tpu":
+        return  # virtual CPU meshes: no physical model to gate on
+    kind = jax.devices()[0].device_kind
+    peak = _HBM_PEAK.get(kind, _HBM_PEAK_DEFAULT)
+    for kind_name, table in out.items():
+        if not isinstance(table, dict):
+            continue
+        for k, us in table.items():
+            if k == "truncated" or us is None:
+                continue
+            nbytes, t = int(k), us * 1e-6
+            if t <= 0:
+                raise RuntimeError(
+                    f"sanity gate: non-positive time {us} us for "
+                    f"{kind_name}/{k}B")
+            implied = (nranks * nbytes / t if single_chip
+                       else 2 * (nranks - 1) / nranks * nbytes / t)
+            if implied > 1.05 * peak:
+                raise RuntimeError(
+                    f"sanity gate: {kind_name} at {nbytes} B implies "
+                    f"{implied / 1e9:.0f} GB/s > {peak / 1e9:.0f} GB/s "
+                    f"HBM peak of {kind!r} — timing did not await "
+                    f"execution (dispatch-floor artifact)")
 
 
 def run_device_sweep(nranks: int, max_ar: int, max_bcast: int,
@@ -85,21 +178,53 @@ def run_device_sweep(nranks: int, max_ar: int, max_bcast: int,
 
     def fn(comm):
         out = {"allreduce": {}, "bcast": {}, "alltoall": {},
-               "reduce_scatter": {}, "truncated": False}
-        # deferred correctness checks: (kind, size_key, result,
-        # expected first element) — read ONLY in the verify phase
-        checks = []
+               "reduce_scatter": {}, "truncated": False,
+               "read_const_us": None}
+
+        # per-shape probe reads (compiled at warmup); the token is the
+        # first element of the flattened result
+        token_fns = {}
+
+        def read_token(arr) -> float:
+            key = (arr.shape, str(arr.dtype))
+            f = token_fns.get(key)
+            if f is None:
+                f = jax.jit(lambda a: a.reshape(-1)[:1])
+                token_fns[key] = f
+            return float(np.asarray(f(arr))[0])
+
+        # tunnel-RPC read constant, measured on a warmed tiny read
+        read_const = 0.0
+        if comm.rank == 0:
+            tiny = jnp.zeros((1,), jnp.float32)
+            read_token(tiny)  # compile the probe
+            read_const = _measure_read_const(lambda: read_token(tiny))
+            out["read_const_us"] = round(read_const * 1e6, 1)
+        rc = np.array([read_const])
+        comm.Bcast(rc, root=0)
+        read_const = float(rc[0])
 
         def one(kind, size_key, make_op, expect0):
+            # warmup: compile op + probe, verify the numeric result on
+            # BOTH the first and the last rank (a collective broken
+            # only on its final ring/tree step passes a rank-0-only
+            # check); reads staggered so the tunnel RPCs serialize
             r = make_op()
-            r.block_until_ready()  # compile
-            t0 = time.perf_counter()
-            r = make_op()
-            r.block_until_ready()  # probe
-            probe = time.perf_counter() - t0
-            out[kind][size_key] = round(
-                _time_arr(comm, make_op, probe) * 1e6, 2)
-            checks.append((kind, size_key, r, expect0))
+            if comm.rank == 0:
+                got = read_token(r)
+                assert abs(got - expect0) < 1e-3, \
+                    (kind, size_key, got, expect0)
+            comm.Barrier()
+            if comm.rank == nranks - 1:
+                got = read_token(r)
+                assert abs(got - expect0) < 1e-3, \
+                    (kind, size_key, got, expect0)
+            comm.Barrier()
+            t = _forced_time(comm, make_op, read_token, read_const,
+                             deadline)
+            # -1 = deadline hit before the point could be amortized
+            # past the read-constant jitter: unmeasurable, not a number
+            out[kind][size_key] = round(t * 1e6, 2) if t > 0 else None
 
         expect_sum = float(sum(range(1, nranks + 1)))
         for nbytes in sizes_upto(max_ar):
@@ -150,22 +275,16 @@ def run_device_sweep(nranks: int, max_ar: int, max_bcast: int,
                     lambda: comm.reduce_scatter_arr(x, mpi_op.SUM),
                     expect_sum)
 
-        # verify phase: first host reads of the whole run.  Two ranks
-        # suffice (results are either identical across ranks or
-        # per-rank with identical element 0) and keep the slow
-        # post-read path off the other threads.
-        comm.Barrier()
-        if comm.rank in (0, nranks - 1):
-            for kind, size_key, r, expect0 in checks:
-                got = float(np.asarray(r).ravel()[0])
-                assert abs(got - expect0) < 1e-3, \
-                    (kind, size_key, got, expect0)
         comm.Barrier()
         return out
 
     res = run_ranks(nranks, fn, devices=devices, device_map=device_map,
                     timeout=3600)
-    return res[0]
+    out = res[0]
+    import jax as _jax
+    single_chip = len(_jax.devices()) < nranks
+    _sanity_gate(out, nranks, single_chip)
+    return out
 
 
 def main() -> None:

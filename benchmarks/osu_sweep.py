@@ -40,12 +40,18 @@ def sizes_upto(max_bytes: int, start: int = 4):
 _DEADLINE = [0.0]
 
 
-def _should_continue(comm) -> bool:
+def _should_continue(comm, last_dt_s: float = 0.0) -> bool:
     """Collectively-agreed budget check (rank 0 decides): ranks must
-    never diverge on whether the next size's collectives run."""
+    never diverge on whether the next size's collectives run.
+
+    ``last_dt_s`` is the previous size's per-op time: the NEXT size is
+    ~2x that, and its unbudgeted warmup probe alone could eat the rest
+    of the budget (the r2 starvation failure: a 110 s probe at 128 MiB
+    consumed the entire window before any timed point ran) — so the
+    projected probe cost gates entry, not just the wall clock."""
     d = _DEADLINE[0]
-    flag = np.array([1 if (d <= 0 or time.perf_counter() < d) else 0],
-                    dtype=np.int32)
+    ok = d <= 0 or (time.perf_counter() + 4.0 * last_dt_s) < d
+    flag = np.array([1 if ok else 0], dtype=np.int32)
     comm.Bcast(flag, root=0)
     return bool(flag[0])
 
@@ -70,8 +76,9 @@ def _timeit(comm, fn, dt_probe: float) -> float:
 
 def bench_allreduce(comm, max_bytes: int) -> dict:
     out = {}
+    last = 0.0
     for nbytes in sizes_upto(max_bytes):
-        if not _should_continue(comm):
+        if not _should_continue(comm, last):
             out["truncated"] = True
             return out
         n = max(1, nbytes // 4)
@@ -84,13 +91,15 @@ def bench_allreduce(comm, max_bytes: int) -> dict:
                        probe)
         assert abs(r[0] - sum(range(1, comm.size + 1))) < 1e-3
         out[str(n * 4)] = round(dt_s * 1e6, 2)
+        last = dt_s
     return out
 
 
 def bench_bcast(comm, max_bytes: int) -> dict:
     out = {}
+    last = 0.0
     for nbytes in sizes_upto(max_bytes):
-        if not _should_continue(comm):
+        if not _should_continue(comm, last):
             out["truncated"] = True
             return out
         n = max(1, nbytes // 4)
@@ -101,14 +110,16 @@ def bench_bcast(comm, max_bytes: int) -> dict:
         dt_s = _timeit(comm, lambda: comm.Bcast(x, root=0), probe)
         assert x[0] == 7.0
         out[str(n * 4)] = round(dt_s * 1e6, 2)
+        last = dt_s
     return out
 
 
 def bench_alltoall(comm, max_bytes: int) -> dict:
     """max_bytes is the per-peer message size (OSU convention)."""
     out = {}
+    last = 0.0
     for nbytes in sizes_upto(max_bytes):
-        if not _should_continue(comm):
+        if not _should_continue(comm, last):
             out["truncated"] = True
             return out
         n = max(1, nbytes // 4) * comm.size
@@ -120,6 +131,7 @@ def bench_alltoall(comm, max_bytes: int) -> dict:
         dt_s = _timeit(comm, lambda: comm.Alltoall(x, r), probe)
         assert r[0] == 1.0 and r[-1] == float(comm.size)
         out[str(max(1, nbytes // 4) * 4)] = round(dt_s * 1e6, 2)
+        last = dt_s
     return out
 
 
@@ -130,8 +142,9 @@ def bench_rsb_vector(comm, max_bytes: int) -> dict:
     stride=2) — contiguous coverage but exercising the derived-type
     pack path."""
     out = {}
+    last = 0.0
     for nbytes in sizes_upto(max_bytes, start=64):
-        if not _should_continue(comm):
+        if not _should_continue(comm, last):
             out["truncated"] = True
             return out
         per = max(2, nbytes // 8 // 2 * 2)  # doubles per rank, even
@@ -150,6 +163,7 @@ def bench_rsb_vector(comm, max_bytes: int) -> dict:
         dt_s = _timeit(comm, op_, probe)
         assert r[0] == float(comm.size)
         out[str(per * 8)] = round(dt_s * 1e6, 2)
+        last = dt_s
     return out
 
 

@@ -27,6 +27,8 @@ APPNUM = -107
 LASTUSEDCODE = -105
 
 _registry: Dict[int, Tuple[Optional[Callable], Optional[Callable], Any]] = {}
+_refs: Dict[int, int] = {}    # live attachments per keyval
+_freed: set = set()           # freed-but-still-attached keyvals
 _counter = itertools.count(1000)
 _lock = threading.Lock()
 
@@ -42,8 +44,31 @@ def create_keyval(copy_fn: Optional[Callable] = None,
 
 
 def free_keyval(keyval: int) -> None:
+    """MPI_*_free_keyval: freeing is deferred while attributes are
+    still attached — the (copy_fn, delete_fn, extra) entry stays live
+    so later dup/free of holding objects still runs the callbacks
+    (ref: ompi/attribute/attribute.c ompi_attr_free_keyval)."""
     with _lock:
-        _registry.pop(keyval, None)
+        if keyval not in _registry:
+            return
+        if _refs.get(keyval, 0) > 0:
+            _freed.add(keyval)
+        else:
+            _registry.pop(keyval, None)
+
+
+def _ref(keyval: int, delta: int) -> None:
+    if keyval < 0:
+        return
+    with _lock:
+        n = _refs.get(keyval, 0) + delta
+        if n <= 0:
+            _refs.pop(keyval, None)
+            if keyval in _freed:
+                _freed.discard(keyval)
+                _registry.pop(keyval, None)
+        else:
+            _refs[keyval] = n
 
 
 def _entry(keyval: int):
@@ -57,11 +82,18 @@ def _entry(keyval: int):
 
 def set_attr(obj, keyval: int, value: Any) -> None:
     """Overwriting an existing value runs its delete callback first
-    (ref: attribute.c set semantics)."""
+    (ref: attribute.c set semantics).  Attaching through a freed
+    keyval is erroneous (MPI_ERR_KEYVAL)."""
     _entry(keyval)
+    with _lock:
+        freed = keyval in _freed
+    if freed:
+        raise ValueError(f"attribute keyval {keyval} has been freed "
+                         "(MPI_ERR_KEYVAL)")
     if keyval in obj.attrs:
         delete_attr(obj, keyval)
     obj.attrs[keyval] = value
+    _ref(keyval, +1)
 
 
 def get_attr(obj, keyval: int) -> Tuple[bool, Any]:
@@ -77,6 +109,7 @@ def delete_attr(obj, keyval: int) -> None:
         value = obj.attrs.pop(keyval)
         if delete_fn is not None:
             delete_fn(obj, keyval, value, extra)
+        _ref(keyval, -1)
 
 
 def copy_all(old, new) -> None:
@@ -92,6 +125,7 @@ def copy_all(old, new) -> None:
         out = copy_fn(old, keyval, extra, value)
         if out is not None:
             new.attrs[keyval] = out
+            _ref(keyval, +1)
 
 
 def delete_all(obj) -> None:

@@ -213,7 +213,9 @@ class Communicator:
             ok = 1 if agreed not in self.state.comms else 0
             all_ok = shim._allreduce_max_int(-ok, wire_tag)
             if all_ok == -1:
-                return Communicator(self.state, agreed, group)
+                new = Communicator(self.state, agreed, group)
+                new.errhandler = self.errhandler  # MPI: children inherit
+                return new
             self.state.comms.setdefault(agreed, None)
 
     def create(self, group: Group) -> Optional["Communicator"]:
@@ -223,7 +225,9 @@ class Communicator:
         if group.rank_of(self.state.rank) == UNDEFINED:
             self.state.comms.setdefault(cid, None)  # keep cid reserved
             return None
-        return Communicator(self.state, cid, group)
+        new = Communicator(self.state, cid, group)
+        new.errhandler = self.errhandler  # MPI: children inherit
+        return new
 
     def split(self, color: int, key: int = 0) -> Optional["Communicator"]:
         """MPI_Comm_split (ref: comm.c:406): gather (color,key) on
@@ -263,7 +267,9 @@ class Communicator:
         if not mine:
             self.state.comms.setdefault(cid, None)
             return None
-        return Communicator(self.state, cid, Group(mine))
+        new = Communicator(self.state, cid, Group(mine))
+        new.errhandler = self.errhandler  # MPI: children inherit
+        return new
 
     def split_type(self, split_type: int, key: int = 0
                    ) -> Optional["Communicator"]:

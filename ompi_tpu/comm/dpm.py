@@ -88,11 +88,29 @@ def comm_accept(comm: Communicator, port: str, root: int = 0
     meta = np.empty(2, dtype=np.int64)
     if comm.rank == root:
         kv = _kv(state)
-        seq = abs(hash(port)) % 100_000
-        cid = _PORT_CID_BASE - seq
-        kv.put(f"port:{port}:accept",
+        # pair acceptor i with connector i on this port (sequence
+        # counters), and draw the bridge cid from a universe-global
+        # counter so concurrent handshakes can never collide
+        aseq = kv.incr(f"port:{port}:aseq")
+        cid = _PORT_CID_BASE - kv.incr("dpm:bridge_cid")
+        kv.put(f"port:{port}:accept:{aseq}",
                {"leader": state.rank, "cid": cid})
-        peer = kv.get(f"port:{port}:connect", timeout=300.0)
+        try:
+            peer = kv.take(f"port:{port}:connect:{aseq}", timeout=300.0)
+        except TimeoutError:
+            # No connector: withdraw the offer so the port counters
+            # stay in sync for later pairs.  If the record is already
+            # gone a connector consumed it while we timed out — the
+            # rendezvous actually succeeded, so finish it.
+            try:
+                kv.take(f"port:{port}:accept:{aseq}", timeout=0.05)
+                withdrawn = True
+            except TimeoutError:
+                withdrawn = False
+            if withdrawn:
+                kv.uncr(f"port:{port}:aseq", aseq)
+                raise
+            peer = kv.take(f"port:{port}:connect:{aseq}", timeout=30.0)
         meta[0] = cid
         meta[1] = peer["leader"]
     comm.Bcast(meta, root=root)
@@ -108,8 +126,15 @@ def comm_connect(comm: Communicator, port: str, root: int = 0
     meta = np.empty(2, dtype=np.int64)
     if comm.rank == root:
         kv = _kv(state)
-        acc = kv.get(f"port:{port}:accept", timeout=300.0)
-        kv.put(f"port:{port}:connect", {"leader": state.rank})
+        cseq = kv.incr(f"port:{port}:cseq")
+        try:
+            acc = kv.take(f"port:{port}:accept:{cseq}", timeout=300.0)
+        except TimeoutError:
+            # No acceptor: return the ticket so the next well-matched
+            # pair on this port still lines up (counter-desync guard)
+            kv.uncr(f"port:{port}:cseq", cseq)
+            raise
+        kv.put(f"port:{port}:connect:{cseq}", {"leader": state.rank})
         meta[0] = acc["cid"]
         meta[1] = acc["leader"]
     comm.Bcast(meta, root=root)

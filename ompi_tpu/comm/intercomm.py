@@ -35,9 +35,20 @@ from .communicator import Communicator, Group, TAG_CID
 # rank that sources/sinks the data passes ROOT; its peers PROC_NULL)
 ROOT = -4
 
-TAG_ICREATE = -25
 TAG_IBRIDGE = -26
 TAG_IMERGE = -27
+
+
+def _icreate_wire_tag(tag: int) -> int:
+    """Fold the user's intercomm_create tag into the dedicated
+    [-1500, -1999] block so it can never land on another internal
+    protocol's tag (small negatives, create_group's [-400,-1399],
+    nbc's <=-2000) or in non-negative user tag space.  Like
+    create_group's fold, CONCURRENT creations between the same leader
+    pair with tags 500 apart would alias — the (peer_comm, tag) pair
+    disambiguates real uses; sequential creations are always safe
+    (matching is ordered per (cid, src, tag))."""
+    return -1500 - (tag % 500)
 
 
 class Intercommunicator(Communicator):
@@ -112,8 +123,10 @@ class Intercommunicator(Communicator):
                   self._remote_group.ranks + self._group.ranks)
         cid = _bridge_cid_agree_leader(
             self.state, lc, self if lc.rank == 0 else None, 0)
-        return Communicator(self.state, cid, Group(merged),
-                            name=f"{self.name}-merged")
+        out = Communicator(self.state, cid, Group(merged),
+                           name=f"{self.name}-merged")
+        out.errhandler = self.errhandler  # MPI: children inherit
+        return out
 
 
 _I64 = None
@@ -158,6 +171,7 @@ def intercomm_create(local_comm: Communicator, local_leader: int,
     _init_dt()
     state = local_comm.state
     am_leader = local_comm.rank == local_leader
+    wire_tag = _icreate_wire_tag(tag)
     if am_leader and peer_comm is None:
         raise ValueError("leader needs a peer communicator")
     pml = state.pml
@@ -166,17 +180,17 @@ def intercomm_create(local_comm: Communicator, local_leader: int,
     if am_leader:
         mine = np.asarray(local_comm.group_obj().ranks, dtype=np.int64)
         szs = np.array([mine.size], dtype=np.int64)
-        s1 = pml.isend(szs, 1, _I64, remote_leader, TAG_ICREATE + tag,
+        s1 = pml.isend(szs, 1, _I64, remote_leader, wire_tag,
                        peer_comm)
         their_n = np.empty(1, dtype=np.int64)
-        pml.recv(their_n, 1, _I64, remote_leader, TAG_ICREATE + tag,
+        pml.recv(their_n, 1, _I64, remote_leader, wire_tag,
                  peer_comm)
         s1.wait()
         s2 = pml.isend(mine, mine.size, _I64, remote_leader,
-                       TAG_ICREATE + tag, peer_comm)
+                       wire_tag, peer_comm)
         theirs = np.empty(int(their_n[0]), dtype=np.int64)
         pml.recv(theirs, theirs.size, _I64, remote_leader,
-                 TAG_ICREATE + tag, peer_comm)
+                 wire_tag, peer_comm)
         s2.wait()
         meta = np.array([theirs.size], dtype=np.int64)
     else:
@@ -205,8 +219,10 @@ def intercomm_create(local_comm: Communicator, local_leader: int,
     else:
         cid = _bridge_cid_agree_leader(state, local_comm, None,
                                        local_leader)
-    return Intercommunicator(state, cid, local_comm.group_obj(),
-                             remote_group, lc)
+    inter = Intercommunicator(state, cid, local_comm.group_obj(),
+                              remote_group, lc)
+    inter.errhandler = local_comm.errhandler  # MPI: children inherit
+    return inter
 
 
 def _bridge_cid_agree_leader(state, local_comm: Communicator,

@@ -54,6 +54,7 @@ class KVServer:
         self.data: Dict[str, Any] = {}
         self.lock = threading.Lock()
         self.cv = threading.Condition(self.lock)
+        self.counters: Dict[str, int] = {}
         self.fences: Dict[str, int] = {}
         self.fence_waiters: Dict[str, List[socket.socket]] = {}
         self.aborted: Optional[Tuple[int, int, str]] = None
@@ -112,6 +113,39 @@ class KVServer:
                             _send_msg(conn, {"timeout": True})
                         else:
                             _send_msg(conn, {"value": self.data[msg["key"]]})
+                elif op == "incr":
+                    # atomic fetch-and-add counter, distinct namespace
+                    # from put/get data (dpm cid + rendezvous sequencing)
+                    with self.cv:
+                        v = self.counters.get(msg["key"], 0)
+                        self.counters[msg["key"]] = v + 1
+                    _send_msg(conn, {"value": v})
+                elif op == "uncr":
+                    # compensating decrement: roll a ticket back only
+                    # if no later ticket was issued meanwhile (dpm
+                    # rendezvous-timeout recovery)
+                    with self.cv:
+                        cur = self.counters.get(msg["key"], 0)
+                        ok = cur == msg["expect"] + 1
+                        if ok:
+                            self.counters[msg["key"]] = msg["expect"]
+                    _send_msg(conn, {"ok": ok})
+                elif op == "take":
+                    # blocking get that atomically deletes the record:
+                    # one-shot rendezvous consumption (dpm accept/connect)
+                    timeout = msg.get("timeout", 60.0)
+                    with self.cv:
+                        deadline_hit = not self.cv.wait_for(
+                            lambda: msg["key"] in self.data
+                            or self.aborted is not None,
+                            timeout=timeout)
+                        if self.aborted is not None:
+                            _send_msg(conn, {"abort": list(self.aborted)})
+                        elif deadline_hit:
+                            _send_msg(conn, {"timeout": True})
+                        else:
+                            _send_msg(conn,
+                                      {"value": self.data.pop(msg["key"])})
                 elif op == "fence":
                     fid = msg["id"]
                     want = int(msg.get("n", self.nprocs))
@@ -209,6 +243,42 @@ class KVClient:
             raise RuntimeError(f"job aborted: {resp['abort']}")
         if resp.get("timeout"):
             raise TimeoutError(f"kv get({key}) timed out")
+        return resp["value"]
+
+    def incr(self, key: str) -> int:
+        """Atomic fetch-and-add on a server-side counter (returns the
+        pre-increment value)."""
+        with self._lock:
+            _send_msg(self._sock, {"op": "incr", "key": key})
+            resp = _recv_msg(self._sock)
+        if resp is None:
+            raise ConnectionError("kv server closed")
+        return int(resp["value"])
+
+    def uncr(self, key: str, expect: int) -> bool:
+        """Roll back a ticket taken with incr() (which returned
+        ``expect``) — succeeds only if no later ticket was issued."""
+        with self._lock:
+            _send_msg(self._sock, {"op": "uncr", "key": key,
+                                   "expect": expect})
+            resp = _recv_msg(self._sock)
+        if resp is None:
+            raise ConnectionError("kv server closed")
+        return bool(resp["ok"])
+
+    def take(self, key: str, timeout: float = 60.0) -> Any:
+        """Blocking get that atomically removes the record — one-shot
+        rendezvous consumption."""
+        with self._lock:
+            _send_msg(self._sock, {"op": "take", "key": key,
+                                   "timeout": timeout})
+            resp = _recv_msg(self._sock)
+        if resp is None:
+            raise ConnectionError("kv server closed")
+        if "abort" in resp:
+            raise RuntimeError(f"job aborted: {resp['abort']}")
+        if resp.get("timeout"):
+            raise TimeoutError(f"kv take({key}) timed out")
         return resp["value"]
 
     def fence(self, fence_id: str, n: Optional[int] = None) -> None:

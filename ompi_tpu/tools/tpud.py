@@ -251,13 +251,15 @@ def main(argv: Optional[List[str]] = None) -> int:
             report({"op": "node_done", "node": opts.node})
             break
 
-    # wait for tree children to finish on clean shutdown
-    for c in children:
-        if c.poll() is None and not killed.is_set():
-            try:
-                c.wait(timeout=5)
-            except subprocess.TimeoutExpired:
-                c.terminate()
+    # Tree children have their own direct HNP channels: on clean local
+    # completion they exit when the HNP tells them (exit/kill), or via
+    # our on_close kill if the HNP dies — so wait on them WITHOUT a
+    # kill timeout (a timed terminate() here would orphan a subtree
+    # whose ranks simply run longer than ours, and the HNP errmgr
+    # would then kill the whole job as a lost-daemon failure).
+    while (not killed.is_set()
+           and any(c.poll() is None for c in children)):
+        time.sleep(0.05)
     import shutil
     shutil.rmtree(session, ignore_errors=True)
     chan.close()

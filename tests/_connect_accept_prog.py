@@ -19,5 +19,17 @@ r = np.empty(1)
 inter.Allreduce(s, r, mpi_op.SUM)
 expect = 2.0 * (comm.size - half) if low else 1.0 * half
 assert r[0] == expect, (comm.rank, r[0], expect)
+
+# second rendezvous on the SAME port (r3 advisor regression: the
+# first round's connect record must have been consumed, and the two
+# bridge cids must differ — no stale-record pairing, no hash cids)
+if low:
+    inter2 = dpm.comm_accept(local, "ca-test-port")
+else:
+    inter2 = dpm.comm_connect(local, "ca-test-port")
+assert inter2.cid != inter.cid
+r2 = np.empty(1)
+inter2.Allreduce(s, r2, mpi_op.SUM)
+assert r2[0] == expect, (comm.rank, r2[0], expect)
 print("ok", flush=True)
 ompi_tpu.finalize()

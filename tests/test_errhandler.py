@@ -210,3 +210,83 @@ def test_flat_bindings_surface():
     assert kv > 0
     mpi.MPI_Comm_free_keyval(kv)
     assert callable(mpi.PMPI_Info_set)  # PMPI aliases cover new names
+
+
+# ---- r3 advisor regressions ----------------------------------------
+
+def test_errhandler_inherited_by_split_create_group():
+    """MPI: newly created communicators inherit the parent's error
+    handler (not just dup)."""
+    def fn(comm):
+        h = Errhandler(lambda c, code: None)
+        comm.Set_errhandler(h)
+        from ompi_tpu.comm.communicator import Group
+        sub = comm.split(0, comm.rank)
+        assert sub.Get_errhandler() is h
+        cg = comm.create_group(Group(list(range(comm.size))))
+        assert cg.Get_errhandler() is h
+        cr = comm.create(Group(list(range(comm.size))))
+        assert cr.Get_errhandler() is h
+        for c in (sub, cg, cr):
+            c.free()
+        return True
+
+    assert run_ranks(2, fn) == [True, True]
+
+
+def test_errhandler_inherited_by_intercomm_and_merge():
+    def fn(comm):
+        from ompi_tpu.comm.intercomm import intercomm_create
+        h = Errhandler(lambda c, code: None)
+        comm.Set_errhandler(h)
+        low = comm.rank < 1
+        local = comm.split(0 if low else 1)
+        local.Set_errhandler(h)
+        inter = intercomm_create(local, 0, comm, 1 if low else 0)
+        assert inter.Get_errhandler() is h
+        merged = inter.merge(high=not low)
+        assert merged.Get_errhandler() is h
+        return True
+
+    assert run_ranks(2, fn) == [True, True]
+
+
+def test_keyval_free_deferred_while_attached():
+    """free_keyval while values are attached must defer: later dup
+    still runs the copy callback; final delete runs the delete
+    callback; the entry disappears only when the last value is gone."""
+    events = []
+
+    class Obj:
+        def __init__(self):
+            self.attrs = {}
+
+    kv = attrs.create_keyval(
+        copy_fn=lambda o, k, extra, v: v + 1,
+        delete_fn=lambda o, k, v, extra: events.append(("del", v)))
+    a = Obj()
+    attrs.set_attr(a, kv, 10)
+    attrs.free_keyval(kv)          # deferred: still attached to a
+    b = Obj()
+    attrs.copy_all(a, b)           # copy callback must still run
+    assert b.attrs[kv] == 11
+    # attaching NEW values through a freed keyval is erroneous
+    with pytest.raises(ValueError):
+        attrs.set_attr(Obj(), kv, 1)
+    attrs.delete_all(a)
+    attrs.delete_all(b)
+    assert ("del", 10) in events and ("del", 11) in events
+    # now fully released: the keyval is gone
+    with pytest.raises(ValueError):
+        attrs.set_attr(Obj(), kv, 1)
+
+
+def test_keyval_free_unattached_is_immediate():
+    class Obj:
+        def __init__(self):
+            self.attrs = {}
+
+    kv = attrs.create_keyval()
+    attrs.free_keyval(kv)
+    with pytest.raises(ValueError):
+        attrs.set_attr(Obj(), kv, 1)

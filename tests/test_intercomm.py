@@ -160,3 +160,31 @@ def test_spawn_loop_under_mpirun():
     r = _mpirun(2, os.path.join(REPO, "tests", "_loop_spawn_prog.py"))
     assert r.returncode == 0, r.stderr.decode()
     assert "loop-spawn done 3 rounds" in r.stdout.decode()
+
+
+def test_icreate_wire_tag_block_isolated():
+    """r3 advisor regression: the intercomm_create wire tag must live
+    in a dedicated negative block — never colliding with the small
+    internal tags, create_group's [-400,-1399], nbc's <=-2000, or
+    non-negative user tag space, for ANY user tag."""
+    from ompi_tpu.comm.intercomm import _icreate_wire_tag
+    for tag in (0, 5, 7, 8, 17, 25, 26, 400, 999, 2**20):
+        wt = _icreate_wire_tag(tag)
+        assert -1999 <= wt <= -1500
+
+
+def test_create_with_colliding_user_tags():
+    """User tags that previously collided with internal protocol tags
+    (5->TAG_GATHER, 7->TAG_SPLIT, 8->TAG_CID) must work."""
+    def fn(comm):
+        low = comm.rank < 2
+        local = comm.split(0 if low else 1)
+        for tag in (5, 7, 8, 30):
+            inter = intercomm_create(local, 0, comm,
+                                     2 if low else 0, tag=tag)
+            assert inter.remote_size == comm.size - local.size
+            inter.free()
+        local.free()
+        return True
+
+    assert run_ranks(4, fn) == [True] * 4
