@@ -1,0 +1,292 @@
+"""Checkpoint/restart: the C/R stack re-designed for the TPU-host
+execution model.
+
+Reference architecture this collapses (SURVEY §5 checkpoint row):
+  * opal/mca/crs  — single-process snapshot engines.  The `self`
+    component (application-assisted callbacks, ref:
+    opal/mca/crs/crs.h) is the model here: the app hands us its
+    state; transparent process-image dumps (BLCR/CRIU) are replaced
+    by device-array capture, which a process image could never carry
+    anyway (HBM is not in the address space).
+  * ompi/mca/crcp/bkmrk — in-flight message quiesce by bookmark
+    exchange (ref: crcp_bkmrk_pml.c): per-peer sent/arrived envelope
+    counters drained until they match globally; buffered eager
+    messages ride the snapshot.
+  * orte/mca/snapc/full — distributed coordination (ref:
+    snapc_full_global.c): here a fence + rank-0 "complete" marker
+    make the snapshot atomic — a sequence directory missing meta.json
+    is ignored at restart.
+  * orte/mca/sstore — image storage layout: sequence directories
+    ckpt_NNNNNN/ under one store root, latest-complete wins.
+  * orte-checkpoint / orte-restart tools — ompi_tpu.tools.restart
+    relaunches from the store's job.json (written by mpirun
+    --ckpt-dir).
+
+API (collective over COMM_WORLD):
+
+    state = cr.restore(comm)            # None on a fresh start
+    ...
+    cr.checkpoint(comm, state)          # store dir from mpirun
+
+mpirun --ckpt-dir DIR exports the store; mpirun --restart DIR (or
+``python -m ompi_tpu.tools.restart DIR``) relaunches into it.
+Device arrays anywhere in the payload are captured to host and
+restored onto each rank's device; a shmem context's symmetric heap
+can be snapshotted via the ``shmem_ctx`` argument.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import shutil
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+ENV_DIR = "TPUMPI_CKPT_DIR"
+ENV_RESTART = "TPUMPI_RESTART"
+
+from ompi_tpu.mca.params import registry as _registry  # noqa: E402
+
+_quiesce_timeout_var = _registry.register(
+    "cr", "base", "quiesce_timeout", 60.0, float,
+    help="Seconds the checkpoint quiesce may stall without counter "
+         "progress before raising (bounds a hang on a lost peer)")
+
+
+
+# ---------------------------------------------------------------------
+# payload encoding: device arrays <-> host
+# ---------------------------------------------------------------------
+
+class _JaxLeaf:
+    """Pickle-stable marker for a captured device array."""
+
+    __slots__ = ("arr",)
+
+    def __init__(self, arr: np.ndarray) -> None:
+        self.arr = arr
+
+
+def _encode(obj):
+    import jax
+
+    if isinstance(obj, jax.Array):
+        return _JaxLeaf(np.asarray(obj))
+    if isinstance(obj, dict):
+        return {k: _encode(v) for k, v in obj.items()}
+    if isinstance(obj, tuple):
+        return tuple(_encode(v) for v in obj)
+    if isinstance(obj, list):
+        return [_encode(v) for v in obj]
+    return obj
+
+
+def _decode(obj, device):
+    import jax
+
+    if isinstance(obj, _JaxLeaf):
+        return (jax.device_put(obj.arr, device) if device is not None
+                else jax.numpy.asarray(obj.arr))
+    if isinstance(obj, dict):
+        return {k: _decode(v, device) for k, v in obj.items()}
+    if isinstance(obj, tuple):
+        return tuple(_decode(v, device) for v in obj)
+    if isinstance(obj, list):
+        return [_decode(v, device) for v in obj]
+    return obj
+
+
+# ---------------------------------------------------------------------
+# sstore analog: sequence directories under one root
+# ---------------------------------------------------------------------
+
+class Store:
+    """ckpt_NNNNNN/ sequence dirs; a dir without meta.json is
+    incomplete and ignored (snapc/full global-coordination analog:
+    rank 0 writes meta only after every rank's file is fenced)."""
+
+    def __init__(self, root: str) -> None:
+        self.root = root
+
+    def _seq_dirs(self) -> List[int]:
+        if not os.path.isdir(self.root):
+            return []
+        out = []
+        for name in os.listdir(self.root):
+            if name.startswith("ckpt_"):
+                try:
+                    out.append(int(name[5:]))
+                except ValueError:
+                    pass
+        return sorted(out)
+
+    def seq_path(self, seq: int) -> str:
+        return os.path.join(self.root, f"ckpt_{seq:06d}")
+
+    def next_seq(self) -> int:
+        dirs = self._seq_dirs()
+        return dirs[-1] + 1 if dirs else 0
+
+    def latest_complete(self) -> Optional[int]:
+        for seq in reversed(self._seq_dirs()):
+            if os.path.exists(os.path.join(self.seq_path(seq),
+                                           "meta.json")):
+                return seq
+        return None
+
+    def write_rank(self, seq: int, rank: int, blob: dict) -> None:
+        d = self.seq_path(seq)
+        os.makedirs(d, exist_ok=True)
+        tmp = os.path.join(d, f".rank_{rank}.tmp")
+        with open(tmp, "wb") as f:
+            pickle.dump(blob, f)
+        os.replace(tmp, os.path.join(d, f"rank_{rank}.ckpt"))
+
+    def read_rank(self, seq: int, rank: int) -> dict:
+        with open(os.path.join(self.seq_path(seq),
+                               f"rank_{rank}.ckpt"), "rb") as f:
+            return pickle.load(f)
+
+    def mark_complete(self, seq: int, meta: dict) -> None:
+        d = self.seq_path(seq)
+        tmp = os.path.join(d, ".meta.tmp")
+        with open(tmp, "w") as f:
+            json.dump(meta, f)
+        os.replace(tmp, os.path.join(d, "meta.json"))
+
+    def read_meta(self, seq: int) -> dict:
+        with open(os.path.join(self.seq_path(seq), "meta.json")) as f:
+            return json.load(f)
+
+    def prune(self, keep: int) -> None:
+        done = [s for s in self._seq_dirs()
+                if os.path.exists(os.path.join(self.seq_path(s),
+                                               "meta.json"))]
+        for seq in done[:-keep] if keep > 0 else []:
+            shutil.rmtree(self.seq_path(seq), ignore_errors=True)
+
+
+# ---------------------------------------------------------------------
+# crcp/bkmrk analog: quiesce the pml
+# ---------------------------------------------------------------------
+
+def quiesce(comm, timeout: Optional[float] = None) -> None:
+    """Drain in-flight user traffic: loop until every pair's
+    sent/arrived envelope counts match globally and no rank holds a
+    partially-transferred send.  Collective over COMM_WORLD — the
+    counters are per GLOBAL rank, so a sub-communicator cannot speak
+    for traffic outside itself.  Bounded: a drain that makes no
+    progress within the timeout raises, naming the mismatched pairs
+    (same discipline as the kv/rendezvous stall guards)."""
+    import time
+
+    if len(comm.group) != comm.state.size:
+        raise ValueError("cr.quiesce must run on COMM_WORLD")
+    if timeout is None:
+        timeout = _quiesce_timeout_var.value
+    pml = comm.state.pml
+    n = comm.size
+    me = np.empty(2 * n + 1, dtype=np.int64)
+    table = np.empty((n, 2 * n + 1), dtype=np.int64)
+    deadline = time.monotonic() + timeout
+    last = None
+    while True:
+        comm.state.progress.progress()
+        for j in range(n):
+            me[j] = pml.cr_sent.get(comm.group[j], 0)
+            me[n + j] = pml.cr_arrived.get(comm.group[j], 0)
+        me[2 * n] = pml.cr_pending_sends()
+        comm.Allgather(me, table)
+        sent = table[:, :n]
+        arrived = table[:, n:2 * n]
+        if not table[:, 2 * n].any() and (sent == arrived.T).all():
+            return
+        snap = table.tobytes()
+        if snap != last:
+            last = snap  # progress: reset the stall clock
+            deadline = time.monotonic() + timeout
+        elif time.monotonic() > deadline:
+            bad = [(i, j, int(sent[i][j]), int(arrived[j][i]))
+                   for i in range(n) for j in range(n)
+                   if sent[i][j] != arrived[j][i]]
+            pend = [i for i in range(n) if table[i, 2 * n]]
+            raise RuntimeError(
+                f"cr.quiesce stalled >{timeout}s without progress: "
+                f"mismatched (sender, receiver, sent, arrived) = "
+                f"{bad[:8]}; ranks with partial sends: {pend} "
+                f"(tune cr_base_quiesce_timeout)")
+
+
+# ---------------------------------------------------------------------
+# the collective checkpoint / restore API
+# ---------------------------------------------------------------------
+
+def _store_for(root: Optional[str]) -> Store:
+    root = root or os.environ.get(ENV_DIR)
+    if not root:
+        raise RuntimeError(
+            "no checkpoint store: pass store_dir= or launch with "
+            "mpirun --ckpt-dir DIR")
+    return Store(root)
+
+
+def checkpoint(comm, payload: Any, store_dir: Optional[str] = None,
+               shmem_ctx=None, keep: int = 0) -> int:
+    """Collective snapshot; returns the sequence number.  ``keep``
+    prunes to the newest N complete snapshots (0 = keep all)."""
+    store = _store_for(store_dir)
+    quiesce(comm)
+    msgs = comm.state.pml.cr_capture()
+    blob = {
+        "payload": _encode(payload),
+        "pml_msgs": msgs,
+        "rank": comm.rank,
+    }
+    if shmem_ctx is not None:
+        blob["shmem_heap"] = shmem_ctx.heap.copy()
+        blob["shmem_holes"] = list(shmem_ctx._holes)
+
+    seq = np.array([store.next_seq() if comm.rank == 0 else 0],
+                   dtype=np.int64)
+    comm.Bcast(seq, root=0)
+    store.write_rank(int(seq[0]), comm.rank, blob)
+    comm.Barrier()  # every rank's file durably in place...
+    if comm.rank == 0:
+        store.mark_complete(int(seq[0]), {
+            "nprocs": comm.size,
+            "seq": int(seq[0]),
+            "jobid": os.environ.get("TPUMPI_JOBID", ""),
+        })
+        if keep:
+            store.prune(keep)
+    comm.Barrier()  # ...before anyone trusts the snapshot exists
+    return int(seq[0])
+
+
+def restore(comm, store_dir: Optional[str] = None, shmem_ctx=None
+            ) -> Optional[Any]:
+    """Returns the latest complete snapshot's payload, or None when
+    starting fresh (no --restart, or an empty store)."""
+    root = store_dir or os.environ.get(ENV_DIR)
+    if not root or not os.environ.get(ENV_RESTART):
+        return None
+    store = Store(root)
+    seq = store.latest_complete()
+    if seq is None:
+        return None
+    meta = store.read_meta(seq)
+    if meta["nprocs"] != comm.size:
+        raise RuntimeError(
+            f"restart topology mismatch: snapshot has "
+            f"{meta['nprocs']} ranks, job has {comm.size}")
+    blob = store.read_rank(seq, comm.rank)
+    comm.state.pml.cr_restore(blob["pml_msgs"])
+    if shmem_ctx is not None and "shmem_heap" in blob:
+        shmem_ctx.heap[:] = blob["shmem_heap"]
+        shmem_ctx._holes = [tuple(h) for h in blob["shmem_holes"]]
+    out = _decode(blob["payload"], comm.state.device)
+    comm.Barrier()
+    return out

@@ -130,6 +130,12 @@ class PmlOb1:
             "pml", "ob1", f"bytes_sent_r{state.rank}")
         self.pvar_recv = registry.register_pvar(
             "pml", "ob1", f"bytes_recv_r{state.rank}")
+        # checkpoint/restart bookmark counters (crcp/bkmrk analog,
+        # ref: ompi/mca/crcp/bkmrk/crcp_bkmrk_pml.c): user-tag message
+        # envelopes sent to / arrived from each GLOBAL rank.  Quiesce
+        # drains until every pair's counts match (see ompi_tpu/cr).
+        self.cr_sent: Dict[int, int] = {}
+        self.cr_arrived: Dict[int, int] = {}
         state.progress.register(self.progress)
 
     # -- wiring ----------------------------------------------------------
@@ -166,20 +172,24 @@ class PmlOb1:
         req = SendRequest(self.state.progress, conv, req_id, gdst)
         req.status.count = conv.packed_size
         self.pvar_sent.add(conv.packed_size)
+        if tag >= 0:
+            self.cr_sent[gdst] = self.cr_sent.get(gdst, 0) + 1
 
+        gsrc = self.state.rank  # global sender id (C/R bookkeeping)
         if conv.packed_size <= btl.eager_limit and mode != MODE_SYNC:
             payload = conv.pack()
-            btl.send(gdst, (MATCH, cid, src, tag, seq, payload))
+            btl.send(gdst, (MATCH, cid, src, tag, seq, gsrc, payload))
             req._complete()
         elif conv.packed_size <= btl.eager_limit:  # sync eager
             payload = conv.pack()
             self._send_reqs[req_id] = req
-            btl.send(gdst, (MATCH_SYNC, cid, src, tag, seq, req_id, payload))
+            btl.send(gdst, (MATCH_SYNC, cid, src, tag, seq, gsrc,
+                            req_id, payload))
         else:
             head = conv.pack(btl.eager_limit)
             self._send_reqs[req_id] = req
-            btl.send(gdst, (RNDV, cid, src, tag, seq, conv.packed_size,
-                            req_id, head))
+            btl.send(gdst, (RNDV, cid, src, tag, seq, gsrc,
+                            conv.packed_size, req_id, head))
         return req
 
     def send(self, buf, count, datatype, dst, tag, comm,
@@ -349,17 +359,22 @@ class PmlOb1:
         kind = frag[0]
         if kind in (MATCH, MATCH_SYNC, RNDV):
             if kind == MATCH:
-                _, cid, src, tag, seq, payload = frag
+                _, cid, src, tag, seq, gsrc, payload = frag
                 msg = UnexpectedMsg(kind, cid, src, tag, seq,
                                     len(payload), None, payload)
             elif kind == MATCH_SYNC:
-                _, cid, src, tag, seq, sreq_id, payload = frag
+                _, cid, src, tag, seq, gsrc, sreq_id, payload = frag
                 msg = UnexpectedMsg(kind, cid, src, tag, seq,
                                     len(payload), sreq_id, payload)
             else:
-                _, cid, src, tag, seq, total, sreq_id, payload = frag
+                _, cid, src, tag, seq, gsrc, total, sreq_id, payload = frag
                 msg = UnexpectedMsg(kind, cid, src, tag, seq, total,
                                     sreq_id, payload)
+            # the envelope carries the sender's GLOBAL rank so C/R
+            # bookkeeping never depends on resolving the cid locally
+            # (the comm may be freed, reserved-None, or not yet built)
+            if tag >= 0:
+                self.cr_arrived[gsrc] = self.cr_arrived.get(gsrc, 0) + 1
             self._dispatch_arrival(msg)
         elif kind == ACK:
             _, sreq_id, rreq_id = frag
@@ -411,6 +426,55 @@ class PmlOb1:
         if req.received >= req.incoming:
             req.status.count = min(req.incoming, capacity)
             self._finish_recv(req)
+
+    # -- checkpoint/restart hooks (ompi_tpu/cr; crcp/bkmrk analog) -------
+    def cr_pending_sends(self) -> int:
+        """Send requests whose payload is not fully on the wire yet
+        (rendezvous streams, sync-eager awaiting ACK)."""
+        return len(self._send_reqs)
+
+    def cr_capture(self) -> List[tuple]:
+        """Snapshot the in-flight state a quiesced rank may legally
+        hold: buffered-eager user messages in the unexpected queues.
+        Everything else must be drained — a stuck rendezvous or
+        out-of-order hold at quiesce is a protocol violation worth a
+        loud failure, not a silent bad snapshot."""
+        if self._send_reqs:
+            raise RuntimeError(
+                "cr_capture with pending send requests (quiesce bug)")
+        if any(self._cant_match.values()):
+            raise RuntimeError(
+                "cr_capture with out-of-order frags held (messages "
+                "still in flight)")
+        msgs = []
+        for cid, lst in self._unexpected.items():
+            for m in sorted(lst, key=lambda u: u.arrival):
+                if m.tag < 0:
+                    # post-quiesce traffic from the checkpoint's own
+                    # machinery (a faster rank's seq-Bcast fan-out can
+                    # land here before we capture): leave it in place —
+                    # it is consumed by OUR upcoming phase, never
+                    # snapshotted
+                    continue
+                if m.kind != MATCH:
+                    raise RuntimeError(
+                        f"cr_capture: {m.kind} message unmatched at "
+                        "quiesce (sender's request could not have "
+                        "completed — user requests must complete "
+                        "before checkpoint)")
+                msgs.append((cid, m.src, m.tag, m.total,
+                             bytes(m.payload)))
+        return msgs
+
+    def cr_restore(self, msgs: List[tuple]) -> None:
+        """Reinject snapshot-carried eager messages as fresh arrivals.
+        Sequence numbers restart from zero on both sides after a
+        restart, so reinjection bypasses sequencing (these envelopes
+        already consumed their pre-checkpoint sequence slots)."""
+        for cid, src, tag, total, payload in msgs:
+            m = UnexpectedMsg(MATCH, cid, src, tag, 0, total, None,
+                              payload)
+            self._unexpected.setdefault(cid, []).append(m)
 
     # -- cancel ----------------------------------------------------------
     def cancel_recv(self, req: RecvRequest) -> bool:

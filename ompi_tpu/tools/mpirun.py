@@ -96,6 +96,7 @@ def run_multinode(opts, nodes, rpp: int, hybrid: bool) -> int:
         node_env[n.node_id] = env
 
     job_env = {
+        **getattr(opts, "ckpt_env", {}),
         "TPUMPI_SIZE": str(opts.np),
         "TPUMPI_KV_ADDR": server.addr,
         "TPUMPI_JOBID": f"job-{os.getpid()}",
@@ -177,12 +178,44 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "'python -m ompi_tpu.tools.localssh')")
     ap.add_argument("--tree-radix", type=int, default=32,
                     help="PLM launch-tree fan-out per daemon")
+    ap.add_argument("--ckpt-dir", default=None, dest="ckpt_dir",
+                    help="Checkpoint store root exported to ranks as "
+                         "TPUMPI_CKPT_DIR; mpirun records job.json "
+                         "there for ompi_tpu.tools.restart")
+    ap.add_argument("--restart", default=None, metavar="DIR",
+                    help="Restart from the latest complete snapshot "
+                         "in DIR (sets TPUMPI_RESTART; the app picks "
+                         "it up via cr.restore)")
     ap.add_argument("--hnp-ip", default=None,
                     help="IP remote nodes should dial for the HNP "
                          "control + KV servers (default: auto-detect)")
     ap.add_argument("prog")
     ap.add_argument("args", nargs=argparse.REMAINDER)
     opts = ap.parse_args(argv)
+    # checkpoint/restart store plumbing (cr stack; orte-checkpoint /
+    # orte-restart tool analogs live in ompi_tpu.tools.restart)
+    ckpt_env = {}
+    ckpt_root = opts.restart or opts.ckpt_dir
+    if ckpt_root:
+        ckpt_root = os.path.abspath(ckpt_root)
+        ckpt_env["TPUMPI_CKPT_DIR"] = ckpt_root
+        if opts.restart:
+            # restart must NEVER rewrite job.json: the original launch
+            # record is what ompi_tpu.tools.restart replays
+            ckpt_env["TPUMPI_RESTART"] = "1"
+        else:
+            try:
+                os.makedirs(ckpt_root, exist_ok=True)
+                with open(os.path.join(ckpt_root, "job.json"),
+                          "w") as jf:
+                    import json as _json
+                    _json.dump({"np": opts.np, "prog": opts.prog,
+                                "args": opts.args, "mca": opts.mca,
+                                "rpp": opts.rpp}, jf)
+            except OSError as e:
+                sys.stderr.write(
+                    f"mpirun: cannot write job.json: {e}\n")
+    opts.ckpt_env = ckpt_env
     rpp = opts.np if opts.rpp == "all" else opts.rpp
     # 'all' always means hybrid (even -np 1: device assignment and the
     # app shell still apply); an explicit integer 1 means one process
@@ -227,6 +260,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     env_base["PYTHONPATH"] = pkg_root + (
         os.pathsep + env_base["PYTHONPATH"]
         if env_base.get("PYTHONPATH") else "")
+    env_base.update(ckpt_env)
     env_base.update({
         "TPUMPI_SIZE": str(opts.np),
         "TPUMPI_LOCAL_SIZE": str(opts.np),  # single-host launch

@@ -1,0 +1,51 @@
+"""orte-restart analog: relaunch a checkpointed job from its store.
+
+``mpirun --ckpt-dir DIR`` records job.json (np/prog/args/mca) in the
+store; this tool re-execs mpirun with ``--restart DIR`` so the app's
+``cr.restore(comm)`` resumes from the latest complete snapshot
+(ref: orte/tools/orte-restart/orte-restart.c — reads the snapshot
+handle's metadata and builds the orterun command line).
+
+    python -m ompi_tpu.tools.restart DIR [extra mpirun args...]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import List, Optional
+
+
+def build_cmd(store_dir: str, extra: List[str]) -> List[str]:
+    with open(os.path.join(store_dir, "job.json")) as f:
+        job = json.load(f)
+    cmd = [sys.executable, "-m", "ompi_tpu.tools.mpirun",
+           "-np", str(job["np"]), "--restart", store_dir]
+    for k, v in job.get("mca") or []:
+        cmd += ["--mca", k, v]
+    rpp = job.get("rpp", 1)
+    if rpp != 1:
+        cmd += ["--ranks-per-proc", str(rpp)]
+    cmd += extra
+    cmd += [job["prog"]] + list(job.get("args") or [])
+    return cmd
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv or argv[0] in ("-h", "--help"):
+        sys.stderr.write(__doc__)
+        return 2
+    store_dir = os.path.abspath(argv[0])
+    if not os.path.exists(os.path.join(store_dir, "job.json")):
+        sys.stderr.write(
+            f"restart: no job.json in {store_dir} (was the job "
+            "launched with mpirun --ckpt-dir?)\n")
+        return 2
+    import subprocess
+    return subprocess.call(build_cmd(store_dir, argv[1:]))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

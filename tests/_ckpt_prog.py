@@ -1,0 +1,35 @@
+"""Iterating job with per-step checkpoints (run under mpirun by
+test_cr.py).  CKPT_CRASH_AT=k makes rank 2 die hard right after the
+step-k checkpoint; a restart resumes from that snapshot and must
+produce the same final answer as an uninterrupted run."""
+import os
+
+import numpy as np
+
+import ompi_tpu
+from ompi_tpu import cr
+from ompi_tpu.op import op as mpi_op
+
+STEPS = 8
+crash_at = int(os.environ.get("CKPT_CRASH_AT", "-1"))
+
+comm = ompi_tpu.init()
+state = cr.restore(comm)
+resumed = state is not None
+if state is None:
+    state = {"step": 0, "acc": np.zeros(4)}
+
+while state["step"] < STEPS:
+    contrib = np.full(4, float(comm.rank + 1) * (state["step"] + 1))
+    r = np.empty(4)
+    comm.Allreduce(contrib, r, mpi_op.SUM)
+    state["acc"] = state["acc"] + r
+    state["step"] += 1
+    cr.checkpoint(comm, state, keep=2)
+    if state["step"] == crash_at and comm.rank == 2:
+        os._exit(17)  # hard mid-job death (no finalize, no cleanup)
+
+if comm.rank == 0:
+    print(f"final step={state['step']} resumed={resumed} "
+          f"acc={state['acc'].tolist()}", flush=True)
+ompi_tpu.finalize()

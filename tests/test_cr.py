@@ -1,0 +1,188 @@
+"""Checkpoint/restart tests (ref: the reference C/R stack — crs +
+crcp/bkmrk + snapc/full + sstore + orte-checkpoint/restart; SURVEY §5
+checkpoint row).  End-to-end: kill a job mid-iteration, restart from
+the store, identical results."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from ompi_tpu import cr
+from ompi_tpu.op import op as mpi_op
+from ompi_tpu.testing import run_ranks
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---- sstore analog: layout, atomicity ------------------------------
+
+def test_store_latest_complete_and_pruning(tmp_path):
+    st = cr.Store(str(tmp_path))
+    assert st.latest_complete() is None
+    for seq in range(3):
+        st.write_rank(seq, 0, {"payload": seq})
+        st.mark_complete(seq, {"nprocs": 1, "seq": seq})
+    # an incomplete newest dir (no meta.json) must be ignored
+    st.write_rank(3, 0, {"payload": 3})
+    assert st.latest_complete() == 2
+    assert st.read_rank(2, 0)["payload"] == 2
+    st.prune(keep=1)
+    assert st.latest_complete() == 2
+    assert not os.path.exists(st.seq_path(0))
+    assert not os.path.exists(st.seq_path(1))
+    # the incomplete dir is never pruned (it may be mid-write)
+    assert os.path.exists(st.seq_path(3))
+
+
+def test_store_rank_write_is_atomic(tmp_path):
+    st = cr.Store(str(tmp_path))
+    st.write_rank(0, 0, {"payload": 1})
+    # no temp droppings
+    assert all(not f.startswith(".")
+               for f in os.listdir(st.seq_path(0)))
+
+
+# ---- quiesce + snapshot-carried messages ---------------------------
+
+def test_quiesce_carries_unreceived_eager(tmp_path):
+    d = str(tmp_path)
+
+    def fn(comm):
+        if comm.rank == 0:
+            comm.Send(np.array([42.0]), dest=1, tag=5)
+        seq = cr.checkpoint(comm, {"step": 7}, store_dir=d)
+        assert seq == 0
+        os.environ[cr.ENV_RESTART] = "1"
+        try:
+            got = cr.restore(comm, store_dir=d)
+        finally:
+            os.environ.pop(cr.ENV_RESTART, None)
+        assert got == {"step": 7}
+        if comm.rank == 1:
+            r = np.empty(1)
+            comm.Recv(r, source=0, tag=5)
+            assert r[0] == 42.0
+        comm.Barrier()
+        return True
+
+    assert run_ranks(4, fn) == [True] * 4
+
+
+def test_device_array_payload_roundtrip(tmp_path):
+    d = str(tmp_path)
+
+    def fn(comm):
+        import jax.numpy as jnp
+        x = jnp.arange(8.0) * (comm.rank + 1)
+        cr.checkpoint(comm, {"x": x, "nested": [x, (1, x)]},
+                      store_dir=d)
+        os.environ[cr.ENV_RESTART] = "1"
+        try:
+            got = cr.restore(comm, store_dir=d)
+        finally:
+            os.environ.pop(cr.ENV_RESTART, None)
+        import jax
+        assert isinstance(got["x"], jax.Array)
+        assert np.allclose(np.asarray(got["x"]),
+                           np.arange(8.0) * (comm.rank + 1))
+        assert np.allclose(np.asarray(got["nested"][1][1]),
+                           np.arange(8.0) * (comm.rank + 1))
+        return True
+
+    assert run_ranks(2, fn, devices=True) == [True, True]
+
+
+def test_restore_topology_mismatch_raises(tmp_path):
+    d = str(tmp_path)
+
+    def write(comm):
+        cr.checkpoint(comm, {"a": 1}, store_dir=d)
+        return True
+
+    assert run_ranks(2, write) == [True, True]
+    # doctor the metadata to claim a different world size
+    st = cr.Store(d)
+    seq = st.latest_complete()
+    meta = st.read_meta(seq)
+    meta["nprocs"] = 5
+    st.mark_complete(seq, meta)
+
+    def read(comm):
+        os.environ[cr.ENV_RESTART] = "1"
+        try:
+            with pytest.raises(RuntimeError, match="topology mismatch"):
+                cr.restore(comm, store_dir=d)
+        finally:
+            os.environ.pop(cr.ENV_RESTART, None)
+        return True
+
+    assert run_ranks(2, read) == [True, True]
+
+
+def test_shmem_heap_snapshot(tmp_path):
+    d = str(tmp_path)
+
+    def fn(comm):
+        from ompi_tpu.shmem import ShmemCtx
+        ctx = ShmemCtx(comm, heap_size=4096)
+        arr = ctx.malloc((8,), np.float64)
+        arr.local[:] = comm.rank + 0.5
+        ctx.barrier_all()
+        cr.checkpoint(comm, None, store_dir=d, shmem_ctx=ctx)
+        arr.local[:] = -1.0  # clobber, then restore
+        os.environ[cr.ENV_RESTART] = "1"
+        try:
+            cr.restore(comm, store_dir=d, shmem_ctx=ctx)
+        finally:
+            os.environ.pop(cr.ENV_RESTART, None)
+        assert np.all(arr.local == comm.rank + 0.5)
+        ctx.finalize()
+        return True
+
+    assert run_ranks(2, fn) == [True, True]
+
+
+# ---- end-to-end: crash mid-job, restart, identical results ---------
+
+def _run(cmd, env=None, timeout=240):
+    full_env = dict(os.environ)
+    full_env["PYTHONPATH"] = REPO + os.pathsep + \
+        full_env.get("PYTHONPATH", "")
+    if env:
+        full_env.update(env)
+    return subprocess.run(cmd, capture_output=True, env=full_env,
+                          timeout=timeout)
+
+
+def test_checkpoint_kill_restart_under_mpirun(tmp_path):
+    prog = os.path.join(REPO, "tests", "_ckpt_prog.py")
+    store = str(tmp_path / "store")
+    # 1) uninterrupted reference run (its own store)
+    ref = _run([sys.executable, "-m", "ompi_tpu.tools.mpirun",
+                "-np", "4", "--ckpt-dir", str(tmp_path / "ref"), prog])
+    assert ref.returncode == 0, ref.stderr.decode()
+    ref_line = [ln for ln in ref.stdout.decode().splitlines()
+                if ln.startswith("final ")][0]
+
+    # 2) crashing run: rank 2 dies after the step-5 checkpoint
+    r1 = _run([sys.executable, "-m", "ompi_tpu.tools.mpirun",
+               "-np", "4", "--ckpt-dir", store, prog],
+              env={"CKPT_CRASH_AT": "5"})
+    assert r1.returncode != 0
+    assert cr.Store(store).latest_complete() is not None
+
+    # 3) restart via the orte-restart analog: resumes and completes
+    r2 = _run([sys.executable, "-m", "ompi_tpu.tools.restart", store])
+    assert r2.returncode == 0, r2.stderr.decode()
+    line = [ln for ln in r2.stdout.decode().splitlines()
+            if ln.startswith("final ")][0]
+    assert "resumed=True" in line
+    # identical final state to the uninterrupted run
+    assert line.replace("resumed=True", "resumed=False") == ref_line
+    # job.json recorded the launch for the restart tool
+    job = json.load(open(os.path.join(store, "job.json")))
+    assert job["np"] == 4
