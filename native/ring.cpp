@@ -70,6 +70,29 @@ int tpumpi_ring_push(uint8_t* base, uint64_t cap, const uint8_t* frame,
     return 1;
 }
 
+// Two-part push (frag header + raw payload) so the producer never
+// concatenates them host-side.  Returns 1 on success, 0 on no space.
+int tpumpi_ring_push2(uint8_t* base, uint64_t cap, const uint8_t* b1,
+                      uint64_t l1, const uint8_t* b2, uint64_t l2) {
+    auto* head = head_of(base);
+    auto* tail = tail_of(base);
+    uint64_t h = head->load(std::memory_order_relaxed);
+    uint64_t t = tail->load(std::memory_order_acquire);
+    uint64_t len = l1 + l2;
+    uint64_t need = 4 + len;
+    if (need > cap - (h - t)) return 0;
+    uint8_t hdr[4] = {static_cast<uint8_t>(len >> 24),
+                      static_cast<uint8_t>(len >> 16),
+                      static_cast<uint8_t>(len >> 8),
+                      static_cast<uint8_t>(len)};
+    uint8_t* data = base + kHdr;
+    copy_in(data, cap, h, hdr, 4);
+    copy_in(data, cap, h + 4, b1, l1);
+    if (l2) copy_in(data, cap, h + 4 + l1, b2, l2);
+    head->store(h + need, std::memory_order_release);
+    return 1;
+}
+
 // Returns the length of the next frame, or -1 when the ring is empty.
 // Does not consume.
 int64_t tpumpi_ring_peek(uint8_t* base, uint64_t cap) {

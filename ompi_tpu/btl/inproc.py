@@ -34,6 +34,9 @@ class InprocModule(BTLModule):
         self.world = state.rte.world  # InprocWorld
         self.eager_limit = _eager_var.value
         self.max_send_size = 4 * 1024 * 1024
+        # hybrid worlds park in the idle selector (shm/tcp fds); a
+        # self-pipe lets thread-peer sends wake them from there too
+        state.progress.enable_thread_wakeup()
 
     def reaches(self, peer: int) -> bool:
         # HybridWorld: only the rank-threads of THIS process; remote

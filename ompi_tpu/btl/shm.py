@@ -15,15 +15,16 @@ is data-before-head on the producer and data-read-before-tail on the
 consumer — x86 TSO plus numpy's single-store index updates satisfy
 it (the C++ native ring in native/ is the hardened version).
 
-Frames carry pickled frag tuples; payload bytes dominate and pickle
-passes them through without copies on protocol 5.
+Frames carry wire-codec frags (ompi_tpu/btl/wire): a fixed binary
+header + raw payload written into the ring as two parts, so payload
+bytes are copied exactly once producer-side (into the ring) and once
+consumer-side (out of it) — no serialization copies.
 """
 
 from __future__ import annotations
 
 import mmap
 import os
-import pickle
 import struct
 from collections import deque
 from typing import Dict, List, Optional
@@ -31,6 +32,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ompi_tpu.mca.params import registry
+from . import wire
 from .base import BTLComponent, BTLModule, btl_framework
 
 _ring_var = registry.register(
@@ -89,6 +91,13 @@ class Ring:
         return bool(self._lib.tpumpi_ring_push(
             self._cbase, self.cap, buf, len(frame)))
 
+    def push2_native(self, hdr: bytes, payload: bytes) -> bool:
+        ct = self._ctypes
+        b1 = ct.cast(ct.c_char_p(hdr), self._u8p)
+        b2 = ct.cast(ct.c_char_p(payload), self._u8p)
+        return bool(self._lib.tpumpi_ring_push2(
+            self._cbase, self.cap, b1, len(hdr), b2, len(payload)))
+
     def pop_native(self) -> Optional[bytes]:
         ln = self._lib.tpumpi_ring_peek(self._cbase, self.cap)
         if ln < 0:
@@ -113,26 +122,44 @@ class Ring:
         return self.cap - (self.head - self.tail)
 
     def push(self, frame: bytes) -> bool:
-        if 4 + len(frame) > self.cap:
+        return self.push_parts(frame, b"")
+
+    def push_parts(self, hdr: bytes, payload) -> bool:
+        """Write one frame given as header + payload parts, copying
+        each part straight into the ring (no concat)."""
+        ln = len(hdr) + len(payload)
+        if 4 + ln > self.cap:
             raise ValueError(
-                f"frame of {len(frame)} bytes can never fit the "
+                f"frame of {ln} bytes can never fit the "
                 f"{self.cap}-byte shm ring; lower btl_shm_max_send_size "
                 "or raise btl_shm_ring_size")
         if self._lib is not None:
-            return self.push_native(frame)
-        need = 4 + len(frame)
+            if not payload:
+                return self.push_native(hdr)
+            if not isinstance(payload, bytes):
+                payload = memoryview(payload).tobytes()
+            return self.push2_native(hdr, payload)
+        need = 4 + ln
         if need > self.free_space():
             return False
-        pos = self.head % self.cap
-        buf = struct.pack(">I", len(frame)) + frame
-        n = len(buf)
-        first = min(n, self.cap - pos)
-        self.data[pos:pos + first] = np.frombuffer(buf[:first], np.uint8)
-        if first < n:
-            self.data[:n - first] = np.frombuffer(buf[first:], np.uint8)
+        pos = self.head
+        self._write(pos, struct.pack(">I", ln))
+        self._write(pos + 4, hdr)
+        if len(payload):
+            self._write(pos + 4 + len(hdr), payload)
         # data written before the head store (x86 TSO keeps order)
-        self.idx[0] = self.head + n
+        self.idx[0] = pos + need
         return True
+
+    def _write(self, abspos: int, buf) -> None:
+        pos = abspos % self.cap
+        n = len(buf)
+        src = np.frombuffer(buf, np.uint8) if isinstance(buf, bytes) \
+            else np.frombuffer(memoryview(buf).cast("B"), np.uint8)
+        first = min(n, self.cap - pos)
+        self.data[pos:pos + first] = src[:first]
+        if first < n:
+            self.data[:n - first] = src[first:]
 
     def pop(self) -> Optional[bytes]:
         if self._lib is not None:
@@ -176,8 +203,73 @@ class ShmModule(BTLModule):
         for peer in range(state.size):
             if peer != self.rank:
                 Ring(self._path(self.rank, peer), create=True)
+        # Doorbell FIFO: senders write one byte after pushing so a
+        # rank parked in the idle selector wakes via the kernel
+        # instead of burning scheduler quanta polling (the fd-wakeup
+        # analog of vader's "fast box + pending queue" signalling).
+        self._db_rfd = -1
+        self._db_wfds: Dict[int, int] = {}
+        db = self._db_path(self.rank)
+        try:
+            if not os.path.exists(db):
+                os.mkfifo(db, 0o600)
+            self._db_rfd = os.open(db, os.O_RDONLY | os.O_NONBLOCK)
+            state.progress.register_idle_fd(
+                self._db_rfd, drain=self._drain_doorbell)
+        except OSError:
+            self._db_rfd = -1  # fall back to pure polling
+        # Parked flags: one shared byte per rank.  A sender only pays
+        # the doorbell write (and its wake-preemption) when the target
+        # is actually parked in select(); while it polls, a flag load
+        # suffices (futex-style: set flag -> one more sweep -> sleep).
+        self._parked = None
+        try:
+            pf = os.path.join(self.session, "shm_parked.flags")
+            fd = os.open(pf, os.O_CREAT | os.O_RDWR, 0o600)
+            if os.fstat(fd).st_size < state.size:
+                os.ftruncate(fd, state.size)
+            self._parked_mm = mmap.mmap(fd, state.size)
+            os.close(fd)
+            self._parked = self._parked_mm
+            state.progress.register_park_hooks(
+                self._park_set, self._park_clear)
+        except OSError:
+            self._parked = None
         state.progress.register(self.progress)
         state.progress.poll_mode = True
+
+    def _db_path(self, rank: int) -> str:
+        return os.path.join(self.session, f"shm_db_{rank}.fifo")
+
+    def _drain_doorbell(self) -> None:
+        try:
+            while os.read(self._db_rfd, 4096):
+                pass
+        except (BlockingIOError, OSError):
+            pass
+
+    def _park_set(self) -> None:
+        self._parked[self.rank] = 1
+
+    def _park_clear(self) -> None:
+        self._parked[self.rank] = 0
+
+    def _ring_doorbell(self, peer: int) -> None:
+        if self._parked is not None and peer < len(self._parked) \
+                and not self._parked[peer]:
+            return  # peer is awake and polling; no syscall needed
+        fd = self._db_wfds.get(peer)
+        if fd is None:
+            try:
+                fd = os.open(self._db_path(peer),
+                             os.O_WRONLY | os.O_NONBLOCK)
+            except OSError:
+                return  # peer not parked yet (no reader) or no fifo
+            self._db_wfds[peer] = fd
+        try:
+            os.write(fd, b"\x01")
+        except (BlockingIOError, OSError):
+            pass  # pipe full = peer has wakeups pending already
 
     def _path(self, src: int, dst: int) -> str:
         return os.path.join(self.session, f"shm_ring_{src}_{dst}.buf")
@@ -220,21 +312,29 @@ class ShmModule(BTLModule):
                     Ring(path, create=True)
 
     def send(self, peer: int, frag) -> None:
-        frame = pickle.dumps(frag, protocol=pickle.HIGHEST_PROTOCOL)
+        hdr, payload = wire.encode(frag)
+        if payload is None:
+            payload = b""
         q = self._pending.setdefault(peer, deque())
-        if not q and self._tx_ring(peer).push(frame):
+        if not q and self._tx_ring(peer).push_parts(hdr, payload):
+            self._ring_doorbell(peer)
             return
-        q.append(frame)
+        q.append((hdr, payload))
 
     def progress(self) -> int:
         events = 0
         # drain pending sends (backpressure released by the consumer)
         for peer, q in self._pending.items():
             ring = self._tx_ring(peer)
-            while q and ring.push(q[0]):
+            pushed = False
+            while q and ring.push_parts(*q[0]):
                 q.popleft()
+                pushed = True
                 events += 1
+            if pushed:
+                self._ring_doorbell(peer)
         # poll every attached inbound ring
+        inbox = self.state.pml.inbox
         for peer in range(self.state.size):
             if peer == self.rank:
                 continue
@@ -245,11 +345,23 @@ class ShmModule(BTLModule):
                 frame = ring.pop()
                 if frame is None:
                     break
-                self.state.pml.inbox.append(pickle.loads(frame))
+                inbox.append(wire.decode(frame, copy=False))
                 events += 1
         return events
 
     def finalize(self) -> None:
+        if self._db_rfd >= 0:
+            self.state.progress.unregister_idle_fd(self._db_rfd)
+            try:
+                os.close(self._db_rfd)
+                os.unlink(self._db_path(self.rank))
+            except OSError:
+                pass
+        for fd in self._db_wfds.values():
+            try:
+                os.close(fd)
+            except OSError:
+                pass
         for peer in range(self.state.size):
             if peer != self.rank:
                 try:

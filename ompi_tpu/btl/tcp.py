@@ -8,8 +8,11 @@ btl_tcp_component.c:304).  Differences from the reference:
   * one socket per DIRECTION (each rank initiates its own send
     channel, inbound connections are read-only) — removes the
     reference's simultaneous-connect tie-breaking dance entirely;
-  * frames are 4-byte length + pickled frag; payload bytes pass
-    through pickle protocol 5 without extra copies;
+  * frames are 4-byte length + wire-codec frag (ompi_tpu/btl/wire):
+    a fixed binary header followed by the raw payload bytes, gathered
+    onto the socket with vectored ``sendmsg`` so payloads are never
+    serialized or concatenated (the reference likewise sends headers
+    + convertor-packed bytes, ref: btl_tcp_frag.c);
   * nonblocking sends drain a per-endpoint queue from the progress
     engine, so two ranks streaming rendezvous segments at each other
     can never deadlock on full socket buffers.
@@ -18,7 +21,6 @@ btl_tcp_component.c:304).  Differences from the reference:
 from __future__ import annotations
 
 import errno
-import pickle
 import selectors
 import socket
 import struct
@@ -26,6 +28,7 @@ from collections import deque
 from typing import Dict, List, Optional
 
 from ompi_tpu.mca.params import registry
+from . import wire
 from .base import BTLComponent, BTLModule, btl_framework
 
 _eager_var = registry.register(
@@ -77,6 +80,9 @@ class TcpModule(BTLModule):
         state.rte.modex_put("btl_tcp_addr", f"{if_ip}:{port}")
         self._out: Dict[int, _Conn] = {}
         self._in: List[_Conn] = []
+        # inbound sockets double as idle-selector wakeup fds: a rank
+        # parked in idle_wait unblocks the moment bytes arrive
+        state.progress.register_idle_fd(self.listener.fileno())
         state.progress.register(self.progress)
         state.progress.poll_mode = True
 
@@ -97,9 +103,15 @@ class TcpModule(BTLModule):
         return conn
 
     def send(self, peer: int, frag) -> None:
-        frame = pickle.dumps(frag, protocol=pickle.HIGHEST_PROTOCOL)
+        hdr, payload = wire.encode(frag)
+        plen = 0 if payload is None else len(payload)
         conn = self._connect(peer)
-        conn.txq.append(struct.pack(">I", len(frame)) + frame)
+        # one small concat for the length prefix + header; the payload
+        # rides as its own buffer so sendmsg gathers it copy-free
+        conn.txq.append(struct.pack(">I", len(hdr) + plen) + hdr)
+        if plen:
+            conn.txq.append(payload if isinstance(payload, (bytes, memoryview))
+                            else memoryview(payload))
         self._drain(conn)
 
     def _set_wr_interest(self, conn: _Conn) -> None:
@@ -120,21 +132,36 @@ class TcpModule(BTLModule):
 
     def _drain(self, conn: _Conn) -> int:
         sent = 0
-        while conn.txq:
-            buf = conn.txq[0]
+        txq = conn.txq
+        while txq:
+            # gather up to 16 queued buffers into one vectored send
+            bufs = []
+            for i, b in enumerate(txq):
+                if i == 0 and conn.txoff:
+                    b = memoryview(b)[conn.txoff:]
+                bufs.append(b)
+                if len(bufs) >= 16:
+                    break
             try:
-                n = conn.sock.send(buf[conn.txoff:] if conn.txoff else buf)
+                n = conn.sock.sendmsg(bufs)
             except (BlockingIOError, InterruptedError):
                 break
             except OSError:
-                conn.txq.clear()
+                txq.clear()
                 conn.txoff = 0
                 break
-            conn.txoff += n
             sent += n
-            if conn.txoff >= len(buf):
-                conn.txq.popleft()
-                conn.txoff = 0
+            # retire fully-sent buffers; track offset into the first
+            # remaining one
+            n += conn.txoff
+            conn.txoff = 0
+            while txq:
+                ln = len(txq[0])
+                if n < ln:
+                    conn.txoff = n
+                    break
+                n -= ln
+                txq.popleft()
         self._set_wr_interest(conn)
         return sent
 
@@ -156,17 +183,23 @@ class TcpModule(BTLModule):
         # the peer's final frags often arrive with the FIN
         buf = conn.rxbuf
         off = 0
+        view = memoryview(buf)
         while len(buf) - off >= 4:
             (ln,) = struct.unpack_from(">I", buf, off)
             if len(buf) - off - 4 < ln:
                 break
-            frag = pickle.loads(bytes(buf[off + 4:off + 4 + ln]))
+            frag = wire.decode(view[off + 4:off + 4 + ln])
             self.state.pml.inbox.append(frag)
             off += 4 + ln
             events += 1
+        view.release()
         if off:
             del buf[:off]
         if closed:
+            try:
+                self.state.progress.unregister_idle_fd(conn.sock.fileno())
+            except OSError:
+                pass
             try:
                 self.sel.unregister(conn.sock)
             except (KeyError, ValueError):
@@ -191,6 +224,7 @@ class TcpModule(BTLModule):
                 c = _Conn(s)
                 self._in.append(c)
                 self.sel.register(s, selectors.EVENT_READ, ("in", c))
+                self.state.progress.register_idle_fd(s.fileno())
                 events += 1
             elif kind == "in":
                 events += self._pump_rx(conn)

@@ -1,0 +1,100 @@
+"""Binary wire codec for pml frags: header + raw payload, no pickle.
+
+Re-design of the reference's frag wire format (ref:
+opal/mca/btl/tcp/btl_tcp_frag.c — headers and convertor-packed bytes
+go on the wire, never serialized objects; header layout ref:
+ompi/mca/pml/ob1/pml_ob1_hdr.h).  The six ob1 frag kinds each get a
+fixed little-endian struct header; the payload buffer is appended raw
+so transports can scatter/gather it (``sendmsg``) or copy it into a
+ring without an intermediate serialization copy.  Anything that is
+not a recognized ob1 frag (future frameworks, tests) falls back to
+pickle under code 0 — correctness never depends on the fast path.
+
+Frame layout (after the transport's own 4-byte length prefix):
+
+    [0]     code: 0=pickle, 1=MATCH, 2=MATCH_SYNC, 3=RNDV, 4=ACK,
+                  5=SYNC_ACK, 6=FRAG
+    [1:N)   fixed signed-64 fields per kind (struct below)
+    [N:)    raw payload bytes (kinds 1,2,3,6)
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+from typing import Any, Optional, Tuple
+
+# field structs (code byte included so encode is one pack call)
+_M = struct.Struct("<Bqqqqq")        # MATCH: cid src tag seq gsrc
+_MS = struct.Struct("<Bqqqqqq")      # MATCH_SYNC: ... sreq_id
+_R = struct.Struct("<Bqqqqqqq")      # RNDV: ... total sreq_id
+_A = struct.Struct("<Bqq")           # ACK: sreq_id rreq_id
+_SA = struct.Struct("<Bq")           # SYNC_ACK: sreq_id
+_F = struct.Struct("<Bqq")           # FRAG: rreq_id pos
+
+_I64_MIN = -(1 << 63)
+_I64_MAX = (1 << 63) - 1
+
+
+def _fits(*vals: int) -> bool:
+    for v in vals:
+        if not (isinstance(v, int) and _I64_MIN <= v <= _I64_MAX):
+            return False
+    return True
+
+
+def encode(frag: Any) -> Tuple[bytes, Optional[Any]]:
+    """Return ``(header, payload)``.  ``header`` is small bytes;
+    ``payload`` is the frag's buffer (bytes/memoryview) to be placed
+    on the wire immediately after, or None."""
+    if type(frag) is tuple and frag:
+        k = frag[0]
+        if k == "M" and len(frag) == 7 and _fits(*frag[1:6]):
+            return _M.pack(1, *frag[1:6]), frag[6]
+        if k == "F" and len(frag) == 4 and _fits(*frag[1:3]):
+            return _F.pack(6, *frag[1:3]), frag[3]
+        if k == "A" and len(frag) == 3 and _fits(*frag[1:]):
+            return _A.pack(4, *frag[1:]), None
+        if k == "SA" and len(frag) == 2 and _fits(frag[1]):
+            return _SA.pack(5, frag[1]), None
+        if k == "MS" and len(frag) == 8 and _fits(*frag[1:7]):
+            return _MS.pack(2, *frag[1:7]), frag[7]
+        if k == "R" and len(frag) == 9 and _fits(*frag[1:8]):
+            return _R.pack(3, *frag[1:8]), frag[8]
+    return b"\x00" + pickle.dumps(frag, protocol=pickle.HIGHEST_PROTOCOL), None
+
+
+def decode(frame, copy: bool = True) -> Any:
+    """Decode one full frame (bytes/memoryview, no length prefix).
+    With ``copy=True`` payload slices are copied to bytes so callers
+    may recycle the backing buffer; ``copy=False`` hands out zero-copy
+    slices of an immutable frame."""
+    code = frame[0]
+    if copy:
+        pl = bytes
+    else:
+        if isinstance(frame, bytes):
+            frame = memoryview(frame)
+        pl = lambda b: b  # noqa: E731 — slices below are zero-copy views
+    if code == 1:
+        _, cid, src, tag, seq, gsrc = _M.unpack_from(frame)
+        return ("M", cid, src, tag, seq, gsrc, pl(frame[_M.size:]))
+    if code == 6:
+        _, rreq_id, pos = _F.unpack_from(frame)
+        return ("F", rreq_id, pos, pl(frame[_F.size:]))
+    if code == 4:
+        _, sreq_id, rreq_id = _A.unpack_from(frame)
+        return ("A", sreq_id, rreq_id)
+    if code == 5:
+        return ("SA", _SA.unpack_from(frame)[1])
+    if code == 2:
+        _, cid, src, tag, seq, gsrc, sreq_id = _MS.unpack_from(frame)
+        return ("MS", cid, src, tag, seq, gsrc, sreq_id,
+                pl(frame[_MS.size:]))
+    if code == 3:
+        _, cid, src, tag, seq, gsrc, total, sreq_id = _R.unpack_from(frame)
+        return ("R", cid, src, tag, seq, gsrc, total, sreq_id,
+                pl(frame[_R.size:]))
+    if code == 0:
+        return pickle.loads(bytes(frame[1:]))
+    raise ValueError(f"bad wire code {code}")

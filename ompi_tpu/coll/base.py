@@ -110,6 +110,39 @@ def barrier_bruck(comm) -> None:
         dist <<= 1
 
 
+def barrier_binomial(comm) -> None:
+    """Binomial fan-in to 0 + binomial fan-out: 2(N-1) total messages
+    vs dissemination's N*log2(N).  On oversubscribed hosts every
+    message costs a scheduler hop, so total message count — not round
+    count — is the latency model (ref: coll_base_barrier.c tree)."""
+    size, rank = comm.size, comm.rank
+    if size == 1:
+        return
+    # fan-in: binomial reduce of a zero-byte token
+    mask = 1
+    while mask < size:
+        if rank & mask:
+            _send(comm, _zero, rank & ~mask, T_BARRIER)
+            break
+        child = rank | mask
+        if child < size:
+            _recv(comm, 0, np.uint8, child, T_BARRIER)
+        mask <<= 1
+    # fan-out: binomial bcast of a zero-byte token (same traversal as
+    # bcast_binomial with root 0)
+    mask = 1
+    while mask < size:
+        if rank & mask:
+            _recv(comm, 0, np.uint8, rank - mask, T_BARRIER)
+            break
+        mask <<= 1
+    mask >>= 1
+    while mask > 0:
+        if rank + mask < size:
+            _send(comm, _zero, rank + mask, T_BARRIER)
+        mask >>= 1
+
+
 def barrier_doublering(comm) -> None:
     size, rank = comm.size, comm.rank
     if size == 1:
@@ -238,6 +271,14 @@ def reduce_binomial(comm, sarr: np.ndarray, rarr: Optional[np.ndarray],
 def allreduce_linear(comm, sarr, rarr, op: Op) -> None:
     """nonoverlapping: reduce to 0 then bcast (ref :46)."""
     reduce_linear(comm, sarr, rarr, op, 0)
+    bcast_binomial(comm, rarr, 0)
+
+
+def allreduce_reduce_bcast(comm, sarr, rarr, op: Op) -> None:
+    """Binomial reduce + binomial bcast: 2(N-1) total messages vs
+    recursive doubling's N*log2(N).  Preferred when ranks share cores
+    (total message count dominates latency, not round count)."""
+    reduce_binomial(comm, sarr, rarr, op, 0)
     bcast_binomial(comm, rarr, 0)
 
 

@@ -37,6 +37,7 @@ _ALGS = {
     "allreduce": {
         "linear": alg.allreduce_linear,
         "recursive_doubling": alg.allreduce_recursivedoubling,
+        "reduce_bcast": alg.allreduce_reduce_bcast,
         "ring": alg.allreduce_ring,
     },
     "bcast": {
@@ -56,6 +57,35 @@ _ALGS = {
         "bruck": alg.alltoall_bruck,
     },
 }
+
+
+def _oversubscribed(comm) -> bool:
+    """Comm-consistent oversubscription verdict: true when some node
+    hosts more members of THIS comm than it has cores.  Computed from
+    modex data (node_id, cores published at init) so every member
+    reaches the same answer — a local-env hint would diverge (e.g. a
+    dpm-spawned singleton vs its parent job) and split the comm
+    across different algorithms: deadlock.  Cached per comm."""
+    cached = getattr(comm, "_oversub_verdict", None)
+    if cached is not None:
+        return cached
+    verdict = False
+    if comm.size > 1:
+        try:
+            rte = comm.state.rte
+            per_node: dict = {}
+            cores_of: dict = {}
+            for g in comm.group:
+                node = rte.modex_get(g, "node_id")
+                per_node[node] = per_node.get(node, 0) + 1
+                if node not in cores_of:
+                    cores_of[node] = int(rte.modex_get(g, "cores"))
+            verdict = any(cnt > cores_of[n]
+                          for n, cnt in per_node.items())
+        except Exception:
+            verdict = False
+    comm._oversub_verdict = verdict
+    return verdict
 
 
 class TunedModule(P2PCollModule):
@@ -88,6 +118,12 @@ class TunedModule(P2PCollModule):
             # only the rank-ordered fold is deterministic+correct for
             # non-commutative ops (ref decision: "else nonoverlapping")
             return alg.allreduce_linear
+        if _oversubscribed(comm):
+            # ranks share cores: every message is a scheduler hop and
+            # nothing runs in parallel, so minimize TOTAL messages.
+            # reduce+bcast moves the same total bytes as ring
+            # (2(N-1)*nbytes) in 2(N-1) messages instead of 2(N-1)*N.
+            return alg.allreduce_reduce_bcast
         if nbytes < _small_var.value and _is_pow2(comm.size):
             return alg.allreduce_recursivedoubling
         if nbytes // max(1, comm.size) > 0:
@@ -129,6 +165,8 @@ class TunedModule(P2PCollModule):
         return alg.reduce_binomial if op.commute else alg.reduce_linear
 
     def _pick_barrier(self, comm):
+        if _oversubscribed(comm):
+            return alg.barrier_binomial
         return alg.barrier_bruck
 
 

@@ -197,6 +197,9 @@ class Convertor:
             self.crc = zlib.crc32(data, self.crc)
         return data
 
+    def pack_bytes(self, max_bytes: Optional[int] = None) -> bytes:
+        return self.pack(max_bytes)
+
     def unpack(self, data: bytes) -> int:
         """Unpack bytes at the current position; advances; returns
         bytes consumed."""
@@ -229,6 +232,85 @@ class Convertor:
             self.crc = zlib.crc32(data[:end - start], self.crc)
         self.position = end
         return end - start
+
+
+class ContigConvertor:
+    """Fast-path convertor: contiguous datatype over a contiguous
+    buffer collapses pack/unpack to flat byte-range copies (the
+    reference's contiguous-convertor shortcut that skips the stack
+    machine entirely, ref: opal_convertor.h:254-262
+    opal_convertor_prepare_for_send's CONVERTOR_NO_OP path).
+
+    ``pack`` returns zero-copy memoryviews of the user buffer — legal
+    because MPI forbids touching the buffer while a request that still
+    streams from it is pending; eager sends that complete immediately
+    must use ``pack_bytes`` (the payload may sit in a transport queue
+    after completion).
+    """
+
+    __slots__ = ("datatype", "count", "packed_size", "position", "_view",
+                 "checksum", "crc", "external32")
+
+    def __init__(self, view, datatype, count) -> None:
+        self._view = view  # uint8 ndarray view over the packed range
+        self.datatype = datatype
+        self.count = count
+        self.packed_size = len(view)
+        self.position = 0
+        self.checksum = False
+        self.external32 = False
+        self.crc = 0
+
+    def set_position(self, pos: int) -> None:
+        if pos < 0 or pos > self.packed_size:
+            raise ValueError("position out of range")
+        self.position = pos
+
+    @property
+    def done(self) -> bool:
+        return self.position >= self.packed_size
+
+    def pack(self, max_bytes: Optional[int] = None):
+        start = self.position
+        end = self.packed_size if max_bytes is None \
+            else min(self.packed_size, start + max_bytes)
+        self.position = end
+        if end <= start:
+            return b""
+        return memoryview(self._view[start:end])
+
+    def pack_bytes(self, max_bytes: Optional[int] = None) -> bytes:
+        out = self.pack(max_bytes)
+        return out if isinstance(out, bytes) else out.tobytes()
+
+    def unpack(self, data) -> int:
+        start = self.position
+        n = min(self.packed_size - start, len(data))
+        if n <= 0:
+            return 0
+        src = np.frombuffer(data, dtype=np.uint8, count=n) \
+            if isinstance(data, bytes) else \
+            np.frombuffer(memoryview(data)[:n], dtype=np.uint8)
+        self._view[start:start + n] = src
+        self.position = start + n
+        return n
+
+
+def make_convertor(datatype: Datatype, count: int, buf: Buffer,
+                   offset: int = 0, writable: bool = False):
+    """Pick the cheapest convertor for (datatype, buf): the flat
+    fast path when both are contiguous, the full stack machine
+    otherwise."""
+    if count and datatype.is_contiguous and datatype.lb == 0:
+        try:
+            view = _byte_view(buf, writable=writable)
+        except (ValueError, TypeError, BufferError):
+            view = None
+        if view is not None:
+            need = offset + count * datatype.size
+            if need <= view.shape[0]:
+                return ContigConvertor(view[offset:need], datatype, count)
+    return Convertor(datatype, count, buf, offset=offset)
 
 
 def pack(datatype: Datatype, count: int, buf: Buffer,

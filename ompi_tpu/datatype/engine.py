@@ -82,8 +82,14 @@ class Datatype:
     # -- queries ---------------------------------------------------------
     @property
     def size(self) -> int:
-        """Packed size in bytes (MPI_Type_size)."""
-        return sum(r.packed_bytes for r in self.runs)
+        """Packed size in bytes (MPI_Type_size).  Cached: this sits on
+        the per-message hot path and runs never change after commit
+        (commit() invalidates)."""
+        s = self.__dict__.get("_size")
+        if s is None:
+            s = sum(r.packed_bytes for r in self.runs)
+            self.__dict__["_size"] = s
+        return s
 
     @property
     def extent(self) -> int:
@@ -107,12 +113,18 @@ class Datatype:
 
     @property
     def is_contiguous(self) -> bool:
-        """True when `count` elements occupy count*size contiguous bytes."""
-        if len(self.runs) != 1:
-            return False
-        r = self.runs[0]
-        one_contig = (r.nblocks == 1 or r.stride == r.block_bytes)
-        return one_contig and r.disp == self.lb and self.extent == self.size
+        """True when `count` elements occupy count*size contiguous
+        bytes.  Cached (hot path; see ``size``)."""
+        c = self.__dict__.get("_contig")
+        if c is None:
+            if len(self.runs) != 1:
+                c = False
+            else:
+                r = self.runs[0]
+                c = ((r.nblocks == 1 or r.stride == r.block_bytes)
+                     and r.disp == self.lb and self.extent == self.size)
+            self.__dict__["_contig"] = c
+        return c
 
     @property
     def is_predefined(self) -> bool:
@@ -128,6 +140,8 @@ class Datatype:
         if not self.committed:
             self.runs = _optimize(self.runs)
             self.committed = True
+            self.__dict__.pop("_size", None)
+            self.__dict__.pop("_contig", None)
         return self
 
     def free(self) -> None:  # handles are GC'd; parity no-op

@@ -38,7 +38,13 @@ def load() -> Optional[ctypes.CDLL]:
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if not os.path.exists(_LIB_PATH) and not _build():
+        stale = True
+        if os.path.exists(_LIB_PATH):
+            lib_mtime = os.path.getmtime(_LIB_PATH)
+            stale = any(
+                os.path.getmtime(os.path.join(_NATIVE_DIR, f)) > lib_mtime
+                for f in os.listdir(_NATIVE_DIR) if f.endswith(".cpp"))
+        if stale and not _build():
             return None
         try:
             lib = ctypes.CDLL(_LIB_PATH)
@@ -48,6 +54,10 @@ def load() -> Optional[ctypes.CDLL]:
         lib.tpumpi_ring_push.argtypes = [u8p, ctypes.c_uint64, u8p,
                                          ctypes.c_uint64]
         lib.tpumpi_ring_push.restype = ctypes.c_int
+        lib.tpumpi_ring_push2.argtypes = [u8p, ctypes.c_uint64, u8p,
+                                          ctypes.c_uint64, u8p,
+                                          ctypes.c_uint64]
+        lib.tpumpi_ring_push2.restype = ctypes.c_int
         lib.tpumpi_ring_peek.argtypes = [u8p, ctypes.c_uint64]
         lib.tpumpi_ring_peek.restype = ctypes.c_int64
         lib.tpumpi_ring_pop.argtypes = [u8p, ctypes.c_uint64, u8p,

@@ -447,7 +447,8 @@ class Window:
 
     def _wait_applied(self, goal: int) -> None:
         while self._applied_total < goal:
-            self._progress.progress()
+            if self._progress.progress() == 0:
+                self._progress.idle_tick()
 
     def fence(self) -> None:
         """Collective epoch boundary (osc/pt2pt fence: alltoall the
@@ -510,7 +511,8 @@ class Window:
         self._start_group = list(group_ranks)
         while any(self._pscw_posted.get(t, 0) < 1
                   for t in self._start_group):
-            self._progress.progress()
+            if self._progress.progress() == 0:
+                self._progress.idle_tick()
         for t in self._start_group:
             self._pscw_posted[t] -= 1
 
@@ -533,7 +535,8 @@ class Window:
         need = {o: 1 for o in self._post_group}
         while any(self._pscw_complete.get(o, 0) < n
                   for o, n in need.items()):
-            self._progress.progress()
+            if self._progress.progress() == 0:
+                self._progress.idle_tick()
         for o in need:
             self._pscw_complete[o] -= 1
         self._post_group = None

@@ -31,7 +31,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ompi_tpu.datatype.convertor import Convertor
+from ompi_tpu.datatype.convertor import Convertor, make_convertor
 from ompi_tpu.mca.base import Component, frameworks
 from ompi_tpu.mca.params import registry
 from .request import (ANY_SOURCE, ANY_TAG, PROC_NULL, ERR_TRUNCATE,
@@ -162,7 +162,7 @@ class PmlOb1:
         gdst = comm.group[dst]
         ep = self._ep(gdst)
         btl = ep.btl
-        conv = Convertor(datatype, count, buf, offset=offset)
+        conv = make_convertor(datatype, count, buf, offset=offset)
         cid = comm.cid
         key = (cid, dst)
         seq = self._send_seq.get(key, 0)
@@ -177,16 +177,18 @@ class PmlOb1:
 
         gsrc = self.state.rank  # global sender id (C/R bookkeeping)
         if conv.packed_size <= btl.eager_limit and mode != MODE_SYNC:
-            payload = conv.pack()
+            # pack_bytes: the request completes NOW, but the frag may
+            # sit in a transport queue — the payload must own its bytes
+            payload = conv.pack_bytes()
             btl.send(gdst, (MATCH, cid, src, tag, seq, gsrc, payload))
             req._complete()
         elif conv.packed_size <= btl.eager_limit:  # sync eager
-            payload = conv.pack()
+            payload = conv.pack_bytes()
             self._send_reqs[req_id] = req
             btl.send(gdst, (MATCH_SYNC, cid, src, tag, seq, gsrc,
                             req_id, payload))
         else:
-            head = conv.pack(btl.eager_limit)
+            head = conv.pack_bytes(btl.eager_limit)
             self._send_reqs[req_id] = req
             btl.send(gdst, (RNDV, cid, src, tag, seq, gsrc,
                             conv.packed_size, req_id, head))
@@ -205,7 +207,8 @@ class PmlOb1:
             r.status.source = PROC_NULL
             r.status.tag = ANY_TAG
             return r
-        conv = Convertor(datatype, count, buf, offset=offset) \
+        conv = make_convertor(datatype, count, buf, offset=offset,
+                              writable=True) \
             if buf is not None else Convertor(datatype, 0, b"")
         req_id = next(self._req_counter)
         req = RecvRequest(self.state.progress, conv, req_id, src, tag,
@@ -242,6 +245,7 @@ class PmlOb1:
             st = self.iprobe(src, tag, comm)
             if st is not None:
                 return st
+            self.state.progress.idle_tick()
 
     def improbe(self, src, tag, comm):
         """Matched probe: removes the message from matching
@@ -255,7 +259,7 @@ class PmlOb1:
 
     def mrecv(self, buf, count, datatype, msg, comm) -> Status:
         req_id = next(self._req_counter)
-        conv = Convertor(datatype, count, buf)
+        conv = make_convertor(datatype, count, buf, writable=True)
         req = RecvRequest(self.state.progress, conv, req_id, msg.src,
                           msg.tag, comm.cid)
         self._recv_reqs[req_id] = req
@@ -409,7 +413,7 @@ class PmlOb1:
         conv = req.conv
         while not conv.done:
             pos = conv.position
-            payload = conv.pack(btl.max_send_size)
+            payload = conv.pack_bytes(btl.max_send_size)
             btl.send(req.dst, (FRAG, rreq_id, pos, payload))
         req._complete()
 

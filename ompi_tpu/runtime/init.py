@@ -53,6 +53,12 @@ def mpi_init(state: ProcState, device=None) -> ProcState:
         world.states[state.rank] = state
     if device is not None:
         state.rte.modex_put("device_id", int(device.id))
+    # node + cores ride the modex so collective algorithm selection
+    # can be COMM-CONSISTENT about oversubscription (every member of
+    # a comm must pick the same algorithm; local env hints diverge —
+    # e.g. a dpm-spawned singleton vs its 8-rank parent)
+    state.rte.modex_put("node_id", getattr(state.rte, "node_id", 0))
+    state.rte.modex_put("cores", os.cpu_count() or 1)
     state.rte.fence()
     endpoints = btl_base.wire_endpoints(state, modules)
     state.pml.add_procs(endpoints)

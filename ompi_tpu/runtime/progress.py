@@ -56,9 +56,104 @@ class Progress:
         # doorbell, so blocked waits must keep polling with short
         # backoff instead of parking.
         self.poll_mode = False
+        # Idle selector: transports register kernel-wakeable fds (shm
+        # doorbell FIFOs, tcp sockets) so an idle rank BLOCKS in
+        # select() and the kernel schedules it the instant a peer
+        # enqueues work — the cross-process analog of the reference's
+        # libevent-blocking opal_progress when no btl needs polling.
+        # Critical on oversubscribed hosts: sched_yield spinning burns
+        # whole CFS quanta (~ms) before the rank holding our message
+        # runs; an fd wakeup context-switches in ~10 us.
+        self._idle_sel = None
+        self._idle_drains: dict = {}
+        self._wake_wfd = -1  # self-pipe write end (thread wakeups)
+        # park hooks: transports publish "this rank is parked" so
+        # senders skip the doorbell syscall (and its wake-preemption)
+        # while we're awake and polling anyway (futex-style protocol)
+        self._park_set: list = []
+        self._park_clear: list = []
+
+    def register_park_hooks(self, set_cb, clear_cb) -> None:
+        self._park_set.append(set_cb)
+        self._park_clear.append(clear_cb)
+
+    def register_idle_fd(self, fd: int, drain: Callable[[], None] | None = None) -> None:
+        import selectors
+        if self._idle_sel is None:
+            self._idle_sel = selectors.DefaultSelector()
+        try:
+            self._idle_sel.register(fd, selectors.EVENT_READ)
+        except (KeyError, ValueError, OSError):
+            return
+        if drain is not None:
+            self._idle_drains[fd] = drain
+
+    def unregister_idle_fd(self, fd: int) -> None:
+        if self._idle_sel is not None:
+            try:
+                self._idle_sel.unregister(fd)
+            except (KeyError, ValueError, OSError):
+                pass
+        self._idle_drains.pop(fd, None)
+
+    def enable_thread_wakeup(self) -> None:
+        """Self-pipe so same-process threads (inproc btl) can wake a
+        rank parked in idle_wait."""
+        if self._wake_wfd >= 0:
+            return
+        import os
+        r, w = os.pipe()
+        os.set_blocking(r, False)
+        os.set_blocking(w, False)
+        self._wake_wfd = w
+        self.register_idle_fd(r, drain=lambda: self._drain_pipe(r))
+
+    def _drain_pipe(self, fd: int) -> None:
+        import os
+        try:
+            while os.read(fd, 4096):
+                pass
+        except (BlockingIOError, OSError):
+            pass
+
+    def idle_wait(self, timeout: float) -> None:
+        """Block until a registered fd becomes readable (or timeout).
+        Drains doorbell bytes; the caller re-sweeps progress()."""
+        sel = self._idle_sel
+        if sel is None or not sel.get_map():
+            time.sleep(min(timeout, 0.0002))
+            return
+        if self._park_set:
+            # publish parked BEFORE the final sweep: a sender that
+            # pushes after our sweep will see the flag and ring the
+            # doorbell; one that pushed before is caught by the sweep
+            for cb in self._park_set:
+                cb()
+            if self.progress():
+                for cb in self._park_clear:
+                    cb()
+                return
+        try:
+            for key, _ in sel.select(timeout):
+                drain = self._idle_drains.get(key.fd)
+                if drain is not None:
+                    drain()
+        finally:
+            for cb in self._park_clear:
+                cb()
+
+    @property
+    def has_idle_fds(self) -> bool:
+        return self._idle_sel is not None and bool(self._idle_sel.get_map())
 
     def wakeup(self) -> None:
         self.doorbell.set()
+        if self._wake_wfd >= 0:
+            import os
+            try:
+                os.write(self._wake_wfd, b"\x01")
+            except (BlockingIOError, OSError):
+                pass
 
     def register(self, cb: Callable[[], int], low_priority: bool = False) -> None:
         with self._lock:
@@ -75,7 +170,13 @@ class Progress:
                 self._lp_callbacks.remove(cb)
 
     def progress(self) -> int:
-        """One sweep; returns number of events completed."""
+        """One sweep; returns number of events completed.
+
+        Never yields or sleeps: a sweep must cost microseconds so
+        blocking loops can spin a few times then park (idle_tick /
+        WaitSync).  An implicit sched_yield here costs a whole CFS
+        quantum (~200 us measured) per call on oversubscribed hosts.
+        """
         self._counter += 1
         events = 0
         for cb in list(self._callbacks):
@@ -83,9 +184,16 @@ class Progress:
         if self._lp_callbacks and self._counter % max(1, _lp_ratio_var.value) == 0:
             for cb in list(self._lp_callbacks):
                 events += cb()
-        if events == 0 and _yield_var.value:
-            time.sleep(0)
         return events
+
+    def idle_tick(self, timeout: float = 0.002) -> None:
+        """Call after a zero-event sweep in a blocking spin loop:
+        parks on the idle selector when transports registered wakeup
+        fds, else yields the core (opal_progress_yield analog)."""
+        if self.has_idle_fds:
+            self.idle_wait(timeout)
+        elif _yield_var.value:
+            time.sleep(0)
 
 
 class WaitSync:
@@ -99,16 +207,18 @@ class WaitSync:
     an Event for cross-thread wakeups.
     """
 
-    __slots__ = ("_event", "_count")
+    __slots__ = ("_count",)
 
     def __init__(self, count: int = 1) -> None:
-        self._event = threading.Event()
+        # A bare counter, no Event: completions always run in the
+        # owning rank's thread (actor model), so the waiter observes
+        # the decrement directly; cross-thread producers wake us via
+        # the progress doorbell / idle fds, never this object.  Keeps
+        # request allocation to one int (requests are per-message).
         self._count = count
 
     def signal(self, n: int = 1) -> None:
         self._count -= n
-        if self._count <= 0:
-            self._event.set()
 
     @property
     def done(self) -> bool:
@@ -117,10 +227,20 @@ class WaitSync:
     def wait(self, progress: Progress, timeout: float | None = None) -> bool:
         deadline = None if timeout is None else time.monotonic() + timeout
         spins = 0
-        while not self._event.is_set():
+        park = 2 if progress.oversubscribed else 50
+        while self._count > 0:
             if progress.progress() == 0:
                 spins += 1
-                if progress.poll_mode:
+                if progress.has_idle_fds:
+                    # kernel-wakeable transports: park in select()
+                    # after a short spin; peers ring the fd doorbell
+                    # the instant they enqueue (essential on
+                    # oversubscribed hosts where yield-spinning
+                    # burns whole scheduler quanta)
+                    if spins > park:
+                        progress.idle_wait(0.002)
+                        spins = 0
+                elif progress.poll_mode:
                     # poll-only transports.  Oversubscribed hosts
                     # (ranks > cores) need aggressive yielding or every
                     # blocked rank burns a scheduler timeslice before
@@ -138,18 +258,18 @@ class WaitSync:
                     # core (the convoy shows up as multi-ms latency
                     # spikes on small messages)
                     progress.doorbell.clear()
-                    if progress.progress() == 0 and not self._event.is_set():
+                    if progress.progress() == 0 and self._count > 0:
                         progress.doorbell.wait(0.005)
                     spins = 0
                 elif spins > 200:
                     # Park on the doorbell; peers ring it when they
                     # enqueue frags for us (cross-thread wakeup).
                     progress.doorbell.clear()
-                    if progress.progress() == 0 and not self._event.is_set():
+                    if progress.progress() == 0 and self._count > 0:
                         progress.doorbell.wait(0.01)
                     spins = 0
             else:
                 spins = 0
             if deadline is not None and time.monotonic() > deadline:
-                return self._event.is_set()
+                return self._count <= 0
         return True

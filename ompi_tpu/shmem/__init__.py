@@ -219,7 +219,7 @@ class ShmemCtx:
         progress = self.comm.state.progress
         while not bool(ops(arr.local.reshape(-1)[index], value)):
             if progress.progress() == 0:
-                time.sleep(0)
+                progress.idle_tick()
             if time.monotonic() > deadline:
                 raise TimeoutError(
                     f"shmem_wait_until({cmp}, {value}) timed out")
