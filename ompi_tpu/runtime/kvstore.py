@@ -64,6 +64,10 @@ class KVServer:
         self.universe = nprocs
         self.spawn_enabled = False
         self.spawn_requests: List[dict] = []
+        # optional event sinks (the job state machine): called OUTSIDE
+        # the lock with activations only (queue puts, never blocking)
+        self.on_abort = None
+        self.on_spawn = None
         self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self.sock.bind((host, 0))
@@ -164,10 +168,13 @@ class KVServer:
                     # reply sent when fence completes (above)
                 elif op == "abort":
                     with self.cv:
-                        if self.aborted is None:
+                        first = self.aborted is None
+                        if first:
                             self.aborted = (msg["rank"], msg["code"],
                                             msg.get("msg", ""))
                         self.cv.notify_all()
+                    if first and self.on_abort is not None:
+                        self.on_abort(self.aborted)
                     _send_msg(conn, {"ok": True})
                 elif op == "spawn":
                     # allocate a universe-rank block and hand the
@@ -194,6 +201,8 @@ class KVServer:
                             "parent_root": int(msg["parent_root"]),
                         })
                         self.cv.notify_all()
+                    if self.on_spawn is not None:
+                        self.on_spawn()
                     _send_msg(conn, {"base": base})
         except OSError:
             return

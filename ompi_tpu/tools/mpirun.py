@@ -6,8 +6,21 @@ Re-design of orterun/HNP (ref: orte/tools/orterun/main.c:13,
 orted_submit.c job construction; odls fork/exec
 ref: odls_default_module.c:338-437; IOF ref: orte/mca/iof; errmgr
 default-HNP kill-job-on-proc-death policy ref:
-orte/mca/errmgr/default_hnp).  On the default single-local-node
-allocation the launcher IS the daemon (fork/exec local).  With
+orte/mca/errmgr/default_hnp).  The launch lifecycle is an
+EVENT-DRIVEN STATE MACHINE (runtime/statemachine.py — the
+orte/mca/state analog, ref: state.h:92-109, state_base_fns.c:428-843):
+
+    INIT -> ALLOCATE -> MAP -> [LAUNCH_DAEMONS -> DAEMONS_REPORTED]
+         -> LAUNCH_APPS -> RUNNING -> DRAINING -> TERMINATED
+
+Daemon report-ins, proc exits, node completions, KV aborts, dynamic
+spawn requests and timeouts arrive as events from any thread; the
+errmgr policy (first abnormal exit / daemon loss / abort kills the
+job) is implemented as the PROC_FAILED / DAEMON_FAILED / ABORTED /
+TIMEOUT state handlers.  ``--verbose state`` traces every transition.
+
+On the default single-local-node allocation the launcher IS the
+daemon (fork/exec local, daemon states skipped).  With
 --hosts/--hostfile/--simulate-nodes the PLM takes over: a radix tree
 of tpud daemons is launched (ssh agent or local subprocesses), each
 daemon runs its slice of the rmaps job map and relays IOF/exits back
@@ -15,8 +28,8 @@ daemon runs its slice of the rmaps job map and relays IOF/exits back
 
 Usage:
     python -m ompi_tpu.tools.mpirun -np 4 [--mca k v] [--tag-output]
-        [--timeout SEC] [--hosts a,b:4 | --hostfile F |
-        --simulate-nodes NxM] [--map-by byslot|bynode]
+        [--timeout SEC] [--verbose state] [--hosts a,b:4 |
+        --hostfile F | --simulate-nodes NxM] [--map-by byslot|bynode]
         [--ranks-per-proc N|all] prog [args...]
 """
 
@@ -33,6 +46,7 @@ import threading
 import time
 from typing import List, Optional
 
+from ompi_tpu.runtime import statemachine as smx
 from ompi_tpu.runtime.kvstore import KVServer
 
 
@@ -50,83 +64,433 @@ def _forward(stream, out, tag: str, tag_output: bool) -> None:
         pass
 
 
+def _pkg_root() -> str:
+    import ompi_tpu as _pkg
+    return os.path.dirname(os.path.dirname(os.path.abspath(
+        _pkg.__file__)))
+
+
+def _wire_abort(server: KVServer, sm: smx.StateMachine) -> None:
+    server.on_abort = lambda ab: sm.activate(
+        smx.ABORTED, rank=ab[0], code=ab[1], msg=ab[2])
+
+
+def _errmgr_table(sm: smx.StateMachine, drain) -> None:
+    """The errmgr/default_hnp policy as state handlers: any failure
+    state drains the job with a diagnostic; DRAINING is idempotent."""
+
+    def _already_drained(sm) -> bool:
+        # a late failure/timeout event must never rewrite the exit
+        # code of a job that already drained cleanly
+        return bool(sm.data.get("drained"))
+
+    def on_proc_failed(sm, info):
+        if _already_drained(sm):
+            return
+        code = info["code"] if info["code"] > 0 else 1
+        extra = f" ({info['error']})" if info.get("error") else ""
+        sys.stderr.write(
+            f"mpirun: {info['who']} exited with status "
+            f"{info['code']}{extra}; terminating job\n")
+        sm.exit_code = code
+        sm.activate(smx.DRAINING, failed=True)
+
+    def on_daemon_failed(sm, info):
+        if _already_drained(sm):
+            return
+        sys.stderr.write(
+            f"mpirun: lost contact with daemon on node(s) "
+            f"[{info['node']}]; terminating job\n")
+        sm.exit_code = 1
+        sm.activate(smx.DRAINING, failed=True)
+
+    def on_aborted(sm, info):
+        if _already_drained(sm):
+            return
+        sm.exit_code = info["code"] or 1
+        sys.stderr.write(
+            f"mpirun: rank {info['rank']} called "
+            f"MPI_Abort({sm.exit_code}): {info['msg']}\n")
+        sm.activate(smx.DRAINING, failed=True)
+
+    def on_timeout(sm, info):
+        if _already_drained(sm):
+            return
+        sys.stderr.write("mpirun: job exceeded --timeout; killing\n")
+        sm.exit_code = 124
+        sm.activate(smx.DRAINING, failed=True)
+
+    def on_launch_failed(sm, info):
+        if _already_drained(sm):
+            return
+        if info.get("msg"):
+            sys.stderr.write(f"mpirun: {info['msg']}\n")
+        sm.exit_code = info.get("code", 1)
+        sm.activate(smx.DRAINING, failed=True)
+
+    def on_draining(sm, info):
+        if not sm.data.get("drained"):
+            sm.data["drained"] = True
+            drain(info.get("failed", False))
+        sm.activate(smx.TERMINATED)
+
+    sm.register_table({
+        smx.PROC_FAILED: on_proc_failed,
+        smx.DAEMON_FAILED: on_daemon_failed,
+        smx.ABORTED: on_aborted,
+        smx.TIMEOUT: on_timeout,
+        smx.LAUNCH_FAILED: on_launch_failed,
+        smx.DRAINING: on_draining,
+        smx.TERMINATED: lambda sm, info: None,
+        smx.RUNNING: lambda sm, info: None,
+    })
+
+
 def run_multinode(opts, nodes, rpp: int, hybrid: bool) -> int:
-    """The PLM path: per-node daemons, rmaps job map, tree launch."""
+    """The PLM path: per-node daemons, rmaps job map, tree launch —
+    sequenced by the hnp-role state machine."""
     from ompi_tpu.runtime import oob, rmaps
     from ompi_tpu.tools.plm import HNP
 
-    try:
-        maps = rmaps.map_ranks(nodes, opts.np, rpp if hybrid else 1,
-                               policy=opts.map_by,
-                               oversubscribe=opts.oversubscribe)
-    except ValueError as e:
-        sys.stderr.write(f"mpirun: {e}\n")
-        return 2
+    sm = smx.StateMachine("hnp", verbose="state" in opts.verbose.split(","))
+    d = sm.data
+    d.update(registered=set(), done=set(), drained=False)
 
-    any_remote = any(not (n.simulated or n.local) for n in nodes)
-    if any_remote:
-        hnp_ip = opts.hnp_ip or oob.local_ip_toward(
-            next(n.name for n in nodes
-                 if not (n.simulated or n.local)) + ":22")
-    else:
-        hnp_ip = "127.0.0.1"
+    pkg_root = _pkg_root()
 
-    import ompi_tpu as _pkg
-    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(
-        _pkg.__file__)))
+    def on_allocate(sm, info):
+        # allocation itself happened in main() (ras.allocate); this
+        # state validates and records it
+        d["nodes"] = nodes
+        sm.activate(smx.MAP)
 
-    server = KVServer(opts.np,
-                      host="0.0.0.0" if any_remote else "127.0.0.1",
-                      advertise=hnp_ip if any_remote else None)
-    hnp = HNP(maps, agent=opts.agent, python=sys.executable,
-              pythonpath=pkg_root, tree_radix=opts.tree_radix,
-              bind_all=any_remote)
-    hnp.tag_output = opts.tag_output
+    def on_map(sm, info):
+        try:
+            d["maps"] = rmaps.map_ranks(
+                nodes, opts.np, rpp if hybrid else 1,
+                policy=opts.map_by, oversubscribe=opts.oversubscribe)
+        except ValueError as e:
+            sm.activate(smx.LAUNCH_FAILED, msg=str(e), code=2)
+            return
+        sm.activate(smx.LAUNCH_DAEMONS)
 
-    # per-node daemon env: simulator nodes get a fake M-chip mesh via
-    # a forced M-device CPU platform (ras/simulator analog)
-    node_env = {}
-    for n in nodes:
-        env = {}
-        if n.simulated and opts.devices != "none":
-            env["JAX_PLATFORMS"] = "cpu"
-            flags = os.environ.get("XLA_FLAGS", "")
-            env["XLA_FLAGS"] = (flags + " " if flags else "") + \
-                f"--xla_force_host_platform_device_count={n.sim_devices}"
-        node_env[n.node_id] = env
+    def on_launch_daemons(sm, info):
+        maps = d["maps"]
+        any_remote = any(not (n.simulated or n.local) for n in nodes)
+        if any_remote:
+            hnp_ip = opts.hnp_ip or oob.local_ip_toward(
+                next(n.name for n in nodes
+                     if not (n.simulated or n.local)) + ":22")
+        else:
+            hnp_ip = "127.0.0.1"
+        server = KVServer(opts.np,
+                          host="0.0.0.0" if any_remote else "127.0.0.1",
+                          advertise=hnp_ip if any_remote else None)
+        _wire_abort(server, sm)
+        hnp = HNP(maps, agent=opts.agent, python=sys.executable,
+                  pythonpath=pkg_root, tree_radix=opts.tree_radix,
+                  bind_all=any_remote, events=sm)
+        hnp.tag_output = opts.tag_output
+        d.update(server=server, hnp=hnp,
+                 want={m.node.node_id for m in maps},
+                 active={m.node.node_id for m in maps if m.procs})
 
-    job_env = {
-        **getattr(opts, "ckpt_env", {}),
-        "TPUMPI_SIZE": str(opts.np),
-        "TPUMPI_KV_ADDR": server.addr,
-        "TPUMPI_JOBID": f"job-{os.getpid()}",
-    }
-    if hybrid:
-        job_env["TPUMPI_DEVICES"] = opts.devices
-    for key, value in opts.mca:
-        job_env[f"TPUMPI_MCA_{key}"] = value
+        # per-node daemon env: simulator nodes get a fake M-chip mesh
+        # via a forced M-device CPU platform (ras/simulator analog)
+        node_env = {}
+        for n in nodes:
+            env = {}
+            if n.simulated and opts.devices != "none":
+                env["JAX_PLATFORMS"] = "cpu"
+                flags = os.environ.get("XLA_FLAGS", "")
+                env["XLA_FLAGS"] = (flags + " " if flags else "") + \
+                    f"--xla_force_host_platform_device_count=" \
+                    f"{n.sim_devices}"
+            node_env[n.node_id] = env
 
-    exit_code = 0
-    failed = False
-    try:
+        job_env = {
+            **getattr(opts, "ckpt_env", {}),
+            "TPUMPI_SIZE": str(opts.np),
+            "TPUMPI_KV_ADDR": server.addr,
+            "TPUMPI_JOBID": f"job-{os.getpid()}",
+        }
+        if hybrid:
+            job_env["TPUMPI_DEVICES"] = opts.devices
+        for key, value in opts.mca:
+            job_env[f"TPUMPI_MCA_{key}"] = value
+        d["job_env"] = job_env
+
         hnp.spawn_daemons(hnp_ip, node_env)
-        if not hnp.wait_registered(timeout=max(90.0, opts.timeout)):
-            missing = ({m.node.node_id for m in maps}
-                       - set(hnp.channels))
-            sys.stderr.write(
-                f"mpirun: daemons on node(s) {sorted(missing)} never "
-                f"registered (lost: {sorted(hnp.lost_daemons)})\n")
-            failed = True
-            return 1
+        t = threading.Timer(max(90.0, opts.timeout),
+                            lambda: sm.activate("EV_REG_TIMEOUT"))
+        t.daemon = True
+        t.start()
+        d["reg_timer"] = t
+
+    def ev_daemon_up(sm, info):
+        d["registered"].add(info["node"])
+        if sm.state == smx.LAUNCH_DAEMONS \
+                and d["registered"] >= d["want"]:
+            sm.activate(smx.DAEMONS_REPORTED)
+
+    def ev_reg_timeout(sm, info):
+        if sm.state == smx.LAUNCH_DAEMONS:
+            missing = d["want"] - d["registered"]
+            sm.activate(
+                smx.LAUNCH_FAILED, code=1,
+                msg=f"daemons on node(s) {sorted(missing)} never "
+                    f"registered")
+
+    def ev_conn_lost(sm, info):
+        # a connection died before registering: fatal during launch,
+        # a stray probe once running
+        if sm.state == smx.LAUNCH_DAEMONS:
+            sm.activate(smx.LAUNCH_FAILED, code=1,
+                        msg="daemon connection lost before "
+                            "registration")
+
+    def ev_daemon_lost(sm, info):
+        if info["node"] in d["done"] or d.get("drained") \
+                or sm.state in (smx.DRAINING, smx.TERMINATED):
+            return  # clean teardown closes daemon channels
+        sm.activate(smx.DAEMON_FAILED, node=info["node"])
+
+    def on_daemons_reported(sm, info):
+        d["reg_timer"].cancel()
+        sm.activate(smx.LAUNCH_APPS)
+
+    def on_launch_apps(sm, info):
         prog = os.path.abspath(opts.prog) if os.path.exists(opts.prog) \
             else opts.prog
-        hnp.launch(prog, opts.args, job_env, opts.wdir)
-        exit_code = hnp.supervise(server, timeout=opts.timeout)
-        failed = exit_code != 0
+        d["hnp"].launch(prog, opts.args, d["job_env"], opts.wdir)
+        sm.activate(smx.RUNNING)
+
+    def ev_proc_exit(sm, info):  # only abnormal exits are posted
+        if not d.get("drained"):
+            sm.activate(smx.PROC_FAILED, who=info["tag"],
+                        code=info["code"], error=info.get("error", ""))
+
+    def ev_node_done(sm, info):
+        d["done"].add(info["node"])
+        if sm.state in (smx.RUNNING, smx.LAUNCH_APPS) \
+                and d["active"] <= d["done"]:
+            sm.activate(smx.DRAINING, failed=False)
+
+    def drain(failed: bool) -> None:
+        hnp = d.get("hnp")
+        server = d.get("server")
+        if "reg_timer" in d:
+            d["reg_timer"].cancel()
+        if hnp is not None:
+            hnp.shutdown(failed)
+        if server is not None:
+            server.close()
+
+    sm.register_table({
+        smx.ALLOCATE: on_allocate,
+        smx.MAP: on_map,
+        smx.LAUNCH_DAEMONS: on_launch_daemons,
+        smx.DAEMONS_REPORTED: on_daemons_reported,
+        smx.LAUNCH_APPS: on_launch_apps,
+        "EV_DAEMON_UP": ev_daemon_up,
+        "EV_REG_TIMEOUT": ev_reg_timeout,
+        "EV_CONN_LOST": ev_conn_lost,
+        "EV_DAEMON_LOST": ev_daemon_lost,
+        "EV_PROC_EXIT": ev_proc_exit,
+        "EV_NODE_DONE": ev_node_done,
+    })
+    _errmgr_table(sm, drain)
+    sm.start_timeout(opts.timeout)
+    sm.activate(smx.ALLOCATE)
+    try:
+        return sm.run()
     finally:
-        hnp.shutdown(failed)
+        if not d.get("drained"):
+            drain(True)
+
+
+def run_local(opts, rpp: int, hybrid: bool, ckpt_env: dict) -> int:
+    """The direct fork/exec path (the launcher IS the daemon) —
+    sequenced by the same state machine, daemon states skipped."""
+    sm = smx.StateMachine("hnp", verbose="state" in opts.verbose.split(","))
+    d = sm.data
+    d.update(drained=False, outstanding=0)
+    procs: List[subprocess.Popen] = []
+    fwd_threads: List[threading.Thread] = []
+    lock = threading.Lock()
+
+    session = tempfile.mkdtemp(prefix="tpumpi-session-")
+    server = KVServer(opts.np)
+    _wire_abort(server, sm)
+    server.on_spawn = lambda: sm.activate("EV_SPAWN")
+
+    pkg_root = _pkg_root()
+    env_base = dict(os.environ)
+    # children must see the ompi_tpu package regardless of their cwd
+    env_base["PYTHONPATH"] = pkg_root + (
+        os.pathsep + env_base["PYTHONPATH"]
+        if env_base.get("PYTHONPATH") else "")
+    env_base.update(ckpt_env)
+    env_base.update({
+        "TPUMPI_SIZE": str(opts.np),
+        "TPUMPI_LOCAL_SIZE": str(opts.np),  # single-host launch
+        "TPUMPI_KV_ADDR": server.addr,
+        "TPUMPI_SESSION_DIR": session,
+        "TPUMPI_JOBID": f"job-{os.getpid()}",
+    })
+    for key, value in opts.mca:
+        env_base[f"TPUMPI_MCA_{key}"] = value
+
+    def spawn_proc(cmd, env, tag) -> None:
+        """odls fork/exec + IOF wiring + an exit-reaper thread that
+        posts EV_PROC_EXIT (replaces the 20 ms poll loop)."""
+        p = subprocess.Popen(cmd, env=env, cwd=opts.wdir,
+                             stdout=subprocess.PIPE,
+                             stderr=subprocess.PIPE)
+        with lock:
+            procs.append(p)
+            d["outstanding"] += 1
+        for stream, out in ((p.stdout, sys.stdout.buffer),
+                            (p.stderr, sys.stderr.buffer)):
+            t = threading.Thread(
+                target=_forward,
+                args=(stream, out, tag, opts.tag_output), daemon=True)
+            t.start()
+            fwd_threads.append(t)
+
+        def reap() -> None:
+            code = p.wait()
+            sm.activate("EV_PROC_EXIT", code=code, who=f"rank {tag}"
+                        if "-" not in tag else f"ranks {tag}")
+        threading.Thread(target=reap, daemon=True).start()
+
+    def on_launch_apps(sm, info):
+        if opts.prog.endswith(".py"):
+            base_cmd = [sys.executable, opts.prog] + opts.args
+        else:
+            base_cmd = [opts.prog] + opts.args
+        # hybrid mode: one app-shell process per block of rpp ranks,
+        # each running its ranks as threads (the TPU-host model)
+        if hybrid:
+            specs = []
+            base = 0
+            node = 0
+            while base < opts.np:
+                n = min(rpp, opts.np - base)
+                specs.append((base, n, node))
+                base += n
+                node += 1
+            env_base["TPUMPI_DEVICES"] = opts.devices
+        else:
+            specs = [(rank, 0, rank) for rank in range(opts.np)]
+        for base, nlocal, node in specs:
+            env = dict(env_base)
+            if nlocal:  # app shell owning ranks [base, base+nlocal)
+                env["TPUMPI_RANK_BASE"] = str(base)
+                env["TPUMPI_LOCAL_RANKS"] = str(nlocal)
+                env["TPUMPI_LOCAL_SIZE"] = str(nlocal)
+                env["TPUMPI_NODE"] = str(node)
+                cmd = [sys.executable, "-m",
+                       "ompi_tpu.tools.hostrun", opts.prog] + opts.args
+                tag = f"{base}-{base + nlocal - 1}" if nlocal > 1 \
+                    else f"{base}"
+            else:
+                env["TPUMPI_RANK"] = str(base)
+                cmd = base_cmd
+                tag = f"{base}"
+            spawn_proc(cmd, env, tag)
+        server.spawn_enabled = True  # dpm supported on the local path
+        sm.activate(smx.RUNNING)
+
+    def ev_spawn(sm, info):
+        """Launch dynamically spawned jobs (ompi/dpm analog)."""
+        if d.get("drained") or sm.state in (smx.DRAINING,
+                                            smx.TERMINATED):
+            return  # never launch into a torn-down job
+        with server.cv:
+            reqs, server.spawn_requests = server.spawn_requests, []
+        for rq in reqs:
+            base, k = rq["base"], rq["maxprocs"]
+            seg_of = []  # (segment index, cmd) per local index
+            for si, seg in enumerate(rq["segments"]):
+                prog = seg["cmd"]
+                c = [sys.executable, prog] + list(seg["args"]) \
+                    if prog.endswith(".py") \
+                    else [prog] + list(seg["args"])
+                seg_of += [(si, c)] * int(seg["n"])
+            for i in range(k):
+                appnum, cmd0 = seg_of[i]
+                env = dict(env_base)
+                env.update({
+                    "TPUMPI_APPNUM": str(appnum),
+                    "TPUMPI_RANK": str(base + i),
+                    "TPUMPI_SIZE": str(k),
+                    "TPUMPI_WORLD_BASE": str(base),
+                    "TPUMPI_WORLD_SIZE": str(k),
+                    "TPUMPI_UNIVERSE": str(base + k),
+                    "TPUMPI_LOCAL_SIZE": str(k),
+                    "TPUMPI_JOBID": f"job-{os.getpid()}-s{base}",
+                    "TPUMPI_PARENT_ROOT": str(rq["parent_root"]),
+                })
+                env.pop("TPUMPI_RANK_BASE", None)
+                env.pop("TPUMPI_LOCAL_RANKS", None)
+                spawn_proc(cmd0, env, f"s{base + i}")
+
+    def ev_proc_exit(sm, info):
+        with lock:
+            d["outstanding"] -= 1
+            left = d["outstanding"]
+        if d.get("drained") or sm.state in (smx.DRAINING,
+                                            smx.TERMINATED):
+            return
+        if info["code"] != 0:
+            # errmgr default-HNP policy: first abnormal exit kills
+            # the job and its code is the job's code
+            sm.activate(smx.PROC_FAILED, who=info["who"],
+                        code=info["code"], error="")
+        elif left <= 0:
+            sm.activate(smx.DRAINING, failed=False)
+
+    def drain(failed: bool) -> None:
+        if failed:
+            # diagnostic grace: the event-driven abort reaction is
+            # near-instant, but peer shells may still be WRITING their
+            # tracebacks — give them a beat before termination so the
+            # IOF forwarders capture the actual failure, not just ours
+            time.sleep(0.25)
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        t_end = time.monotonic() + 2.0
+        for p in procs:
+            if p.poll() is None and time.monotonic() < t_end:
+                try:
+                    p.wait(timeout=max(0.1, t_end - time.monotonic()))
+                except subprocess.TimeoutExpired:
+                    pass
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for t in fwd_threads:
+            t.join(timeout=1.0)
         server.close()
-    return exit_code
+        shutil.rmtree(session, ignore_errors=True)
+
+    sm.register_table({
+        smx.ALLOCATE: lambda sm, info: sm.activate(smx.MAP),
+        smx.MAP: lambda sm, info: sm.activate(smx.LAUNCH_APPS),
+        smx.LAUNCH_APPS: on_launch_apps,
+        "EV_SPAWN": ev_spawn,
+        "EV_PROC_EXIT": ev_proc_exit,
+    })
+    _errmgr_table(sm, drain)
+    sm.start_timeout(opts.timeout)
+    sm.activate(smx.ALLOCATE)
+    try:
+        return sm.run()
+    finally:
+        if not d.get("drained"):
+            drain(True)
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -137,6 +501,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--tag-output", action="store_true")
     ap.add_argument("--timeout", type=float, default=0.0,
                     help="Kill the job after SEC seconds")
+    ap.add_argument("--verbose", default="", metavar="WHAT",
+                    help="Comma list of subsystems to trace "
+                         "('state': job state-machine transitions)")
     ap.add_argument("--wdir", default=None)
     def _rpp_arg(v: str):
         if v == "all":
@@ -240,180 +607,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     if any(x is not None for x in (opts.hosts, opts.hostfile,
                                    opts.simulate)):
         return run_multinode(opts, nodes, rpp, hybrid)
-
-    session = tempfile.mkdtemp(prefix="tpumpi-session-")
-    server = KVServer(opts.np)
-    procs: List[subprocess.Popen] = []
-    fwd_threads: List[threading.Thread] = []
-    exit_code = 0
-
-    if opts.prog.endswith(".py"):
-        base_cmd = [sys.executable, opts.prog] + opts.args
-    else:
-        base_cmd = [opts.prog] + opts.args
-
-    env_base = dict(os.environ)
-    # children must see the ompi_tpu package regardless of their cwd
-    import ompi_tpu as _pkg
-    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(
-        _pkg.__file__)))
-    env_base["PYTHONPATH"] = pkg_root + (
-        os.pathsep + env_base["PYTHONPATH"]
-        if env_base.get("PYTHONPATH") else "")
-    env_base.update(ckpt_env)
-    env_base.update({
-        "TPUMPI_SIZE": str(opts.np),
-        "TPUMPI_LOCAL_SIZE": str(opts.np),  # single-host launch
-        "TPUMPI_KV_ADDR": server.addr,
-        "TPUMPI_SESSION_DIR": session,
-        "TPUMPI_JOBID": f"job-{os.getpid()}",
-    })
-    for key, value in opts.mca:
-        env_base[f"TPUMPI_MCA_{key}"] = value
-
-    # hybrid mode: one app-shell process per block of rpp ranks, each
-    # running its ranks as threads (the TPU-host execution model)
-    if hybrid:
-        spawn_specs = []
-        base = 0
-        node = 0
-        while base < opts.np:
-            n = min(rpp, opts.np - base)
-            spawn_specs.append((base, n, node))
-            base += n
-            node += 1
-        env_base["TPUMPI_DEVICES"] = opts.devices
-    else:
-        spawn_specs = [(rank, 0, rank) for rank in range(opts.np)]
-
-    try:
-        for base, nlocal, node in spawn_specs:
-            env = dict(env_base)
-            if nlocal:  # app shell owning ranks [base, base+nlocal)
-                env["TPUMPI_RANK_BASE"] = str(base)
-                env["TPUMPI_LOCAL_RANKS"] = str(nlocal)
-                env["TPUMPI_LOCAL_SIZE"] = str(nlocal)
-                env["TPUMPI_NODE"] = str(node)
-                cmd = [sys.executable, "-m", "ompi_tpu.tools.hostrun",
-                       opts.prog] + opts.args
-                tag = f"{base}-{base + nlocal - 1}" if nlocal > 1 \
-                    else f"{base}"
-            else:
-                env["TPUMPI_RANK"] = str(base)
-                cmd = base_cmd
-                tag = f"{base}"
-            p = subprocess.Popen(
-                cmd, env=env, cwd=opts.wdir,
-                stdout=subprocess.PIPE, stderr=subprocess.PIPE)
-            procs.append(p)
-            for stream, out in ((p.stdout, sys.stdout.buffer),
-                                (p.stderr, sys.stderr.buffer)):
-                t = threading.Thread(
-                    target=_forward,
-                    args=(stream, out, tag, opts.tag_output),
-                    daemon=True)
-                t.start()
-                fwd_threads.append(t)
-
-        deadline = time.monotonic() + opts.timeout if opts.timeout else None
-        server.spawn_enabled = True  # dpm supported on the local path
-
-        def drain_spawns() -> None:
-            """Launch dynamically spawned jobs (ompi/dpm analog)."""
-            with server.cv:
-                reqs, server.spawn_requests = server.spawn_requests, []
-            for rq in reqs:
-                base, k = rq["base"], rq["maxprocs"]
-                seg_of = []  # (segment index, cmd) per local index
-                for si, seg in enumerate(rq["segments"]):
-                    prog = seg["cmd"]
-                    c = [sys.executable, prog] + list(seg["args"]) \
-                        if prog.endswith(".py") \
-                        else [prog] + list(seg["args"])
-                    seg_of += [(si, c)] * int(seg["n"])
-                for i in range(k):
-                    appnum, cmd0 = seg_of[i]
-                    env = dict(env_base)
-                    env.update({
-                        "TPUMPI_APPNUM": str(appnum),
-                        "TPUMPI_RANK": str(base + i),
-                        "TPUMPI_SIZE": str(k),
-                        "TPUMPI_WORLD_BASE": str(base),
-                        "TPUMPI_WORLD_SIZE": str(k),
-                        "TPUMPI_UNIVERSE": str(base + k),
-                        "TPUMPI_LOCAL_SIZE": str(k),
-                        "TPUMPI_JOBID": f"job-{os.getpid()}-s{base}",
-                        "TPUMPI_PARENT_ROOT": str(rq["parent_root"]),
-                    })
-                    env.pop("TPUMPI_RANK_BASE", None)
-                    env.pop("TPUMPI_LOCAL_RANKS", None)
-                    p = subprocess.Popen(
-                        cmd0, env=env, cwd=opts.wdir,
-                        stdout=subprocess.PIPE, stderr=subprocess.PIPE)
-                    procs.append(p)
-                    spawn_specs.append((base + i, 0, -1))
-                    for stream, out in ((p.stdout, sys.stdout.buffer),
-                                        (p.stderr, sys.stderr.buffer)):
-                        t = threading.Thread(
-                            target=_forward,
-                            args=(stream, out, f"s{base + i}",
-                                  opts.tag_output),
-                            daemon=True)
-                        t.start()
-                        fwd_threads.append(t)
-
-        # errmgr default-HNP policy: first abnormal exit (or KV abort)
-        # kills the job and its code is the job's code
-        while True:
-            drain_spawns()
-            alive = [p for p in procs if p.poll() is None]
-            failed = [p for p in procs
-                      if p.returncode not in (None, 0)]
-            if server.aborted is not None:
-                exit_code = server.aborted[1] or 1
-                sys.stderr.write(
-                    f"mpirun: rank {server.aborted[0]} called "
-                    f"MPI_Abort({exit_code}): {server.aborted[2]}\n")
-                break
-            if failed:
-                p = failed[0]
-                exit_code = p.returncode if p.returncode > 0 else 1
-                base, nlocal, _ = spawn_specs[procs.index(p)]
-                who = f"rank {base}" if nlocal <= 1 else \
-                    f"ranks {base}-{base + nlocal - 1}"
-                sys.stderr.write(
-                    f"mpirun: {who} exited with status "
-                    f"{p.returncode}; terminating remaining "
-                    f"{len(alive)} processes\n")
-                break
-            if not alive:
-                break
-            if deadline is not None and time.monotonic() > deadline:
-                sys.stderr.write(
-                    f"mpirun: job exceeded --timeout "
-                    f"{opts.timeout}s; killing\n")
-                exit_code = 124
-                break
-            time.sleep(0.02)
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.terminate()
-        t_end = time.monotonic() + 2.0
-        for p in procs:
-            if p.poll() is None and time.monotonic() < t_end:
-                try:
-                    p.wait(timeout=max(0.1, t_end - time.monotonic()))
-                except subprocess.TimeoutExpired:
-                    pass
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-        for t in fwd_threads:
-            t.join(timeout=1.0)
-        server.close()
-        shutil.rmtree(session, ignore_errors=True)
-    return exit_code
+    return run_local(opts, rpp, hybrid, ckpt_env)
 
 
 if __name__ == "__main__":

@@ -9,11 +9,13 @@ launch_apps, :855-1176 daemon report-in).  The HNP:
      subprocesses for simulated nodes); each daemon tree-spawns its
      subtree (ref: plm_rsh_module.c tree launch) and every daemon
      connects *directly* back here (routed/direct model);
-  2. waits for all daemons to register (report-in);
+  2. posts daemon report-ins, proc exits, node completions and
+     connection losses as EVENTS into the job state machine
+     (runtime/statemachine.py — the orte/mca/state analog); the
+     errmgr policy lives in the machine's state handlers
+     (tools/mpirun.py), not here;
   3. ships each daemon its slice of the job map (launch message);
-  4. relays IOF lines, collects proc-exit reports, and applies the
-     default-HNP errmgr policy: first abnormal exit, daemon loss or
-     KV abort kills the whole job everywhere.
+  4. relays IOF lines directly (data plane, no state involvement).
 """
 
 from __future__ import annotations
@@ -54,12 +56,17 @@ def build_tree(nodes: List[Node], radix: int) -> List[dict]:
 class HNP:
     def __init__(self, maps: List[NodeMap], agent: str, python: str,
                  pythonpath: str, tree_radix: int = 32,
-                 bind_all: bool = False) -> None:
+                 bind_all: bool = False, events=None) -> None:
+        """``events``: the job StateMachine — every daemon-side
+        happening is posted there (EV_DAEMON_UP / EV_PROC_EXIT /
+        EV_NODE_DONE / EV_DAEMON_LOST / EV_CONN_LOST) and the
+        machine's handlers decide policy."""
         self.maps = maps
         self.agent = agent
         self.python = python
         self.pythonpath = pythonpath
         self.tree_radix = max(1, tree_radix)
+        self.events = events
         self.listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self.listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self.listener.bind(("0.0.0.0" if bind_all else "127.0.0.1", 0))
@@ -67,12 +74,7 @@ class HNP:
         self.port = self.listener.getsockname()[1]
         self.channels: Dict[int, oob.Channel] = {}
         self.lock = threading.Lock()
-        self.cv = threading.Condition(self.lock)
         self.daemon_procs: List[subprocess.Popen] = []
-        self.failures: List[Tuple[str, int, str]] = []  # (tag, code, err)
-        self.nodes_done: set = set()
-        self.lost_daemons: List[int] = []
-        self.unregistered_losses = 0
         self.tag_output = False
         self._stop = False
         self._accept_thread = threading.Thread(target=self._accept_loop,
@@ -113,17 +115,15 @@ class HNP:
 
             def on_close(_exc, _holder=holder) -> None:
                 node = _holder[0]
-                with self.cv:
-                    if node is None:
-                        # a connection died before registering — fail
-                        # registration fast, but never abort a running
-                        # job over it (could be a stray probe)
-                        self.unregistered_losses += 1
-                    else:
-                        if node not in self.nodes_done:
-                            self.lost_daemons.append(node)
+                if node is None:
+                    # a connection died before registering — fail
+                    # registration fast, but never abort a running
+                    # job over it (could be a stray probe)
+                    self.events.activate("EV_CONN_LOST")
+                else:
+                    with self.lock:
                         self.channels.pop(node, None)
-                    self.cv.notify_all()
+                    self.events.activate("EV_DAEMON_LOST", node=node)
 
             ch = oob.Channel(conn, handle, on_close)
             holder.append(ch)
@@ -133,12 +133,12 @@ class HNP:
         op = msg.get("op")
         if op == "register":
             node = msg["node"]
-            with self.cv:
+            with self.lock:
                 holder[0] = node
                 # holder[1] is the Channel (appended in _accept_loop)
                 if len(holder) > 1:
                     self.channels[node] = holder[1]
-                self.cv.notify_all()
+            self.events.activate("EV_DAEMON_UP", node=node)
         elif op == "iof":
             out = sys.stdout.buffer if msg["stream"] == "out" \
                 else sys.stderr.buffer
@@ -150,33 +150,19 @@ class HNP:
             out.flush()
         elif op == "proc_exit":
             if msg["code"] != 0:
-                with self.cv:
-                    self.failures.append(
-                        (msg["tag"], msg["code"], msg.get("error", "")))
-                    self.cv.notify_all()
+                self.events.activate(
+                    "EV_PROC_EXIT", tag=msg["tag"], code=msg["code"],
+                    error=msg.get("error", ""))
         elif op == "node_done":
-            with self.cv:
-                self.nodes_done.add(msg["node"])
-                self.cv.notify_all()
-
-    def wait_registered(self, timeout: float = 90.0) -> bool:
-        want = {m.node.node_id for m in self.maps}
-        deadline = time.monotonic() + timeout
-        with self.cv:
-            while set(self.channels) != want:
-                left = deadline - time.monotonic()
-                if left <= 0 or self.lost_daemons \
-                        or self.unregistered_losses:
-                    return False
-                self.cv.wait(timeout=min(left, 0.5))
-        return True
+            self.events.activate("EV_NODE_DONE", node=msg["node"])
 
     # ---- job launch + supervision ----------------------------------
     def launch(self, prog: str, args: List[str],
                env: Dict[str, str], wdir: Optional[str]) -> None:
         for m in self.maps:
             if not m.procs:
-                self.nodes_done.add(m.node.node_id)
+                self.events.activate("EV_NODE_DONE",
+                                     node=m.node.node_id)
                 continue
             nid = m.node.node_id
             try:
@@ -189,54 +175,9 @@ class HNP:
                                "nlocal": p.nlocal} for p in m.procs],
                 })
             except (KeyError, ConnectionError, OSError):
-                # daemon died between report-in and launch: let the
-                # supervise loop apply the errmgr policy
-                with self.cv:
-                    if nid not in self.lost_daemons:
-                        self.lost_daemons.append(nid)
-                    self.cv.notify_all()
-
-    def supervise(self, kv_server, timeout: float = 0.0) -> int:
-        """The mpirun wait loop, multi-node edition."""
-        active = {m.node.node_id for m in self.maps if m.procs}
-        deadline = time.monotonic() + timeout if timeout else None
-        exit_code = 0
-        while True:
-            with self.cv:
-                if kv_server.aborted is not None:
-                    exit_code = kv_server.aborted[1] or 1
-                    sys.stderr.write(
-                        f"mpirun: rank {kv_server.aborted[0]} called "
-                        f"MPI_Abort({exit_code}): "
-                        f"{kv_server.aborted[2]}\n")
-                    break
-                if self.failures:
-                    tag, code, err = self.failures[0]
-                    exit_code = code if code > 0 else 1
-                    extra = f" ({err})" if err else ""
-                    sys.stderr.write(
-                        f"mpirun: {tag} exited with status "
-                        f"{code}{extra}; terminating job\n")
-                    break
-                if self.lost_daemons:
-                    exit_code = 1
-                    sys.stderr.write(
-                        f"mpirun: lost contact with daemon on node(s) "
-                        f"{sorted(self.lost_daemons)}; terminating "
-                        f"job\n")
-                    break
-                if active <= self.nodes_done:
-                    break
-                left = None if deadline is None \
-                    else deadline - time.monotonic()
-                if left is not None and left <= 0:
-                    sys.stderr.write(
-                        f"mpirun: job exceeded --timeout; killing\n")
-                    exit_code = 124
-                    break
-                self.cv.wait(timeout=0.2 if left is None
-                             else min(0.2, left))
-        return exit_code
+                # daemon died between report-in and launch: the
+                # machine's DAEMON_FAILED handler applies the policy
+                self.events.activate("EV_DAEMON_LOST", node=nid)
 
     def shutdown(self, failed: bool) -> None:
         op = "kill" if failed else "exit"

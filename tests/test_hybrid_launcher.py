@@ -67,12 +67,14 @@ def test_hybrid_rank_failure_kills_job():
         with open(prog, "w") as f:
             f.write(textwrap.dedent("""
                 import ompi_tpu
+                from ompi_tpu.op import op as mpi_op
                 comm = ompi_tpu.init()
                 if comm.rank == 1:
                     raise RuntimeError("boom on rank 1")
                 import numpy as np
                 x = np.zeros(1, np.int32)
-                comm.Allreduce(x, x)
+                r = np.zeros(1, np.int32)
+                comm.Allreduce(x, r, mpi_op.SUM)
                 ompi_tpu.finalize()
             """))
         cmd = [sys.executable, "-m", "ompi_tpu.tools.mpirun", "-np", "4",

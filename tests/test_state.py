@@ -1,0 +1,110 @@
+"""Job state machine (runtime/statemachine — orte/mca/state analog):
+transition sequencing, error-state policy, and the --verbose state
+trace through a real mpirun launch."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from ompi_tpu.runtime import statemachine as smx
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_machine_runs_lifecycle_in_order():
+    sm = smx.StateMachine("hnp")
+    seen = []
+
+    def step(next_state):
+        def h(sm, info):
+            seen.append(sm.state)
+            if next_state is not None:
+                sm.activate(next_state)
+        return h
+
+    sm.register_table({
+        smx.ALLOCATE: step(smx.MAP),
+        smx.MAP: step(smx.LAUNCH_APPS),
+        smx.LAUNCH_APPS: step(smx.RUNNING),
+        smx.RUNNING: step(smx.DRAINING),
+        smx.DRAINING: step(smx.TERMINATED),
+        smx.TERMINATED: step(None),
+    })
+    sm.activate(smx.ALLOCATE)
+    assert sm.run() == 0
+    assert seen == [smx.ALLOCATE, smx.MAP, smx.LAUNCH_APPS,
+                    smx.RUNNING, smx.DRAINING, smx.TERMINATED]
+
+
+def test_error_state_carries_exit_code():
+    sm = smx.StateMachine("hnp")
+
+    def on_fail(sm, info):
+        sm.exit_code = info["code"]
+        sm.activate(smx.TERMINATED)
+
+    sm.register(smx.PROC_FAILED, on_fail)
+    sm.register(smx.TERMINATED, lambda sm, info: None)
+    sm.activate(smx.PROC_FAILED, code=7)
+    assert sm.run() == 7
+
+
+def test_events_do_not_change_state():
+    sm = smx.StateMachine("hnp")
+    hits = []
+    sm.register("EV_PING", lambda sm, info: hits.append(sm.state))
+    sm.register(smx.RUNNING, lambda sm, info: None)
+    sm.register(smx.TERMINATED, lambda sm, info: None)
+    sm.activate(smx.RUNNING)
+    sm.activate("EV_PING")
+    sm.activate(smx.TERMINATED)
+    sm.run()
+    # the EV_ handler observed RUNNING — events never rename the state
+    assert hits == [smx.RUNNING]
+
+
+def test_cross_thread_activation():
+    import threading
+    sm = smx.StateMachine("hnp")
+    sm.register(smx.RUNNING, lambda sm, info: None)
+    sm.register("EV_DONE",
+                lambda sm, info: sm.activate(smx.TERMINATED))
+    sm.register(smx.TERMINATED, lambda sm, info: None)
+    sm.activate(smx.RUNNING)
+    threading.Timer(0.05, lambda: sm.activate("EV_DONE")).start()
+    assert sm.run() == 0
+
+
+def test_verbose_state_trace_under_mpirun():
+    """--verbose state prints every lifecycle transition (the VERDICT
+    r2 requirement for the state-machine re-design)."""
+    r = subprocess.run(
+        [sys.executable, "-m", "ompi_tpu.tools.mpirun", "-np", "2",
+         "--timeout", "60", "--verbose", "state",
+         os.path.join(REPO, "examples", "hello.py")],
+        capture_output=True, timeout=120)
+    assert r.returncode == 0, r.stderr.decode()
+    err = r.stderr.decode()
+    for arrow in ("INIT -> ALLOCATE", "ALLOCATE -> MAP",
+                  "MAP -> LAUNCH_APPS", "LAUNCH_APPS -> RUNNING",
+                  "RUNNING -> DRAINING", "DRAINING -> TERMINATED"):
+        assert arrow in err, err
+
+
+def test_verbose_state_trace_multinode():
+    """The PLM path walks the daemon states too."""
+    r = subprocess.run(
+        [sys.executable, "-m", "ompi_tpu.tools.mpirun", "-np", "2",
+         "--timeout", "120", "--verbose", "state",
+         "--simulate-nodes", "2x1", "--devices", "none",
+         os.path.join(REPO, "examples", "hello.py")],
+        capture_output=True, timeout=180)
+    assert r.returncode == 0, r.stderr.decode()
+    err = r.stderr.decode()
+    for arrow in ("MAP -> LAUNCH_DAEMONS",
+                  "LAUNCH_DAEMONS -> DAEMONS_REPORTED",
+                  "DAEMONS_REPORTED -> LAUNCH_APPS",
+                  "RUNNING -> DRAINING", "DRAINING -> TERMINATED"):
+        assert arrow in err, err
