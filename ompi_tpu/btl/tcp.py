@@ -43,6 +43,11 @@ _if_ip_var = registry.register(
     help="IP to advertise for inbound btl connections (the opal if/"
          "reachable analog; set per-node by the tpud daemon from the "
          "route toward the HNP).  Empty = loopback, single-host.")
+_advertise_all_var = registry.register(
+    "btl", "tcp", "advertise_all", False, bool,
+    help="Bind wildcard and advertise EVERY up NIC in the modex; "
+         "dialing peers pick the best pair by reachable/weighted "
+         "scoring.  Off = traffic stays on btl_tcp_if_ip only.")
 
 
 class _Conn:
@@ -67,17 +72,36 @@ class TcpModule(BTLModule):
         self.rank = state.rank
         self.sel = selectors.DefaultSelector()
         if_ip = _if_ip_var.value or "127.0.0.1"
+        advertise_all = _advertise_all_var.value
         self.listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self.listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        # bind the advertised IP itself: cross-host peers dial it, and
-        # loopback-only jobs never open a network-reachable port
-        self.listener.bind((if_ip, 0))
+        # default: bind the advertised IP itself — loopback-only jobs
+        # never open a network-reachable port, and a configured if_ip
+        # keeps traffic OFF other interfaces (the btl_tcp_if_include
+        # discipline).  btl_tcp_advertise_all opts into wildcard bind
+        # + multi-NIC advertising with reachable scoring.
+        bind_ip = "0.0.0.0" if (advertise_all
+                                and if_ip != "127.0.0.1") else if_ip
+        self.listener.bind((bind_ip, 0))
         self.listener.listen(state.size * 2)
         self.listener.setblocking(False)
         self.sel.register(self.listener, selectors.EVENT_READ,
                           ("accept", None))
         port = self.listener.getsockname()[1]
         state.rte.modex_put("btl_tcp_addr", f"{if_ip}:{port}")
+        # multi-NIC: advertise every usable address (reachable analog,
+        # ref: opal/mca/reachable/weighted); the dialing side scores
+        # each against its own NICs and picks the best pair.  Always
+        # published (single-addr configs advertise just if_ip) so the
+        # connector's lookup never blocks on a missing key.
+        if advertise_all and if_ip != "127.0.0.1":
+            from ompi_tpu.runtime import reachable
+            addrs = [if_ip] + [a for a in reachable.advertised_addrs()
+                               if a != if_ip]
+        else:
+            addrs = [if_ip]
+        state.rte.modex_put("btl_tcp_addrs",
+                            [f"{a}:{port}" for a in addrs])
         self._out: Dict[int, _Conn] = {}
         self._in: List[_Conn] = []
         # inbound sockets double as idle-selector wakeup fds: a rank
@@ -94,6 +118,20 @@ class TcpModule(BTLModule):
         if conn is not None:
             return conn
         addr = self.state.rte.modex_get(peer, "btl_tcp_addr")
+        try:
+            # multi-NIC peers advertise every address; score each
+            # against our NICs and dial the best pair (reachable
+            # analog).  Single-addr peers skip the lookup.
+            addrs = self.state.rte.modex_get(peer, "btl_tcp_addrs")
+        except Exception:
+            addrs = None
+        if addrs and len(addrs) > 1:
+            from ompi_tpu.runtime import reachable
+            best = reachable.pick_remote_addr(
+                [a.rsplit(":", 1)[0] for a in addrs])
+            if best is not None:
+                addr = next(a for a in addrs
+                            if a.rsplit(":", 1)[0] == best)
         host, port = addr.rsplit(":", 1)
         s = socket.create_connection((host, int(port)), timeout=30)
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)

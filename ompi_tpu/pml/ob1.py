@@ -31,6 +31,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ompi_tpu import memchecker, peruse
 from ompi_tpu.datatype.convertor import Convertor, make_convertor
 from ompi_tpu.mca.base import Component, frameworks
 from ompi_tpu.mca.params import registry
@@ -62,7 +63,7 @@ FRAG = "F"
 
 
 class SendRequest(Request):
-    __slots__ = ("conv", "req_id", "total", "dst", "acked")
+    __slots__ = ("conv", "req_id", "total", "dst", "acked", "mc_crc")
 
     def __init__(self, progress, conv, req_id, dst):
         super().__init__(progress)
@@ -172,6 +173,9 @@ class PmlOb1:
         req = SendRequest(self.state.progress, conv, req_id, gdst)
         req.status.count = conv.packed_size
         self.pvar_sent.add(conv.packed_size)
+        if peruse.enabled:
+            peruse.fire("req_activate", kind="send", cid=cid, peer=dst,
+                        tag=tag, bytes=conv.packed_size)
         if tag >= 0:
             self.cr_sent[gdst] = self.cr_sent.get(gdst, 0) + 1
 
@@ -182,12 +186,17 @@ class PmlOb1:
             payload = conv.pack_bytes()
             btl.send(gdst, (MATCH, cid, src, tag, seq, gsrc, payload))
             req._complete()
+            if peruse.enabled:
+                peruse.fire("req_complete", kind="send",
+                            bytes=req.total)
         elif conv.packed_size <= btl.eager_limit:  # sync eager
             payload = conv.pack_bytes()
             self._send_reqs[req_id] = req
             btl.send(gdst, (MATCH_SYNC, cid, src, tag, seq, gsrc,
                             req_id, payload))
         else:
+            if memchecker.enabled():
+                req.mc_crc = memchecker.send_checksum(conv)
             head = conv.pack_bytes(btl.eager_limit)
             self._send_reqs[req_id] = req
             btl.send(gdst, (RNDV, cid, src, tag, seq, gsrc,
@@ -215,6 +224,11 @@ class PmlOb1:
                           comm.cid)
         req._canceller = self.cancel_recv
         self._recv_reqs[req_id] = req
+        if peruse.enabled:
+            peruse.fire("req_activate", kind="recv", cid=comm.cid,
+                        peer=src, tag=tag, bytes=conv.packed_size)
+        if memchecker.enabled() and buf is not None:
+            memchecker.poison_recv(conv)
         # match against buffered unexpected messages first
         msg = self._match_unexpected(req)
         if msg is not None:
@@ -342,6 +356,9 @@ class PmlOb1:
     def _finish_recv(self, req: RecvRequest) -> None:
         self._recv_reqs.pop(req.req_id, None)
         req._complete()
+        if peruse.enabled:
+            peruse.fire("req_complete", kind="recv",
+                        bytes=req.status.count)
 
     def state_comm_peer(self, cid: int, comm_rank: int) -> int:
         comm = self.state.comms.get(cid)
@@ -388,6 +405,9 @@ class PmlOb1:
             req = self._send_reqs.pop(sreq_id, None)
             if req is not None:
                 req._complete()
+                if peruse.enabled:
+                    peruse.fire("req_complete", kind="send",
+                                bytes=req.total)
         elif kind == FRAG:
             _, rreq_id, pos, payload = frag
             self._recv_segment(rreq_id, pos, payload)
@@ -400,8 +420,14 @@ class PmlOb1:
         self._advance_seq(msg.cid, msg.src)
         req = self._match_posted(msg.cid, msg.src, msg.tag)
         if req is not None:
+            if peruse.enabled:
+                peruse.fire("req_match", cid=msg.cid, peer=msg.src,
+                            tag=msg.tag, bytes=msg.total)
             self._bind(req, msg)
         else:
+            if peruse.enabled:
+                peruse.fire("req_match_unex", cid=msg.cid,
+                            peer=msg.src, tag=msg.tag, bytes=msg.total)
             self._unexpected.setdefault(msg.cid, []).append(msg)
 
     def _send_rest(self, sreq_id: int, rreq_id: int) -> None:
@@ -415,7 +441,13 @@ class PmlOb1:
             pos = conv.position
             payload = conv.pack_bytes(btl.max_send_size)
             btl.send(req.dst, (FRAG, rreq_id, pos, payload))
+        if memchecker.enabled():
+            memchecker.verify_send(
+                conv, getattr(req, "mc_crc", None),
+                f"rendezvous send req {sreq_id}")
         req._complete()
+        if peruse.enabled:
+            peruse.fire("req_complete", kind="send", bytes=req.total)
 
     def _recv_segment(self, rreq_id: int, pos: int, payload: bytes) -> None:
         req = self._recv_reqs.get(rreq_id)

@@ -26,6 +26,32 @@ def mpi_init(state: ProcState, device=None) -> ProcState:
 
     set_current(state)
     state.device = device
+    # debugger attach support (MPIR analog, ref: ompi/debuggers):
+    # SIGUSR1 dumps every thread's stack to stderr so
+    # ompi_tpu.tools.attach --stacks can show where a hung job is
+    # stuck; binding (rtc/hwloc analog) applies TPUMPI_BIND
+    try:
+        import faulthandler
+        import signal as _signal
+        faulthandler.register(_signal.SIGUSR1, all_threads=True,
+                              chain=True)
+    except (ImportError, AttributeError, ValueError, OSError):
+        pass  # non-main thread or unsupported platform
+    from ompi_tpu.runtime import topology as _topo
+    _world = getattr(state.rte, "world", None)
+    if _world is not None:
+        # thread-rank: sched_setaffinity(0) binds the calling THREAD,
+        # so each rank-thread binds itself by its local index
+        _local_rank = state.rank - getattr(_world, "rank_base", 0)
+    else:
+        # process-rank: the launcher exports the rank's index WITHIN
+        # its node (never the global rank — that would misbind every
+        # node after the first)
+        _local_rank = int(os.environ.get("TPUMPI_LOCAL_RANK", "0"))
+    try:
+        _topo.apply_binding(_local_rank)
+    except (ValueError, OSError):
+        pass
     # refine the oversubscription hint with the true local-rank count:
     # thread-rank worlds (inproc/hybrid) know it exactly; process-ranks
     # read the launcher's TPUMPI_LOCAL_SIZE (ref: the reference

@@ -1,13 +1,28 @@
 """RMAPS analog: map ranks onto the allocated nodes.
 
-Re-design of orte/mca/rmaps (round_robin component's byslot/bynode
-policies, ref: orte/mca/rmaps/round_robin): the map is the launch
-blueprint shipped to each node's daemon.  Two shapes per node:
+Re-design of orte/mca/rmaps: the map is the launch blueprint shipped
+to each node's daemon.  Policies:
+
+  * ``byslot`` / ``bynode`` — round_robin component (ref:
+    orte/mca/rmaps/round_robin): fill nodes to slot capacity vs
+    round-robin across nodes;
+  * ``ppr:N:node`` — procs-per-resource (ref: orte/mca/rmaps/ppr):
+    exactly N ranks per node, node order;
+  * ``seq`` — sequential mapper (ref: orte/mca/rmaps/seq): strict
+    round-robin in allocation order, ignoring slot counts;
+  * ``rankfile:PATH`` — explicit placement (ref:
+    orte/mca/rmaps/rank_file): lines ``rank R=nodename`` (or
+    ``R nodename``); every rank must be assigned exactly once.
+
+Within-node placement (cores/NUMA — the mindist concern) is handled
+by binding at rank bring-up (runtime/topology.py, --bind-to).
+
+Two launch-unit shapes per node:
 
   * classic — one process per rank (blocks of nlocal=0 below);
   * hybrid  — rank-threads grouped into app shells of ``rpp`` ranks
     (the TPU-host model; requires *contiguous* global ranks per shell,
-    which is why bynode mapping is rejected when rpp > 1).
+    which is why non-contiguous mappings are rejected when rpp > 1).
 """
 
 from __future__ import annotations
@@ -49,20 +64,91 @@ def map_ranks(nodes: List[Node], np: int, rpp: int = 1,
     many rank-threads (capped per node by its slot count and the ranks
     assigned to it)."""
     total_slots = sum(n.slots for n in nodes)
-    if np > total_slots and not oversubscribe:
+    base_policy = policy.split(":", 1)[0]
+    if base_policy not in ("byslot", "bynode", "ppr", "seq",
+                           "rankfile"):
+        raise ValueError(f"unknown mapping policy {policy!r}")
+    if np > total_slots and not oversubscribe \
+            and base_policy not in ("seq", "rankfile", "ppr"):
         raise ValueError(
             f"not enough slots: {np} ranks > {total_slots} slots "
             f"(use --oversubscribe)")
-    if policy not in ("byslot", "bynode"):
-        raise ValueError(f"unknown mapping policy {policy!r}")
-    if rpp > 1 and policy == "bynode":
+    if rpp > 1 and base_policy not in ("byslot", "ppr"):
         raise ValueError(
-            "--ranks-per-proc > 1 requires byslot mapping (app shells "
-            "own contiguous rank blocks)")
+            "--ranks-per-proc > 1 requires a contiguous mapping "
+            "(byslot or ppr: app shells own contiguous rank blocks)")
 
     # ranks → nodes
     per_node: List[List[int]] = [[] for _ in nodes]
-    if policy == "byslot":
+    if base_policy == "ppr":
+        # ppr:N:node — exactly N ranks per node in node order
+        parts = policy.split(":")
+        if len(parts) != 3 or parts[2] != "node":
+            raise ValueError(
+                f"ppr policy must be 'ppr:N:node', got {policy!r}")
+        try:
+            n_per = int(parts[1])
+        except ValueError:
+            raise ValueError(f"bad ppr count in {policy!r}") from None
+        if n_per < 1:
+            raise ValueError("ppr count must be >= 1")
+        if np > n_per * len(nodes):
+            raise ValueError(
+                f"ppr:{n_per}:node places at most "
+                f"{n_per * len(nodes)} ranks < {np}")
+        rank = 0
+        for i in range(len(nodes)):
+            take = min(n_per, np - rank)
+            per_node[i] = list(range(rank, rank + take))
+            rank += take
+            if rank >= np:
+                break
+    elif base_policy == "seq":
+        # strict round-robin in allocation order, slots ignored
+        for rank in range(np):
+            per_node[rank % len(nodes)].append(rank)
+    elif base_policy == "rankfile":
+        _, _, path = policy.partition(":")
+        if not path:
+            raise ValueError("rankfile policy needs a path "
+                             "(rankfile:PATH)")
+        by_name = {n.name: i for i, n in enumerate(nodes)}
+        placed = {}
+        with open(path) as fh:
+            for ln, line in enumerate(fh, 1):
+                line = line.split("#", 1)[0].strip()
+                if not line:
+                    continue
+                # 'rank R=node' (reference syntax) or 'R node'
+                try:
+                    if line.startswith("rank") and "=" in line:
+                        rpart, npart = line[4:].split("=", 1)
+                        r, name = int(rpart.strip()), npart.split()[0]
+                    else:
+                        toks = line.split()
+                        r, name = int(toks[0]), toks[1]
+                except (ValueError, IndexError):
+                    raise ValueError(
+                        f"rankfile line {ln}: malformed entry "
+                        f"{line!r}") from None
+                if not 0 <= r < np:
+                    raise ValueError(
+                        f"rankfile line {ln}: rank {r} out of range "
+                        f"for -np {np}")
+                if name not in by_name:
+                    raise ValueError(
+                        f"rankfile line {ln}: unknown node {name!r}")
+                if r in placed:
+                    raise ValueError(
+                        f"rankfile line {ln}: rank {r} placed twice")
+                placed[r] = by_name[name]
+        missing = [r for r in range(np) if r not in placed]
+        if missing:
+            raise ValueError(
+                f"rankfile leaves rank(s) {missing} unplaced")
+        for r in range(np):
+            per_node[placed[r]].append(r)
+    if base_policy == "byslot":
         # within capacity: fill each node to its slots in order.
         # oversubscribed: contiguous slot-proportional shares (largest-
         # remainder), preserving the per-node contiguity the hybrid
@@ -85,7 +171,7 @@ def map_ranks(nodes: List[Node], np: int, rpp: int = 1,
         for i, take in enumerate(shares):
             per_node[i] = list(range(rank, rank + take))
             rank += take
-    else:  # bynode round-robin
+    elif base_policy == "bynode":  # round-robin
         i = 0
         counts = [0] * len(nodes)
         for rank in range(np):

@@ -1,0 +1,217 @@
+"""Host topology detection + process binding — the hwloc/rtc analog.
+
+Re-design of opal/mca/hwloc (embedded hwloc topology objects,
+ref: opal/mca/hwloc/hwloc.h) and orte/mca/rtc/hwloc (cpu binding
+applied pre-exec, ref: orte/mca/rtc/hwloc/rtc_hwloc.c).  The
+reference embeds all of hwloc (~40 kLoC) to model caches, packages
+and PCI; for a TPU-host framework the model that matters is
+
+    host -> NUMA node -> cpus
+         -> accelerator devices (chips), with ICI neighbor order
+
+so detection reads sysfs directly (Linux) with a portable fallback,
+and device locality comes from the JAX device table (``coords`` on
+real TPUs encode the ICI torus position — rank->chip->ICI-neighbor
+placement IS the performance model on pods).
+
+Binding policy (the rtc analog) is applied in-process via
+``os.sched_setaffinity`` at rank bring-up: mpirun exports
+``TPUMPI_BIND=core|numa|none`` and each rank binds itself using its
+local rank index — same effect as the reference's pre-exec binding,
+without needing a privileged helper.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Dict, List, Optional, Tuple
+
+
+def _read_int(path: str, default: int = -1) -> int:
+    try:
+        with open(path) as fh:
+            return int(fh.read().strip())
+    except (OSError, ValueError):
+        return default
+
+
+def _parse_cpulist(text: str) -> List[int]:
+    """Parse a sysfs cpulist ('0-3,8,10-11') into cpu ids."""
+    out: List[int] = []
+    for part in text.strip().split(","):
+        if not part:
+            continue
+        if "-" in part:
+            lo, hi = part.split("-", 1)
+            out.extend(range(int(lo), int(hi) + 1))
+        else:
+            out.append(int(part))
+    return out
+
+
+class CpuInfo:
+    __slots__ = ("cpu", "core", "package", "numa")
+
+    def __init__(self, cpu: int, core: int, package: int,
+                 numa: int) -> None:
+        self.cpu = cpu
+        self.core = core
+        self.package = package
+        self.numa = numa
+
+    def __repr__(self) -> str:
+        return (f"CpuInfo(cpu={self.cpu}, core={self.core}, "
+                f"pkg={self.package}, numa={self.numa})")
+
+
+class Topology:
+    """One host's hardware layout (the hwloc topology object analog)."""
+
+    def __init__(self, cpus: List[CpuInfo],
+                 numa_nodes: Dict[int, List[int]]) -> None:
+        self.cpus = cpus
+        self.numa_nodes = numa_nodes  # numa id -> cpu ids
+
+    # -- queries (hwloc_get_nbobjs_by_type analogs) --------------------
+    @property
+    def ncpus(self) -> int:
+        return len(self.cpus)
+
+    @property
+    def ncores(self) -> int:
+        return len({(c.package, c.core) for c in self.cpus})
+
+    @property
+    def npackages(self) -> int:
+        return len({c.package for c in self.cpus})
+
+    @property
+    def nnuma(self) -> int:
+        return max(1, len(self.numa_nodes))
+
+    def cpus_of_numa(self, numa: int) -> List[int]:
+        return self.numa_nodes.get(numa, [c.cpu for c in self.cpus])
+
+    def numa_of_cpu(self, cpu: int) -> int:
+        for c in self.cpus:
+            if c.cpu == cpu:
+                return max(0, c.numa)
+        return 0
+
+    def core_groups(self) -> List[List[int]]:
+        """cpu ids grouped by physical core (SMT siblings together),
+        in core order — the bind-to-core unit."""
+        groups: Dict[Tuple[int, int], List[int]] = {}
+        for c in self.cpus:
+            groups.setdefault((c.package, c.core), []).append(c.cpu)
+        return [groups[k] for k in sorted(groups)]
+
+    def summary(self) -> str:
+        return (f"{self.npackages} package(s) x {self.ncores} core(s) "
+                f"/ {self.ncpus} cpu(s), {self.nnuma} NUMA node(s)")
+
+
+def detect() -> Topology:
+    """Detect this host's topology from sysfs; degrade gracefully to
+    a flat cpu_count model (the hwloc discover entry point analog)."""
+    cpus: List[CpuInfo] = []
+    base = "/sys/devices/system/cpu"
+    for d in sorted(glob.glob(os.path.join(base, "cpu[0-9]*"))):
+        try:
+            cpu = int(os.path.basename(d)[3:])
+        except ValueError:
+            continue
+        topo = os.path.join(d, "topology")
+        core = _read_int(os.path.join(topo, "core_id"), cpu)
+        pkg = _read_int(os.path.join(topo, "physical_package_id"), 0)
+        numa = -1
+        for nd in glob.glob(os.path.join(d, "node[0-9]*")):
+            numa = int(os.path.basename(nd)[4:])
+            break
+        cpus.append(CpuInfo(cpu, core, max(0, pkg), numa))
+    if not cpus:
+        cpus = [CpuInfo(i, i, 0, 0)
+                for i in range(os.cpu_count() or 1)]
+    numa_nodes: Dict[int, List[int]] = {}
+    for nd in glob.glob("/sys/devices/system/node/node[0-9]*"):
+        try:
+            nid = int(os.path.basename(nd)[4:])
+            with open(os.path.join(nd, "cpulist")) as fh:
+                numa_nodes[nid] = _parse_cpulist(fh.read())
+        except (OSError, ValueError):
+            continue
+    if not numa_nodes:
+        numa_nodes = {0: [c.cpu for c in cpus]}
+    return Topology(cpus, numa_nodes)
+
+
+_topology: Optional[Topology] = None
+
+
+def topology() -> Topology:
+    global _topology
+    if _topology is None:
+        _topology = detect()
+    return _topology
+
+
+# -- device locality (the hwloc PCI/accelerator tree analog) -----------
+
+def device_order_for_locality(devices) -> List:
+    """Order local accelerator devices so consecutive local ranks own
+    ICI NEIGHBORS: on real TPUs ``device.coords`` is the chip's torus
+    position, and a lexicographic snake over the torus keeps rank i
+    and rank i+1 one ICI hop apart (the treematch/mindist idea applied
+    to the chip interconnect instead of PCI distance)."""
+    def key(d):
+        coords = getattr(d, "coords", None)
+        if coords is None:
+            return (0,) * 3 + (getattr(d, "id", 0),)
+        # snake order: reverse odd rows so adjacent indices stay
+        # physically adjacent on the torus
+        c = list(coords)
+        if len(c) >= 2 and c[-2] % 2 == 1:
+            c[-1] = -c[-1]
+        return tuple(c) + (getattr(d, "id", 0),)
+    return sorted(devices, key=key)
+
+
+# -- binding (the orte/mca/rtc/hwloc analog) ---------------------------
+
+def bind_policy() -> str:
+    return os.environ.get("TPUMPI_BIND", "none")
+
+
+def apply_binding(local_rank: int,
+                  policy: Optional[str] = None) -> Optional[List[int]]:
+    """Bind the calling rank per policy; returns the applied cpuset
+    (None = unbound).  Policies (ref: rtc_hwloc.c set of bindings):
+
+      * ``core``: local rank r -> physical core r % ncores (all its
+        SMT siblings);
+      * ``numa``: local rank r -> every cpu of NUMA node
+        r % nnuma (rank spreads round-robin over NUMA domains);
+      * ``none``: leave the OS placement.
+    """
+    policy = policy or bind_policy()
+    if policy in ("", "none"):
+        return None
+    if not hasattr(os, "sched_setaffinity"):
+        return None
+    topo = topology()
+    if policy == "core":
+        groups = topo.core_groups()
+        cpuset = groups[local_rank % len(groups)]
+    elif policy == "numa":
+        numa_ids = sorted(topo.numa_nodes)
+        nid = numa_ids[local_rank % len(numa_ids)]
+        cpuset = topo.cpus_of_numa(nid)
+    else:
+        raise ValueError(
+            f"unknown bind policy {policy!r} (core|numa|none)")
+    try:
+        os.sched_setaffinity(0, cpuset)
+    except OSError:
+        return None
+    return cpuset

@@ -1,0 +1,64 @@
+"""Debugger attach tool — the MPIR interface analog.
+
+Re-design of the reference's MPIR debugger rendezvous
+(ref: ompi/debuggers/ompi_debuggers.c — mpirun publishes
+MPIR_proctable[] = {host, executable, pid} for TotalView-class
+debuggers to read).  TPU-native shape: mpirun writes
+``proctable.json`` into the job session directory; this tool reads
+it, prints the rank->pid map, and with ``--stacks`` makes every
+local rank dump ALL its thread stacks to its stderr (ranks install a
+SIGUSR1 faulthandler at init) — the "where is my hung 256-rank job
+stuck" workflow without a real debugger.
+
+Usage:
+    python -m ompi_tpu.tools.attach <session_dir|proctable.json>
+        [--stacks]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+
+
+def load_proctable(path: str) -> list:
+    if os.path.isdir(path):
+        path = os.path.join(path, "proctable.json")
+    with open(path) as fh:
+        return json.load(fh)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="ompi_tpu-attach")
+    ap.add_argument("session", help="job session dir or proctable.json")
+    ap.add_argument("--stacks", action="store_true",
+                    help="SIGUSR1 every local pid: each rank dumps "
+                         "all thread stacks to its stderr")
+    opts = ap.parse_args(argv)
+    try:
+        table = load_proctable(opts.session)
+    except (OSError, ValueError) as e:
+        sys.stderr.write(f"attach: cannot read proctable: {e}\n")
+        return 1
+    for ent in table:
+        sys.stdout.write(
+            f"rank(s) {ent['tag']:>8}  pid {ent['pid']:>7}  "
+            f"host {ent.get('host', 'localhost')}\n")
+    if opts.stacks:
+        sent = 0
+        for ent in table:
+            try:
+                os.kill(int(ent["pid"]), signal.SIGUSR1)
+                sent += 1
+            except (OSError, ValueError):
+                pass
+        sys.stdout.write(f"attach: signalled {sent}/{len(table)} "
+                         f"pids (stacks go to job stderr)\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -210,6 +210,7 @@ def run_multinode(opts, nodes, rpp: int, hybrid: bool) -> int:
 
         job_env = {
             **getattr(opts, "ckpt_env", {}),
+            "TPUMPI_BIND": opts.bind_to,
             "TPUMPI_SIZE": str(opts.np),
             "TPUMPI_KV_ADDR": server.addr,
             "TPUMPI_JOBID": f"job-{os.getpid()}",
@@ -316,6 +317,7 @@ def run_local(opts, rpp: int, hybrid: bool, ckpt_env: dict) -> int:
     d = sm.data
     d.update(drained=False, outstanding=0)
     procs: List[subprocess.Popen] = []
+    ptags: List[str] = []
     fwd_threads: List[threading.Thread] = []
     lock = threading.Lock()
 
@@ -332,6 +334,7 @@ def run_local(opts, rpp: int, hybrid: bool, ckpt_env: dict) -> int:
         if env_base.get("PYTHONPATH") else "")
     env_base.update(ckpt_env)
     env_base.update({
+        "TPUMPI_BIND": opts.bind_to,
         "TPUMPI_SIZE": str(opts.np),
         "TPUMPI_LOCAL_SIZE": str(opts.np),  # single-host launch
         "TPUMPI_KV_ADDR": server.addr,
@@ -341,6 +344,23 @@ def run_local(opts, rpp: int, hybrid: bool, ckpt_env: dict) -> int:
     for key, value in opts.mca:
         env_base[f"TPUMPI_MCA_{key}"] = value
 
+    def _write_proctable() -> None:
+        """MPIR proctable analog (ref: ompi/debuggers MPIR_proctable):
+        rank(s) -> pid map for ompi_tpu.tools.attach."""
+        import json as _json
+        import socket as _socket
+        with lock:
+            table = [{"tag": t, "pid": p.pid,
+                      "host": _socket.gethostname()}
+                     for t, p in zip(ptags, procs)
+                     if p.poll() is None]
+        try:
+            with open(os.path.join(session, "proctable.json"),
+                      "w") as fh:
+                _json.dump(table, fh)
+        except OSError:
+            pass
+
     def spawn_proc(cmd, env, tag) -> None:
         """odls fork/exec + IOF wiring + an exit-reaper thread that
         posts EV_PROC_EXIT (replaces the 20 ms poll loop)."""
@@ -349,6 +369,7 @@ def run_local(opts, rpp: int, hybrid: bool, ckpt_env: dict) -> int:
                              stderr=subprocess.PIPE)
         with lock:
             procs.append(p)
+            ptags.append(tag)
             d["outstanding"] += 1
         for stream, out in ((p.stdout, sys.stdout.buffer),
                             (p.stderr, sys.stderr.buffer)):
@@ -396,10 +417,12 @@ def run_local(opts, rpp: int, hybrid: bool, ckpt_env: dict) -> int:
                     else f"{base}"
             else:
                 env["TPUMPI_RANK"] = str(base)
+                env["TPUMPI_LOCAL_RANK"] = str(base)  # single host
                 cmd = base_cmd
                 tag = f"{base}"
             spawn_proc(cmd, env, tag)
         server.spawn_enabled = True  # dpm supported on the local path
+        _write_proctable()
         sm.activate(smx.RUNNING)
 
     def ev_spawn(sm, info):
@@ -435,6 +458,7 @@ def run_local(opts, rpp: int, hybrid: bool, ckpt_env: dict) -> int:
                 env.pop("TPUMPI_RANK_BASE", None)
                 env.pop("TPUMPI_LOCAL_RANKS", None)
                 spawn_proc(cmd0, env, f"s{base + i}")
+        _write_proctable()
 
     def ev_proc_exit(sm, info):
         with lock:
@@ -537,9 +561,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "daemons on a forced M-device CPU platform "
                          "(the ras/simulator analog)")
     ap.add_argument("--map-by", default="byslot", dest="map_by",
-                    choices=("byslot", "bynode"),
-                    help="rmaps policy: fill nodes vs round-robin")
+                    help="rmaps policy: byslot | bynode | ppr:N:node "
+                         "| seq | rankfile:PATH")
     ap.add_argument("--oversubscribe", action="store_true")
+    ap.add_argument("--bind-to", default="none", dest="bind_to",
+                    choices=("none", "core", "numa"),
+                    help="Bind each rank to a core / NUMA domain by "
+                         "local rank (the rtc/hwloc binding analog)")
     ap.add_argument("--launch-agent", default="ssh", dest="agent",
                     help="Remote daemon launcher (e.g. 'ssh' or "
                          "'python -m ompi_tpu.tools.localssh')")

@@ -145,6 +145,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         prog = msg["prog"]
         args = msg.get("args") or []
         node_ranks = sum(max(1, p["nlocal"]) for p in msg["procs"])
+        local_idx = 0  # rank index WITHIN this node (binding input)
         for spec in msg["procs"]:
             env = dict(env_base)
             base, nlocal = spec["rank_base"], spec["nlocal"]
@@ -158,10 +159,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                     if nlocal > 1 else f"{opts.name}:{base}"
             else:
                 env["TPUMPI_RANK"] = str(base)
+                env["TPUMPI_LOCAL_RANK"] = str(local_idx)
                 env["TPUMPI_LOCAL_SIZE"] = str(node_ranks)
                 cmd = ([opts.python, prog] + args
                        if prog.endswith(".py") else [prog] + args)
                 tag = f"{opts.name}:{base}"
+            local_idx += max(1, nlocal)
             try:
                 p = subprocess.Popen(cmd, env=env, cwd=msg.get("wdir"),
                                      stdout=subprocess.PIPE,
