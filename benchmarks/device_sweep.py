@@ -259,22 +259,58 @@ def run_device_sweep(nranks: int, max_ar: int, max_bcast: int,
                 one("alltoall", str(per * 4),
                     lambda: comm.alltoall_arr(x), 1.0)
         if not out["truncated"]:
+            # BASELINE config 5 as specified: MPI_MAX on MPI_DOUBLE
+            # sourced through a derived VECTOR datatype, with the
+            # datatype pack running ON DEVICE (datatype/device.py: the
+            # run descriptors become one XLA gather fused into the
+            # collective).  float64 needs jax x64; when the backend
+            # cannot compile f64 (some TPU generations) the sweep
+            # falls back to float32 and RECORDS the substitution
+            # instead of silently benching a different config.
+            from ompi_tpu.datatype import engine as dtmod
+            from ompi_tpu.datatype.device import device_pack
+            rs_dtype = jnp.float64
+            x64_before = bool(jax.config.jax_enable_x64)
+            try:
+                jax.config.update("jax_enable_x64", True)
+                probe = jax.device_put(jnp.zeros((2,), jnp.float64),
+                                       comm.device)
+                _ = (probe + 1).dtype
+                if np.dtype(probe.dtype) != np.dtype("float64"):
+                    rs_dtype = jnp.float32  # x64 unavailable: silent
+            except Exception:
+                rs_dtype = jnp.float32
+            if rs_dtype is jnp.float32:
+                # process-global switch: never leave it flipped when
+                # the section runs f32 anyway
+                jax.config.update("jax_enable_x64", x64_before)
+            out["config5_dtype"] = str(np.dtype(rs_dtype))
+            itemsize = np.dtype(rs_dtype).itemsize
+            base_dt = dtmod.from_numpy_dtype(np.dtype(rs_dtype))
             for nbytes in sizes_upto(max_rsb, start=64):
                 if not should_continue(comm, deadline):
                     out["truncated"] = True
                     break
-                per = max(1, nbytes // 4 // nranks)
-                x = jax.device_put(
-                    jnp.full((per * nranks,), comm.rank + 1.0,
-                             jnp.float32), comm.device)
-                # SUM: the op with a native scatter-reduce lowering on
-                # both device paths (psum_scatter / stacked sum); the
-                # software sweep keeps BASELINE config 5's exact
-                # MAX-on-DOUBLE-via-vector form
-                one("reduce_scatter", str(per * nranks * 4),
-                    lambda: comm.reduce_scatter_arr(x, mpi_op.SUM),
-                    expect_sum)
+                per = max(1, nbytes // itemsize // nranks)
+                n = per * nranks
+                # vector: n blocks of 1 element, stride 2 elements —
+                # the packed stream is the even-indexed elements
+                vec = dtmod.vector(n, 1, 2, base_dt).commit()
+                raw = jax.device_put(
+                    jnp.stack([jnp.full((n,), comm.rank + 1.0,
+                                        rs_dtype),
+                               jnp.full((n,), -1.0, rs_dtype)],
+                              axis=1).reshape(-1), comm.device)
+                packed_fn = jax.jit(
+                    lambda a: device_pack(vec, 1, a))
+                packed_fn(raw)  # warm the gather
+                one("reduce_scatter", str(n * itemsize),
+                    lambda: comm.reduce_scatter_arr(
+                        packed_fn(raw), mpi_op.MAX),
+                    float(nranks))
 
+        if "config5_dtype" in out:
+            jax.config.update("jax_enable_x64", x64_before)
         comm.Barrier()
         return out
 

@@ -227,3 +227,50 @@ def get_parent(comm_world: Communicator) -> Optional[Intercommunicator]:
         inter = intercomm_create(comm_world, 0, None, 0, tag=0)
     state.extra["parent_intercomm"] = inter
     return inter
+
+
+# ---------------------------------------------------------------------
+# join (ref: ompi/mpi/c/comm_join.c — two processes holding the ends
+# of a connected socket build a 1-1 intercommunicator by exchanging
+# port names over the fd, then running connect/accept)
+# ---------------------------------------------------------------------
+
+def comm_join(comm_self: Communicator, fd: int) -> Intercommunicator:
+    """MPI_Comm_join: ``fd`` is a connected, bidirectional socket
+    shared with exactly one peer process of the same universe.  Each
+    side opens a port and sends it over the fd; the side with the
+    lexicographically smaller port string accepts on its own port,
+    the other connects to the received one (the reference decides
+    send_first by the same kind of total order)."""
+    import os
+    import struct as _struct
+
+    state = comm_self.state
+    my_port = open_port(state)
+
+    def _write_all(data: bytes) -> None:
+        off = 0
+        while off < len(data):
+            off += os.write(fd, data[off:])
+
+    def _read_exact(n: int) -> bytes:
+        out = b""
+        while len(out) < n:
+            chunk = os.read(fd, n - len(out))
+            if not chunk:
+                raise ConnectionError(
+                    "MPI_Comm_join: peer closed the socket during "
+                    "the port exchange")
+            out += chunk
+        return out
+
+    enc = my_port.encode()
+    _write_all(_struct.pack(">I", len(enc)) + enc)
+    (n,) = _struct.unpack(">I", _read_exact(4))
+    peer_port = _read_exact(n).decode()
+    if my_port == peer_port:
+        raise ValueError("MPI_Comm_join: both ends exchanged the "
+                         "same port name (fd looped back to self?)")
+    if my_port < peer_port:
+        return comm_accept(comm_self, my_port, root=0)
+    return comm_connect(comm_self, peer_port, root=0)

@@ -37,6 +37,7 @@ ROOT = -4
 
 TAG_IBRIDGE = -26
 TAG_IMERGE = -27
+TAG_ISPLIT = -28
 
 
 def _icreate_wire_tag(tag: int) -> int:
@@ -87,8 +88,81 @@ class Intercommunicator(Communicator):
         return None  # never device-offloadable as one mesh
 
     def split(self, color: int, key: int = 0):
-        raise NotImplementedError(
-            "MPI_Comm_split on intercommunicators is not supported")
+        """MPI_Comm_split on an intercommunicator (ref:
+        ompi/mpi/c/comm_split.c -> ompi_comm_split inter branch):
+        members of the SAME color on both sides form a new
+        intercommunicator; a color with members on only one side gets
+        MPI_COMM_NULL (None), per MPI-3.1 §6.4.2.
+
+        Both sides order each color group by (key, old local rank),
+        computed identically from the exchanged (color, key) tables.
+        """
+        from .communicator import UNDEFINED
+        _init_dt()
+        lc = self.local_comm
+        pml = self._pml()
+
+        # 1. allgather (color, key) within each local group, in old
+        # local-rank order
+        mine = np.array([color, key], dtype=np.int64)
+        local_tbl = np.empty((lc.size, 2), dtype=np.int64)
+        lc.Allgather(mine, local_tbl)
+
+        # 2. leaders exchange the full tables across the bridge
+        # (local rank 0 <-> remote rank 0 over the intercomm), then
+        # bcast locally
+        if lc.rank == 0:
+            sreq = pml.isend(local_tbl, local_tbl.size, _I64, 0,
+                             TAG_ISPLIT, self)
+            remote_tbl = np.empty((self.remote_size, 2),
+                                  dtype=np.int64)
+            pml.recv(remote_tbl, remote_tbl.size, _I64, 0,
+                     TAG_ISPLIT, self)
+            sreq.wait()
+        else:
+            remote_tbl = np.empty((self.remote_size, 2),
+                                  dtype=np.int64)
+        lc.Bcast(remote_tbl, root=0)
+
+        # 3. my color's ordered subgroups on both sides (global ranks)
+        def members(tbl, group):
+            out = [(int(tbl[i][1]), i, group[i])
+                   for i in range(len(group))
+                   if int(tbl[i][0]) == color]
+            out.sort()
+            return [g for (_k, _i, g) in out]
+
+        # 4. split the private local comm (handles UNDEFINED and the
+        # local cid agreement); every member of the old intercomm
+        # participates (comm_split is collective over both groups)
+        local_split = lc.split(color, key)
+        if color == UNDEFINED or local_split is None:
+            return None
+        my_local = members(local_tbl, self._group.ranks)
+        my_remote = members(remote_tbl, self._remote_group.ranks)
+        if not my_remote:
+            # my color exists only on this side -> MPI_COMM_NULL
+            local_split.free()
+            return None
+
+        # 5. cid agreement between the two color groups: the color
+        # leaders bridge over the OLD intercomm (distinct leader
+        # pairs per color -> per-(src) matching keeps them apart)
+        am_leader = my_local[0] == self.state.rank
+        if am_leader:
+            # remote color leader's index in the old REMOTE group
+            r_leader = self._remote_group.ranks.index(my_remote[0])
+            bridge = _SplitBridge(self, r_leader)
+            cid = _bridge_cid_agree_leader(self.state, local_split,
+                                           bridge, 0)
+        else:
+            cid = _bridge_cid_agree_leader(self.state, local_split,
+                                           None, 0)
+        out = Intercommunicator(self.state, cid, Group(my_local),
+                                Group(my_remote), local_split,
+                                name=f"{self.name}-split")
+        out.errhandler = self.errhandler  # MPI: children inherit
+        return out
 
     def free(self) -> None:
         self.local_comm.free()
@@ -138,6 +212,22 @@ def _init_dt():
         from ompi_tpu.datatype import engine as dtmod
         _I64 = dtmod.INT64_T
     return _I64
+
+
+class _SplitBridge:
+    """Adapter bridging a color-group leader to its remote color
+    leader over the OLD intercomm during intercomm split."""
+
+    def __init__(self, inter: "Intercommunicator",
+                 remote_leader: int) -> None:
+        self.inter = inter
+        self.remote_leader = remote_leader
+
+    def _bridge_peer(self) -> int:
+        return self.remote_leader
+
+    def __getattr__(self, name):
+        return getattr(self.inter, name)
 
 
 class _PeerBridge:

@@ -16,7 +16,7 @@ same two error classes are caught differently:
     a mismatch raises, naming the request (the MPI-2 erroneous
     program the reference's memchecker flags).
 
-Enable with ``--mca opal_memchecker 1`` (off by default: poisoning
+Enable with ``--mca opal_memchecker_enable 1`` (off by default: poisoning
 costs a memset per receive, checksums a pass per send — same
 price/benefit as running the reference under Valgrind).
 """

@@ -807,6 +807,15 @@ def MPI_Comm_get_parent():
     return _top.get_parent()
 
 
+def MPI_Comm_join(fd):
+    """ref: ompi/mpi/c/comm_join.c — intercomm from a connected
+    socket fd shared with one peer of this universe."""
+    from ompi_tpu.comm import dpm as _dpm
+    from ompi_tpu.runtime import state as _statemod
+    st = _statemod.current()
+    return _dpm.comm_join(st.comm_self, fd)
+
+
 def MPI_Open_port(info=None) -> str:
     return _top.open_port()
 

@@ -40,9 +40,14 @@ def mpi_init(state: ProcState, device=None) -> ProcState:
     from ompi_tpu.runtime import topology as _topo
     _world = getattr(state.rte, "world", None)
     if _world is not None:
-        # thread-rank: sched_setaffinity(0) binds the calling THREAD,
-        # so each rank-thread binds itself by its local index
-        _local_rank = state.rank - getattr(_world, "rank_base", 0)
+        # thread-rank: sched_setaffinity(0) binds the calling THREAD.
+        # The binding index is the rank's position within its NODE
+        # (TPUMPI_NODE_RANK_BASE), not within its shell — two shells
+        # on one node must not overlap their core assignments
+        node_base = int(os.environ.get(
+            "TPUMPI_NODE_RANK_BASE",
+            str(getattr(_world, "rank_base", 0))))
+        _local_rank = state.rank - node_base
     else:
         # process-rank: the launcher exports the rank's index WITHIN
         # its node (never the global rank — that would misbind every

@@ -138,7 +138,10 @@ def advertised_addrs() -> List[str]:
     dialing side scores and picks)."""
     ifs = sorted(interfaces(),
                  key=lambda i: (not i.up, i.loopback, -i.speed_mbps))
-    return [i.ip for i in ifs if i.up]
+    # loopback is never advertised: a cross-host dialer that selected
+    # it would connect to its OWN host (same-host jobs use the
+    # loopback-only if_ip path, not multi-NIC advertising)
+    return [i.ip for i in ifs if i.up and not i.loopback]
 
 
 def best_local_toward(remote_ip: str) -> Tuple[Optional[Interface], int]:

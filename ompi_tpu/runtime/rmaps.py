@@ -28,7 +28,7 @@ Two launch-unit shapes per node:
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import List
+from typing import Dict, List
 
 from .ras import Node
 
@@ -96,6 +96,11 @@ def map_ranks(nodes: List[Node], np: int, rpp: int = 1,
             raise ValueError(
                 f"ppr:{n_per}:node places at most "
                 f"{n_per * len(nodes)} ranks < {np}")
+        over = [n.name for n in nodes if n_per > n.slots]
+        if over and not oversubscribe:
+            raise ValueError(
+                f"ppr:{n_per}:node exceeds the slot count on "
+                f"node(s) {over} (use --oversubscribe)")
         rank = 0
         for i in range(len(nodes)):
             take = min(n_per, np - rank)
@@ -146,6 +151,15 @@ def map_ranks(nodes: List[Node], np: int, rpp: int = 1,
         if missing:
             raise ValueError(
                 f"rankfile leaves rank(s) {missing} unplaced")
+        counts: Dict[int, int] = {}
+        for r in range(np):
+            counts[placed[r]] = counts.get(placed[r], 0) + 1
+        over = [nodes[i].name for i, c in counts.items()
+                if c > nodes[i].slots]
+        if over and not oversubscribe:
+            raise ValueError(
+                f"rankfile oversubscribes node(s) {over} "
+                f"(use --oversubscribe)")
         for r in range(np):
             per_node[placed[r]].append(r)
     if base_policy == "byslot":

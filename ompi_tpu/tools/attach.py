@@ -48,10 +48,25 @@ def main(argv=None) -> int:
             f"rank(s) {ent['tag']:>8}  pid {ent['pid']:>7}  "
             f"host {ent.get('host', 'localhost')}\n")
     if opts.stacks:
+        import socket as _socket
+        me = _socket.gethostname()
         sent = 0
         for ent in table:
+            if ent.get("host", me) != me:
+                continue  # never signal pids on another host
+            pid = int(ent["pid"])
+            # pid-recycling guard: only signal a process that still
+            # looks like a Python rank (SIGUSR1's default action
+            # TERMINATES a process with no faulthandler registered)
             try:
-                os.kill(int(ent["pid"]), signal.SIGUSR1)
+                with open(f"/proc/{pid}/cmdline", "rb") as fh:
+                    cmdline = fh.read()
+            except OSError:
+                continue  # gone
+            if b"python" not in cmdline:
+                continue
+            try:
+                os.kill(pid, signal.SIGUSR1)
                 sent += 1
             except (OSError, ValueError):
                 pass

@@ -408,6 +408,7 @@ def run_local(opts, rpp: int, hybrid: bool, ckpt_env: dict) -> int:
             env = dict(env_base)
             if nlocal:  # app shell owning ranks [base, base+nlocal)
                 env["TPUMPI_RANK_BASE"] = str(base)
+                env["TPUMPI_NODE_RANK_BASE"] = "0"  # single node
                 env["TPUMPI_LOCAL_RANKS"] = str(nlocal)
                 env["TPUMPI_LOCAL_SIZE"] = str(nlocal)
                 env["TPUMPI_NODE"] = str(node)

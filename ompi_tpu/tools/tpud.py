@@ -145,6 +145,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         prog = msg["prog"]
         args = msg.get("args") or []
         node_ranks = sum(max(1, p["nlocal"]) for p in msg["procs"])
+        node_base = min(p["rank_base"] for p in msg["procs"])
+        env_base["TPUMPI_NODE_RANK_BASE"] = str(node_base)
         local_idx = 0  # rank index WITHIN this node (binding input)
         for spec in msg["procs"]:
             env = dict(env_base)

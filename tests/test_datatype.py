@@ -332,3 +332,47 @@ def test_partial_pack_is_chunk_local():
     el = time.perf_counter() - t0
     assert n == 64
     assert el < 1.0  # O(N^2) behavior would take far longer
+
+
+# -- on-device packing (datatype/device.py; SURVEY §2.9.1 north star) --
+
+def test_device_pack_vector_matches_host_convertor():
+    import jax.numpy as jnp
+    from ompi_tpu.datatype import convertor as cv
+    from ompi_tpu.datatype import engine as dt
+    from ompi_tpu.datatype.device import (device_pack, device_unpack,
+                                          is_device_packable)
+
+    vec = dt.vector(5, 2, 3, dt.FLOAT).commit()
+    assert is_device_packable(vec, 2)
+    buf = np.arange(40, dtype=np.float32)
+    host = np.frombuffer(cv.pack(vec, 2, buf), dtype=np.float32)
+    dev = np.asarray(device_pack(vec, 2, jnp.asarray(buf)))
+    assert np.array_equal(host, dev)
+    # unpack scatters back to the same slots
+    out = np.asarray(device_unpack(vec, 2, jnp.asarray(dev),
+                                   jnp.zeros(40, jnp.float32)))
+    ref = np.zeros(40, dtype=np.float32)
+    cv.unpack(vec, 2, ref, host.tobytes())
+    assert np.array_equal(out, ref)
+
+
+def test_device_pack_rejects_mixed_structs():
+    from ompi_tpu.datatype import engine as dt
+    from ompi_tpu.datatype.device import is_device_packable
+
+    st = dt.struct([1, 1], [0, 8], [dt.INT, dt.DOUBLE]).commit()
+    assert not is_device_packable(st, 1)
+
+
+def test_device_pack_indexed_and_contiguous():
+    import jax.numpy as jnp
+    from ompi_tpu.datatype import convertor as cv
+    from ompi_tpu.datatype import engine as dt
+    from ompi_tpu.datatype.device import device_pack
+
+    idxed = dt.indexed([2, 3], [7, 0], dt.INT).commit()
+    buf = np.arange(16, dtype=np.int32)
+    host = np.frombuffer(cv.pack(idxed, 1, buf), dtype=np.int32)
+    dev = np.asarray(device_pack(idxed, 1, jnp.asarray(buf)))
+    assert np.array_equal(host, dev)

@@ -188,3 +188,65 @@ def test_create_with_colliding_user_tags():
         return True
 
     assert run_ranks(4, fn) == [True] * 4
+
+
+def test_intercomm_split_pairs_colors_across_sides():
+    """MPI_Comm_split on an intercommunicator: same color on both
+    sides pairs up; one-sided colors get COMM_NULL (MPI-3.1 §6.4.2,
+    ref: ompi/mpi/c/comm_split.c inter branch)."""
+    def fn(comm):
+        inter, local, low = _mk_inter(comm, 3)
+        # colors: local side {0: ranks 0,1; 1: rank 2};
+        # remote side (3 ranks) {0: ranks 0,1; 7: rank 2}
+        color = 0 if local.rank < 2 else (1 if low else 7)
+        sub = inter.split(color, key=local.rank)
+        if color == 0:
+            assert sub is not None and sub.is_inter
+            assert sub.size == 2 and sub.remote_size == 2
+            # the pair comm works for p2p: exchange global ranks
+            from ompi_tpu.datatype import engine as dt
+            pml = comm.state.pml
+            x = np.array([comm.rank], dtype=np.int64)
+            y = np.empty(1, dtype=np.int64)
+            s = pml.isend(x, 1, dt.INT64_T, sub.rank, -61, sub)
+            pml.recv(y, 1, dt.INT64_T, sub.rank, -61, sub)
+            s.wait()
+            expect = comm.rank + 3 if low else comm.rank - 3
+            assert int(y[0]) == expect
+        else:
+            # color 1 / 7 exist on one side only -> COMM_NULL
+            assert sub is None
+        return True
+
+    assert run_ranks(6, fn) == [True] * 6
+
+
+def test_intercomm_split_undefined_returns_null():
+    def fn(comm):
+        inter, local, low = _mk_inter(comm, 2)
+        from ompi_tpu.comm.communicator import UNDEFINED
+        if local.rank == 0:
+            sub = inter.split(0, key=0)
+            assert sub is not None
+            assert sub.size == 1 and sub.remote_size == 1
+        else:
+            assert inter.split(UNDEFINED) is None
+        return True
+
+    assert run_ranks(4, fn) == [True] * 4
+
+
+def test_comm_join_over_socket():
+    """MPI_Comm_join builds a 1-1 intercomm from a raw connected
+    socket (ref: ompi/mpi/c/comm_join.c)."""
+    r = subprocess.run(
+        [sys.executable, "-m", "ompi_tpu.tools.mpirun", "-np", "2",
+         "--timeout", "90",
+         os.path.join(REPO, "tests", "_join_prog.py")],
+        capture_output=True, timeout=150,
+        env={**os.environ,
+             "PYTHONPATH": REPO + os.pathsep
+             + os.environ.get("PYTHONPATH", "")},
+        cwd=REPO)
+    assert r.returncode == 0, r.stderr.decode()
+    assert b"join ok" in r.stdout
