@@ -54,25 +54,63 @@ class BTLComponent(Component):
         return []
 
 
+class BtlError(RuntimeError):
+    """A transport failed a send hard (socket dead, reconnect
+    exhausted).  The endpoint catches it and fails over."""
+
+
 class Endpoint:
-    """Per-peer transport choice (the bml_base_btl analog)."""
+    """Per-peer transport array (the bml_base_btl array analog,
+    ref: ompi/mca/bml/r2/bml_r2.c — per-proc btl lists with
+    failover; ompi/mca/pml/bfo for the recovery idea).
 
-    __slots__ = ("peer", "btl")
+    ``btls`` is every module reaching the peer, best exclusivity
+    first.  ``send`` uses the active one; a BtlError fails over to
+    the next and retries the failed frag.  Frags a dead transport
+    had not fully written are resent by the transport's own
+    reconnect (btl/tcp); frames lost inside dead kernel buffers are
+    NOT recovered (that needs btl-level acks — the pml/bfo protocol)
+    and fail stop at the receiver.  No frag-level striping: frags
+    are sized against the active rail's eager/max-send limits, so
+    routing them over a different rail would violate its protocol
+    (and no two current transports share an exclusivity tier)."""
 
-    def __init__(self, peer: int, btl: BTLModule) -> None:
+    __slots__ = ("peer", "btls", "active")
+
+    def __init__(self, peer: int, btls: List[BTLModule]) -> None:
         self.peer = peer
-        self.btl = btl
+        self.btls = btls
+        self.active = 0
+
+    @property
+    def btl(self) -> BTLModule:
+        """The active transport (protocol limits are read from it)."""
+        return self.btls[self.active]
+
+    def failover(self) -> bool:
+        """Advance to the next transport; False when exhausted."""
+        if self.active + 1 >= len(self.btls):
+            return False
+        self.active += 1
+        return True
+
+    def send(self, frag) -> None:
+        """Send with failover-and-retry of the failed frag."""
+        while True:
+            try:
+                self.btls[self.active].send(self.peer, frag)
+                return
+            except BtlError:
+                if not self.failover():
+                    raise
 
 
 def wire_endpoints(state, modules: List[BTLModule]) -> List[Optional[Endpoint]]:
-    """For each peer pick the highest-exclusivity btl that reaches it
-    (mca_bml_r2_add_procs analog)."""
+    """For each peer collect every btl that reaches it, best
+    exclusivity first (mca_bml_r2_add_procs analog)."""
     eps: List[Optional[Endpoint]] = []
     for peer in range(state.size):
-        best: Optional[BTLModule] = None
-        for m in modules:
-            if m.reaches(peer) and (best is None
-                                    or m.exclusivity > best.exclusivity):
-                best = m
-        eps.append(Endpoint(peer, best) if best is not None else None)
+        reach = sorted((m for m in modules if m.reaches(peer)),
+                       key=lambda m: -m.exclusivity)
+        eps.append(Endpoint(peer, reach) if reach else None)
     return eps

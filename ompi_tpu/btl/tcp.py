@@ -29,7 +29,7 @@ from typing import Dict, List, Optional
 
 from ompi_tpu.mca.params import registry
 from . import wire
-from .base import BTLComponent, BTLModule, btl_framework
+from .base import BTLComponent, BTLModule, BtlError, btl_framework
 
 _eager_var = registry.register(
     "btl", "tcp", "eager_limit", 64 * 1024, int,
@@ -51,14 +51,18 @@ _advertise_all_var = registry.register(
 
 
 class _Conn:
-    __slots__ = ("sock", "rxbuf", "txq", "txoff", "wr_registered")
+    __slots__ = ("sock", "rxbuf", "txq", "txoff", "wr_registered",
+                 "peer", "reconnects", "dead")
 
-    def __init__(self, sock: socket.socket) -> None:
+    def __init__(self, sock: socket.socket, peer: int = -1) -> None:
         self.sock = sock
         self.rxbuf = bytearray()
         self.txq: deque = deque()
         self.txoff = 0
         self.wr_registered = False
+        self.peer = peer          # >= 0 on outbound conns (reconnect)
+        self.reconnects = 0
+        self.dead = False
 
 
 class TcpModule(BTLModule):
@@ -133,29 +137,96 @@ class TcpModule(BTLModule):
                 addr = next(a for a in addrs
                             if a.rsplit(":", 1)[0] == best)
         host, port = addr.rsplit(":", 1)
-        s = socket.create_connection((host, int(port)), timeout=30)
+        try:
+            s = socket.create_connection((host, int(port)), timeout=30)
+        except OSError as e:
+            raise BtlError(f"tcp connect to rank {peer} failed: {e}")
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         s.setblocking(False)
-        conn = _Conn(s)
+        conn = _Conn(s, peer=peer)
         self._out[peer] = conn
         return conn
 
+    def _reconnect(self, conn: _Conn) -> bool:
+        """Transport-level recovery (the failover half the endpoint
+        cannot do): dial the peer again and resend every frame not
+        yet FULLY handed to the dead socket (txq holds whole frames,
+        so resends always start on a frame boundary; the receiver's
+        half-read tail of the dead connection is superseded, and a
+        duplicated frame is absorbed by the pml — seq dedup for
+        envelopes, contiguous-coverage accounting for segments).
+        Frames the kernel accepted but never delivered are NOT
+        recoverable here — that window needs btl-level acks (the
+        pml/bfo protocol), so a gap fails stop at the receiver
+        instead of completing with a hole."""
+        if conn.peer < 0 or conn.reconnects >= 3:
+            return False
+        conn.reconnects += 1
+        try:
+            self.sel.unregister(conn.sock)
+        except (KeyError, ValueError):
+            pass
+        conn.wr_registered = False
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+        addr = self.state.rte.modex_get(conn.peer, "btl_tcp_addr")
+        host, port = addr.rsplit(":", 1)
+        try:
+            s = socket.create_connection((host, int(port)), timeout=10)
+        except OSError:
+            return False
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        s.setblocking(False)
+        conn.sock = s
+        conn.txoff = 0  # resend the partially-written frame whole
+        return True
+
+    def _kill_conn(self, conn: _Conn) -> None:
+        """Reconnects exhausted: tear the connection down fully so no
+        selector ever polls a dead fd and no sweep busy-loops; the
+        next send() to this peer raises BtlError for endpoint
+        failover."""
+        conn.dead = True
+        conn.txq.clear()
+        conn.txoff = 0
+        try:
+            self.sel.unregister(conn.sock)
+        except (KeyError, ValueError):
+            pass
+        conn.wr_registered = False
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+
     def send(self, peer: int, frag) -> None:
+        conn = self._connect(peer)
+        if conn.dead:
+            # endpoint failover consumed this transport for the peer
+            del self._out[peer]
+            raise BtlError(f"tcp transport to rank {peer} is dead")
         hdr, payload = wire.encode(frag)
         plen = 0 if payload is None else len(payload)
-        conn = self._connect(peer)
-        # one small concat for the length prefix + header; the payload
-        # rides as its own buffer so sendmsg gathers it copy-free
-        conn.txq.append(struct.pack(">I", len(hdr) + plen) + hdr)
+        # txq holds WHOLE FRAMES (a list of buffers each): retirement
+        # and reconnect-resend happen on frame boundaries only, so a
+        # resent stream can never start mid-frame.  The payload rides
+        # as its own buffer so sendmsg gathers it copy-free.
+        frame = [struct.pack(">I", len(hdr) + plen) + hdr]
         if plen:
-            conn.txq.append(payload if isinstance(payload, (bytes, memoryview))
-                            else memoryview(payload))
+            frame.append(payload
+                         if isinstance(payload, (bytes, memoryview))
+                         else memoryview(payload))
+        conn.txq.append(frame)
         self._drain(conn)
 
     def _set_wr_interest(self, conn: _Conn) -> None:
         """Write interest only while the queue is non-empty: idle
         sockets must not wake every progress sweep (ref: the
         reference's event-driven send_handler registration)."""
+        if conn.dead:
+            return
         want = bool(conn.txq)
         if want and not conn.wr_registered:
             self.sel.register(conn.sock, selectors.EVENT_WRITE,
@@ -169,15 +240,24 @@ class TcpModule(BTLModule):
             conn.wr_registered = False
 
     def _drain(self, conn: _Conn) -> int:
+        if conn.dead:
+            return 0
         sent = 0
         txq = conn.txq
         while txq:
-            # gather up to 16 queued buffers into one vectored send
+            # gather up to 16 buffers into one vectored send; txoff
+            # is the byte offset into the FIRST frame
             bufs = []
-            for i, b in enumerate(txq):
-                if i == 0 and conn.txoff:
-                    b = memoryview(b)[conn.txoff:]
-                bufs.append(b)
+            skip = conn.txoff
+            for frame in txq:
+                for b in frame:
+                    if skip:
+                        if skip >= len(b):
+                            skip -= len(b)
+                            continue
+                        b = memoryview(b)[skip:]
+                        skip = 0
+                    bufs.append(b)
                 if len(bufs) >= 16:
                     break
             try:
@@ -185,20 +265,24 @@ class TcpModule(BTLModule):
             except (BlockingIOError, InterruptedError):
                 break
             except OSError:
-                txq.clear()
-                conn.txoff = 0
+                # socket died: reconnect and resend from the first
+                # not-fully-sent frame; exhausted reconnects tear the
+                # conn down so the next send() fails over
+                if self._reconnect(conn):
+                    continue
+                self._kill_conn(conn)
                 break
             sent += n
-            # retire fully-sent buffers; track offset into the first
-            # remaining one
+            # retire fully-sent FRAMES; the offset tracks into the
+            # first remaining frame
             n += conn.txoff
             conn.txoff = 0
             while txq:
-                ln = len(txq[0])
-                if n < ln:
+                flen = sum(len(b) for b in txq[0])
+                if n < flen:
                     conn.txoff = n
                     break
-                n -= ln
+                n -= flen
                 txq.popleft()
         self._set_wr_interest(conn)
         return sent

@@ -184,7 +184,7 @@ class PmlOb1:
             # pack_bytes: the request completes NOW, but the frag may
             # sit in a transport queue — the payload must own its bytes
             payload = conv.pack_bytes()
-            btl.send(gdst, (MATCH, cid, src, tag, seq, gsrc, payload))
+            ep.send((MATCH, cid, src, tag, seq, gsrc, payload))
             req._complete()
             if peruse.enabled:
                 peruse.fire("req_complete", kind="send",
@@ -192,15 +192,15 @@ class PmlOb1:
         elif conv.packed_size <= btl.eager_limit:  # sync eager
             payload = conv.pack_bytes()
             self._send_reqs[req_id] = req
-            btl.send(gdst, (MATCH_SYNC, cid, src, tag, seq, gsrc,
-                            req_id, payload))
+            ep.send((MATCH_SYNC, cid, src, tag, seq, gsrc,
+                     req_id, payload))
         else:
             if memchecker.enabled():
                 req.mc_crc = memchecker.send_checksum(conv)
             head = conv.pack_bytes(btl.eager_limit)
             self._send_reqs[req_id] = req
-            btl.send(gdst, (RNDV, cid, src, tag, seq, gsrc,
-                            conv.packed_size, req_id, head))
+            ep.send((RNDV, cid, src, tag, seq, gsrc,
+                     conv.packed_size, req_id, head))
         return req
 
     def send(self, buf, count, datatype, dst, tag, comm,
@@ -344,11 +344,11 @@ class PmlOb1:
         req.status.count = min(req.received, capacity)
         if msg.kind == MATCH_SYNC:
             ep = self._ep(self.state_comm_peer(msg.cid, msg.src))
-            ep.btl.send(ep.peer, (SYNC_ACK, msg.sreq_id))
+            ep.send((SYNC_ACK, msg.sreq_id))
         if msg.kind == RNDV:
             gsrc = self.state_comm_peer(msg.cid, msg.src)
             ep = self._ep(gsrc)
-            ep.btl.send(ep.peer, (ACK, msg.sreq_id, req.req_id))
+            ep.send((ACK, msg.sreq_id, req.req_id))
         if req.received >= msg.total:
             req.status.count = min(msg.total, capacity)
             self._finish_recv(req)
@@ -440,7 +440,7 @@ class PmlOb1:
         while not conv.done:
             pos = conv.position
             payload = conv.pack_bytes(btl.max_send_size)
-            btl.send(req.dst, (FRAG, rreq_id, pos, payload))
+            ep.send((FRAG, rreq_id, pos, payload))
         if memchecker.enabled():
             memchecker.verify_send(
                 conv, getattr(req, "mc_crc", None),
@@ -458,7 +458,13 @@ class PmlOb1:
             take = min(len(payload), capacity - pos)
             req.conv.set_position(pos)
             req.conv.unpack(payload[:take])
-        req.received += len(payload)
+        # contiguous coverage only: duplicated segments (transport
+        # reconnect resends) never double-count, and a LOST segment
+        # (the unrecoverable kernel-buffer window of a dead
+        # connection) leaves received short forever — the recv fails
+        # stop via timeout instead of completing with a hole
+        if pos <= req.received:
+            req.received = max(req.received, pos + len(payload))
         if req.received >= req.incoming:
             req.status.count = min(req.incoming, capacity)
             self._finish_recv(req)

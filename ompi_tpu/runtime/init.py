@@ -128,13 +128,9 @@ def extend_universe(state: ProcState, new_size: int) -> None:
             ext(new_size)
     eps = list(state.pml.endpoints)
     for peer in range(old, new_size):
-        best = None
-        for m in state.btls:
-            if m.reaches(peer) and (best is None
-                                    or m.exclusivity > best.exclusivity):
-                best = m
-        eps.append(btl_base.Endpoint(peer, best)
-                   if best is not None else None)
+        reach = sorted((m for m in state.btls if m.reaches(peer)),
+                       key=lambda m: -m.exclusivity)
+        eps.append(btl_base.Endpoint(peer, reach) if reach else None)
     state.pml.add_procs(eps)
 
 

@@ -17,6 +17,7 @@ import json
 import socket
 import struct
 import threading
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 
@@ -57,6 +58,10 @@ class KVServer:
         self.counters: Dict[str, int] = {}
         self.fences: Dict[str, int] = {}
         self.fence_waiters: Dict[str, List[socket.socket]] = {}
+        # O(daemons)-vs-O(ranks) scalability diagnostic: connections
+        # ever accepted (daemon KV proxies collapse per-rank traffic
+        # onto one upstream connection per node)
+        self.connections_served = 0
         self.aborted: Optional[Tuple[int, int, str]] = None
         # dpm: the universe rank space grows as jobs are spawned
         # (ref: ompi/dpm over the PMIx server); mpirun drains
@@ -86,6 +91,7 @@ class KVServer:
                 conn, _ = self.sock.accept()
             except OSError:
                 return
+            self.connections_served += 1
             t = threading.Thread(target=self._serve, args=(conn,),
                                  daemon=True)
             t.start()
@@ -151,12 +157,19 @@ class KVServer:
                             _send_msg(conn,
                                       {"value": self.data.pop(msg["key"])})
                 elif op == "fence":
+                    # weighted arrival: a daemon KV proxy fences ONCE
+                    # on behalf of its node's ranks (weight = local
+                    # rank count); the fence completes when the summed
+                    # weights reach n (grpcomm aggregation analog,
+                    # ref: orte/mca/grpcomm — daemons collect their
+                    # local procs' contributions)
                     fid = msg["id"]
                     want = int(msg.get("n", self.nprocs))
+                    weight = int(msg.get("weight", 1))
                     with self.cv:
-                        self.fences[fid] = self.fences.get(fid, 0) + 1
+                        self.fences[fid] = self.fences.get(fid, 0) + weight
                         self.fence_waiters.setdefault(fid, []).append(conn)
-                        if self.fences[fid] == want:
+                        if self.fences[fid] >= want:
                             for c in self.fence_waiters[fid]:
                                 try:
                                     _send_msg(c, {"fence_done": fid})
@@ -290,11 +303,14 @@ class KVClient:
             raise TimeoutError(f"kv take({key}) timed out")
         return resp["value"]
 
-    def fence(self, fence_id: str, n: Optional[int] = None) -> None:
+    def fence(self, fence_id: str, n: Optional[int] = None,
+              weight: int = 1) -> None:
         with self._lock:
             msg = {"op": "fence", "id": fence_id}
             if n is not None:
                 msg["n"] = n
+            if weight != 1:
+                msg["weight"] = weight
             _send_msg(self._sock, msg)
             resp = _recv_msg(self._sock)
         if resp is None or "fence_done" not in resp:
@@ -332,3 +348,171 @@ class KVClient:
             self._sock.close()
         except OSError:
             pass
+
+
+class KVProxy:
+    """Per-node KV aggregation daemon — the grpcomm/routed analog.
+
+    Runs inside tpud.  Local ranks speak the ordinary KV wire protocol
+    to this proxy on loopback; the proxy maintains ONE upstream
+    connection to the HNP's KVServer, so the central server sees
+    O(daemons) connections instead of O(ranks) (ref:
+    orte/mca/grpcomm/brucks — daemons aggregate their local procs'
+    collective contributions; orte/mca/routed — control traffic rides
+    the daemon overlay, not per-proc sockets).
+
+    Aggregation:
+      * fence  — collect ``local_expected`` arrivals, then ONE
+        weighted upstream arrival (weight = local rank count); the
+        server completes when summed weights reach n;
+      * get    — write-once ``modex:`` keys are cached after the
+        first fetch, so N local readers cost one upstream read;
+        blocking upstream gets poll with short timeouts so one
+        waiting rank never serializes the node's other traffic;
+      * everything else (put/incr/uncr/take/abort/spawn) forwards.
+    """
+
+    def __init__(self, upstream_addr: str, local_expected: int) -> None:
+        self.local_expected = max(1, local_expected)
+        self.up = KVClient(upstream_addr)
+        # dedicated fence channel, reused across fences (a pending
+        # fence must never block ops; fences of one job are
+        # sequential, so one channel suffices per node)
+        self._up_fence: Optional[KVClient] = None
+        self._fence_lock = threading.Lock()
+        self._cache: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        # fid -> [arrivals, result ('done'|'error'), waiter sockets]
+        self._fences: Dict[str, list] = {}
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(64)
+        self.addr = f"127.0.0.1:{self.sock.getsockname()[1]}"
+        self._stop = False
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    def _accept_loop(self) -> None:
+        while not self._stop:
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _poll_upstream(self, op: str, key: str, timeout: float):
+        """Blocking get/take forwarded as short polls so the shared
+        upstream channel is never held across a long wait."""
+        deadline = time.monotonic() + timeout
+        while True:
+            left = deadline - time.monotonic()
+            step = min(0.2, max(0.01, left))
+            try:
+                if op == "get":
+                    return {"value": self.up.get(key, timeout=step)}
+                return {"value": self.up.take(key, timeout=step)}
+            except TimeoutError:
+                if time.monotonic() >= deadline:
+                    return {"timeout": True}
+            except RuntimeError as e:  # job abort rides the reply
+                return {"abort": str(e)}
+
+    def _serve(self, conn: socket.socket) -> None:
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            while True:
+                msg = _recv_msg(conn)
+                if msg is None:
+                    return
+                op = msg.get("op")
+                if op == "put":
+                    self.up.put(msg["key"], msg["value"])
+                    _send_msg(conn, {"ok": True})
+                elif op == "get":
+                    key = msg["key"]
+                    with self._lock:
+                        hit = self._cache.get(key)
+                    if hit is not None:
+                        _send_msg(conn, {"value": hit})
+                        continue
+                    resp = self._poll_upstream(
+                        "get", key, msg.get("timeout", 60.0))
+                    if "value" in resp and key.startswith("modex:"):
+                        # modex keys are write-once per rank: safe to
+                        # serve every later local reader from cache
+                        with self._lock:
+                            self._cache[key] = resp["value"]
+                    _send_msg(conn, resp)
+                elif op == "take":
+                    _send_msg(conn, self._poll_upstream(
+                        "take", msg["key"], msg.get("timeout", 60.0)))
+                elif op == "incr":
+                    _send_msg(conn, {"value": self.up.incr(msg["key"])})
+                elif op == "uncr":
+                    _send_msg(conn, {"ok": self.up.uncr(
+                        msg["key"], msg["expect"])})
+                elif op == "abort":
+                    try:
+                        self.up.abort(msg["rank"], msg["code"],
+                                      msg.get("msg", ""))
+                    except (RuntimeError, OSError):
+                        pass
+                    _send_msg(conn, {"ok": True})
+                elif op == "fence":
+                    self._fence(conn, msg)
+                elif op == "spawn":
+                    with self.up._lock:
+                        _send_msg(self.up._sock, msg)
+                        resp = _recv_msg(self.up._sock)
+                    _send_msg(conn, resp or {"error": "upstream gone"})
+        except OSError:
+            return
+
+    def _fence(self, conn: socket.socket, msg: dict) -> None:
+        fid = msg["id"]
+        release = None
+        with self._cv:
+            ent = self._fences.setdefault(fid, [0, None, []])
+            ent[0] += 1
+            ent[2].append(conn)
+            if ent[0] == self.local_expected:
+                release = ent
+        if release is None:
+            return  # reply comes when the node's last rank arrives
+        # last local arrival: ONE weighted upstream fence on the
+        # dedicated fence channel
+        try:
+            with self._fence_lock:
+                if self._up_fence is None:
+                    self._up_fence = KVClient(
+                        f"{self.up.addr[0]}:{self.up.addr[1]}")
+                self._up_fence.fence(fid, n=msg.get("n"),
+                                     weight=self.local_expected)
+            reply = {"fence_done": fid}
+        except (RuntimeError, OSError) as e:
+            reply = {"error": f"fence failed: {e}"}
+        with self._cv:
+            ent = self._fences.pop(fid)
+        for c in ent[2]:
+            try:
+                _send_msg(c, reply)
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        self._stop = True
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+        try:
+            self.up.close()
+        except OSError:
+            pass
+        if self._up_fence is not None:
+            try:
+                self._up_fence.close()
+            except OSError:
+                pass

@@ -280,6 +280,10 @@ def run_multinode(opts, nodes, rpp: int, hybrid: bool) -> int:
     def drain(failed: bool) -> None:
         hnp = d.get("hnp")
         server = d.get("server")
+        if server is not None and "kv" in opts.verbose.split(","):
+            sys.stderr.write(
+                f"mpirun: kv server served "
+                f"{server.connections_served} connections\n")
         if "reg_timer" in d:
             d["reg_timer"].cancel()
         if hnp is not None:
@@ -498,6 +502,10 @@ def run_local(opts, rpp: int, hybrid: bool, ckpt_env: dict) -> int:
                 p.kill()
         for t in fwd_threads:
             t.join(timeout=1.0)
+        if "kv" in opts.verbose.split(","):
+            sys.stderr.write(
+                f"mpirun: kv server served "
+                f"{server.connections_served} connections\n")
         server.close()
         shutil.rmtree(session, ignore_errors=True)
 

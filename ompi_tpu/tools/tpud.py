@@ -117,6 +117,8 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     chan_box: List[Optional[oob.Channel]] = [None]
 
+    proxies: list = []
+
     def report(msg: dict) -> None:
         ch = chan_box[0]
         if ch is None:
@@ -142,6 +144,18 @@ def main(argv: Optional[List[str]] = None) -> int:
         env_base["TPUMPI_SESSION_DIR"] = session
         env_base["TPUMPI_NODE"] = str(opts.node)
         env_base.setdefault("TPUMPI_MCA_btl_tcp_if_ip", if_ip)
+        # KV aggregation proxy (grpcomm analog): local ranks talk to
+        # this daemon, the central server sees ONE connection per node
+        node_ranks = sum(max(1, p["nlocal"]) for p in msg["procs"])
+        if env_base.get("TPUMPI_KV_ADDR") and node_ranks:
+            from ompi_tpu.runtime.kvstore import KVProxy
+            try:
+                proxy = KVProxy(env_base["TPUMPI_KV_ADDR"],
+                                local_expected=node_ranks)
+                proxies.append(proxy)
+                env_base["TPUMPI_KV_ADDR"] = proxy.addr
+            except OSError:
+                pass  # fall back to direct connections
         prog = msg["prog"]
         args = msg.get("args") or []
         node_ranks = sum(max(1, p["nlocal"]) for p in msg["procs"])

@@ -182,3 +182,20 @@ def test_sim_nodes_nonzero_exit_kills_job():
                timeout=120)
     assert r.returncode == 7, (r.returncode, r.stderr.decode())
     assert "terminating job" in r.stderr.decode()
+
+
+def test_kv_proxy_aggregates_connections():
+    """The per-node KV proxy (grpcomm analog) collapses per-rank KV
+    traffic: the central server sees O(daemons) connections, not
+    O(ranks) — with 8 ranks on 2 simulated nodes, at most 2 upstream
+    channels per daemon (ops + fence) instead of 8 rank sockets."""
+    import re
+    r = mpirun(8, "hello.py", "--simulate-nodes", "2x4",
+               "--devices", "none", "--verbose", "kv")
+    assert r.returncode == 0, r.stderr.decode()
+    err = r.stderr.decode()
+    m = re.search(r"kv server served (\d+) connections", err)
+    assert m, err
+    served = int(m.group(1))
+    assert served <= 4, f"expected O(daemons) connections, saw {served}"
+    assert b"Hello" in r.stdout
