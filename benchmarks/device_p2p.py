@@ -1,0 +1,84 @@
+"""Device p2p bench: the btl/tpu D2D path vs the host-staged path,
+timed truthfully (wall clock around completed round trips; results
+are materialized each iteration via a host read of one element, so
+no dispatch-floor artifacts — the same discipline as device_sweep).
+
+    python benchmarks/device_p2p.py [--nranks 2] [--max-bytes N]
+
+Prints one JSON line: {nbytes: {"device_us": .., "staged_us": ..}}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def run(nranks: int, max_bytes: int) -> dict:
+    from ompi_tpu.testing import run_ranks
+
+    def fn(comm):
+        import jax
+        import jax.numpy as jnp
+
+        out = {}
+        nxt = (comm.rank + 1) % comm.size
+        prv = (comm.rank - 1) % comm.size
+        nbytes = 4
+        while nbytes <= max_bytes:
+            n = max(1, nbytes // 4)
+            x = jnp.full((n,), float(comm.rank), jnp.float32)
+            x.block_until_ready()
+
+            def rtt(exchange) -> float:
+                for _ in range(3):
+                    exchange()
+                iters = max(5, min(200, int(2e6 / max(nbytes, 1))))
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    got = exchange()
+                    # force completion: one host read per iteration
+                    float(np.asarray(got[:1])[0])
+                return (time.perf_counter() - t0) / iters
+
+            dev = rtt(lambda: comm.sendrecv_arr(x, nxt, prv, tag=1))
+            host_buf = np.empty(n, np.float32)
+
+            def staged():
+                # classic host path: d2h, byte send/recv, h2d.
+                # Isend+Recv: head-to-head blocking sends would
+                # deadlock once the size crosses the eager limit
+                from ompi_tpu.datatype import engine as dt
+                req = comm.state.pml.isend(
+                    np.asarray(x), n, dt.FLOAT, nxt, 2, comm)
+                comm.Recv(host_buf, prv, tag=2)
+                req.wait()
+                return jax.device_put(host_buf, comm.state.device)
+
+            stg = rtt(staged)
+            if comm.rank == 0:
+                out[str(nbytes)] = {
+                    "device_us": round(dev * 1e6, 1),
+                    "staged_us": round(stg * 1e6, 1),
+                }
+            comm.Barrier()
+            nbytes *= 8
+        return out
+
+    res = run_ranks(nranks, fn, devices=True, timeout=600)
+    return res[0]
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nranks", type=int, default=2)
+    ap.add_argument("--max-bytes", type=int, default=4 * 1024 * 1024)
+    opts = ap.parse_args()
+    print(json.dumps(run(opts.nranks, opts.max_bytes)))
+
+
+if __name__ == "__main__":
+    main()

@@ -36,6 +36,17 @@ def main() -> None:
     interior = tile[1:-1, 1:-1]
     north, south, west, east = halo
     mean_halo = (north.sum() + south.sum() + west.sum() + east.sum()) / (4 * n)
+
+    # device halo: when this rank owns a chip (hybrid launch), the
+    # same shift runs device-to-device through the btl/tpu shim —
+    # sendrecv_arr places the edge on the neighbor's chip directly
+    if cart.state.device is not None:
+        import jax.numpy as jnp
+        left, right = cart.Shift(1, 1)
+        dev_edge = jnp.asarray(tile[:, -1])
+        dev_halo = cart.sendrecv_arr(dev_edge, right, left, tag=11)
+        assert float(dev_halo[0]) == float(left), "device halo mismatch"
+
     print(f"rank {cart.rank} coords {cart.Get_coords()} "
           f"halo-mean {mean_halo:.2f} interior-mean {interior.mean():.2f}",
           flush=True)

@@ -1,0 +1,115 @@
+"""Device-buffer point-to-point — the ``btl/tpu`` HBM shim of the
+north star (BASELINE.json; SURVEY §2.8 send/recv row).
+
+Send/recv where both ends own devices moves the bytes
+DEVICE-TO-DEVICE: the sender places the array on the receiver's chip
+with ``jax.device_put`` (an ICI/D2D copy on real hardware — XLA
+picks the transfer path) and the reference rides the pml as an
+opaque payload through the inproc btl, so co-located rank-threads
+(the TPU-host execution model) never bounce through host memory.
+Crossing a process/host boundary, the payload wrapper pickles itself
+to numpy — exactly ONE host staging, at the last possible moment
+(the coll/cuda staging discipline, ref: ompi/mca/coll/cuda).
+
+Eligibility mirrors coll/device: the D2D placement depends only on
+peer locality and device ownership (never on argument residency), so
+both sides always agree on the protocol — there is nothing to
+diverge on because the receiver accepts the same wrapper either way.
+
+API (on Communicator): ``send_arr`` / ``recv_arr`` /
+``sendrecv_arr``.  Ordering and matching are the pml's (same
+(cid, src, tag) discipline as byte messages).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import numpy as np
+
+
+class DeviceArrayPayload:
+    """Opaque pml payload carrying a device array by reference.
+
+    Within a process it is never serialized (inproc passes the
+    object).  Crossing a process boundary the wire codec's pickle
+    fallback invokes ``__getstate__``, which host-stages to numpy —
+    the single host bounce of the cross-host path."""
+
+    __slots__ = ("arr",)
+
+    def __init__(self, arr) -> None:
+        self.arr = arr
+
+    def __len__(self) -> int:
+        """Payload size in bytes (the pml envelope's total)."""
+        a = self.arr
+        nbytes = getattr(a, "nbytes", None)
+        if nbytes is None:
+            nbytes = np.asarray(a).nbytes
+        return int(nbytes)
+
+    def __getstate__(self):
+        return {"np": np.asarray(self.arr)}
+
+    def __setstate__(self, st) -> None:
+        self.arr = st["np"]
+
+
+def _peer_device(comm, dst: int):
+    """The destination rank's jax device when it is a co-resident
+    rank-thread, else None (host staging will apply)."""
+    state = comm.state
+    world = getattr(state.rte, "world", None)
+    if world is None:
+        return None
+    gdst = comm.group[dst]
+    if not world.is_local(gdst):
+        return None
+    peer_state = world.states[gdst]
+    return getattr(peer_state, "device", None) \
+        if peer_state is not None else None
+
+
+def send_arr(comm, x, dst: int, tag: int = 0) -> None:
+    """Device-aware send: D2D placement onto the receiver's chip when
+    the peer is a co-resident rank-thread, by-reference delivery
+    through the pml; host-staged exactly once otherwise.  PROC_NULL
+    destinations are no-ops (MPI semantics — cart.Shift edges)."""
+    from ompi_tpu.pml.request import PROC_NULL
+    if dst == PROC_NULL:
+        return
+    pdev = _peer_device(comm, dst)
+    if pdev is not None:
+        import jax
+        x = jax.device_put(x, pdev)
+    comm.state.pml.isend_obj(DeviceArrayPayload(x), dst, tag, comm)
+
+
+def recv_arr(comm, src: int, tag: int = 0):
+    """Matched receive of a device-array payload; the result lives on
+    this rank's device (or stays a numpy array when the rank owns no
+    device)."""
+    from ompi_tpu.pml.request import PROC_NULL
+    if src == PROC_NULL:
+        return None
+    msg = comm.state.pml.recv_obj(src, tag, comm)
+    payload = msg.payload
+    if not isinstance(payload, DeviceArrayPayload):
+        raise TypeError(
+            f"recv_arr matched a non-device message (tag {tag} from "
+            f"{src}); byte messages use Recv")
+    arr = payload.arr
+    dev = comm.state.device
+    if dev is not None:
+        import jax
+        if getattr(arr, "device", None) != dev:
+            arr = jax.device_put(arr, dev)
+    return arr
+
+
+def sendrecv_arr(comm, x, dst: int, src: int, tag: int = 0):
+    """Combined exchange (halo shifts): the send is eager-object, so
+    posting it before the blocking receive is deadlock-free."""
+    send_arr(comm, x, dst, tag)
+    return recv_arr(comm, src, tag)

@@ -36,6 +36,13 @@ _I64_MIN = -(1 << 63)
 _I64_MAX = (1 << 63) - 1
 
 
+def _is_buf(x) -> bool:
+    """Only real byte buffers ride the binary fast path; opaque
+    payload objects (device arrays, btl/tpu) take the pickle
+    fallback, which host-stages them via __getstate__."""
+    return isinstance(x, (bytes, bytearray, memoryview))
+
+
 def _fits(*vals: int) -> bool:
     for v in vals:
         if not (isinstance(v, int) and _I64_MIN <= v <= _I64_MAX):
@@ -49,17 +56,21 @@ def encode(frag: Any) -> Tuple[bytes, Optional[Any]]:
     on the wire immediately after, or None."""
     if type(frag) is tuple and frag:
         k = frag[0]
-        if k == "M" and len(frag) == 7 and _fits(*frag[1:6]):
+        if k == "M" and len(frag) == 7 and _is_buf(frag[6]) \
+                and _fits(*frag[1:6]):
             return _M.pack(1, *frag[1:6]), frag[6]
-        if k == "F" and len(frag) == 4 and _fits(*frag[1:3]):
+        if k == "F" and len(frag) == 4 and _is_buf(frag[3]) \
+                and _fits(*frag[1:3]):
             return _F.pack(6, *frag[1:3]), frag[3]
         if k == "A" and len(frag) == 3 and _fits(*frag[1:]):
             return _A.pack(4, *frag[1:]), None
         if k == "SA" and len(frag) == 2 and _fits(frag[1]):
             return _SA.pack(5, frag[1]), None
-        if k == "MS" and len(frag) == 8 and _fits(*frag[1:7]):
+        if k == "MS" and len(frag) == 8 and _is_buf(frag[7]) \
+                and _fits(*frag[1:7]):
             return _MS.pack(2, *frag[1:7]), frag[7]
-        if k == "R" and len(frag) == 9 and _fits(*frag[1:8]):
+        if k == "R" and len(frag) == 9 and _is_buf(frag[8]) \
+                and _fits(*frag[1:8]):
             return _R.pack(3, *frag[1:8]), frag[8]
     return b"\x00" + pickle.dumps(frag, protocol=pickle.HIGHEST_PROTOCOL), None
 

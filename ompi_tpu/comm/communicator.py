@@ -800,6 +800,19 @@ class Communicator:
         """perm: [(src_rank, dst_rank), ...] — mesh-neighbor shift."""
         return self.coll.ppermute_arr(self, x, perm)
 
+    # -- device point-to-point (btl/tpu shim; see ompi_tpu/btl/tpu) ----
+    def send_arr(self, x, dst, tag: int = 0) -> None:
+        from ompi_tpu.btl import tpu as _tpu
+        _tpu.send_arr(self, x, dst, tag)
+
+    def recv_arr(self, src, tag: int = 0):
+        from ompi_tpu.btl import tpu as _tpu
+        return _tpu.recv_arr(self, src, tag)
+
+    def sendrecv_arr(self, x, dst, src, tag: int = 0):
+        from ompi_tpu.btl import tpu as _tpu
+        return _tpu.sendrecv_arr(self, x, dst, src, tag)
+
     # -- topologies (ompi/mca/topo analog; ompi_tpu.topo) ---------------
     def Create_cart(self, dims, periods=None, reorder: bool = False):
         from ompi_tpu.topo import cart_create

@@ -55,6 +55,7 @@ MODE_BUFFERED = 3
 
 # Frag kinds (tuple tag at index 0)
 MATCH = "M"
+MATCH_OBJ = "MO"   # opaque-object payload (device arrays, btl/tpu)
 MATCH_SYNC = "MS"
 RNDV = "R"
 ACK = "A"
@@ -150,10 +151,10 @@ class PmlOb1:
         return ep
 
     # -- send ------------------------------------------------------------
-    def isend(self, buf, count, datatype, dst, tag, comm,
-              mode=MODE_STANDARD, offset: int = 0) -> Request:
-        if dst == PROC_NULL:
-            return CompletedRequest(self.state.progress)
+    def _envelope(self, dst, tag, comm):
+        """Shared send-side bookkeeping: rank check + translation,
+        per-(cid,dst) sequencing, C/R sent counting.  Returns
+        (gdst, endpoint, seq)."""
         if not 0 <= dst < len(comm.group):
             # comm.group is the p2p translation table: the membership
             # for intracomms, the REMOTE group for intercomms
@@ -162,12 +163,21 @@ class PmlOb1:
                 "destination group (MPI_ERR_RANK)")
         gdst = comm.group[dst]
         ep = self._ep(gdst)
+        key = (comm.cid, dst)
+        seq = self._send_seq.get(key, 0)
+        self._send_seq[key] = seq + 1
+        if tag >= 0:
+            self.cr_sent[gdst] = self.cr_sent.get(gdst, 0) + 1
+        return gdst, ep, seq
+
+    def isend(self, buf, count, datatype, dst, tag, comm,
+              mode=MODE_STANDARD, offset: int = 0) -> Request:
+        if dst == PROC_NULL:
+            return CompletedRequest(self.state.progress)
+        gdst, ep, seq = self._envelope(dst, tag, comm)
         btl = ep.btl
         conv = make_convertor(datatype, count, buf, offset=offset)
         cid = comm.cid
-        key = (cid, dst)
-        seq = self._send_seq.get(key, 0)
-        self._send_seq[key] = seq + 1
         src = comm.rank
         req_id = next(self._req_counter)
         req = SendRequest(self.state.progress, conv, req_id, gdst)
@@ -176,8 +186,6 @@ class PmlOb1:
         if peruse.enabled:
             peruse.fire("req_activate", kind="send", cid=cid, peer=dst,
                         tag=tag, bytes=conv.packed_size)
-        if tag >= 0:
-            self.cr_sent[gdst] = self.cr_sent.get(gdst, 0) + 1
 
         gsrc = self.state.rank  # global sender id (C/R bookkeeping)
         if conv.packed_size <= btl.eager_limit and mode != MODE_SYNC:
@@ -207,6 +215,41 @@ class PmlOb1:
              mode=MODE_STANDARD, offset: int = 0) -> Status:
         return self.isend(buf, count, datatype, dst, tag, comm, mode,
                           offset).wait()
+
+    # -- opaque-object channel (device payloads; btl/tpu shim) ----------
+    def isend_obj(self, obj, dst, tag, comm) -> None:
+        """Eager send of an opaque payload object: same envelope and
+        sequencing as byte messages, but a DISTINCT kind (MATCH_OBJ)
+        so object messages can never bind a posted byte receive (and
+        byte probes never steal them).  The object rides by reference
+        through inproc and host-stages (pickle) across processes."""
+        if dst == PROC_NULL:
+            return
+        gdst, ep, seq = self._envelope(dst, tag, comm)
+        ep.send((MATCH_OBJ, comm.cid, comm.rank, tag, seq,
+                 self.state.rank, obj))
+
+    def recv_obj(self, src, tag, comm):
+        """Blocking matched receive of an object message (kind
+        MATCH_OBJ only) returning the UnexpectedMsg with its payload
+        uninterpreted (no convertor)."""
+        if src == PROC_NULL:
+            return None
+        while True:
+            self.state.progress.progress()
+            cid = comm.cid
+            best = None
+            for m in self._unexpected.get(cid, []):
+                if m.kind == MATCH_OBJ and \
+                        (src == ANY_SOURCE or m.src == src) and \
+                        (m.tag == tag or (tag == ANY_TAG
+                                          and m.tag >= 0)):
+                    if best is None or m.arrival < best.arrival:
+                        best = m
+            if best is not None:
+                self._unexpected[cid].remove(best)
+                return best
+            self.state.progress.idle_tick()
 
     # -- recv ------------------------------------------------------------
     def irecv(self, buf, count, datatype, src, tag, comm,
@@ -290,8 +333,10 @@ class PmlOb1:
         # order, so match the earliest arrival only
         best = None
         for m in self._unexpected.get(cid, []):
-            # ANY_TAG never matches reserved internal (negative) tags
-            if (src == ANY_SOURCE or m.src == src) and \
+            # ANY_TAG never matches reserved internal (negative) tags;
+            # object messages (MATCH_OBJ) belong to recv_obj only
+            if m.kind != MATCH_OBJ and \
+               (src == ANY_SOURCE or m.src == src) and \
                (m.tag == tag or (tag == ANY_TAG and m.tag >= 0)):
                 if best is None or m.arrival < best.arrival:
                     best = m
@@ -378,8 +423,8 @@ class PmlOb1:
 
     def _handle(self, frag: tuple) -> None:
         kind = frag[0]
-        if kind in (MATCH, MATCH_SYNC, RNDV):
-            if kind == MATCH:
+        if kind in (MATCH, MATCH_OBJ, MATCH_SYNC, RNDV):
+            if kind in (MATCH, MATCH_OBJ):
                 _, cid, src, tag, seq, gsrc, payload = frag
                 msg = UnexpectedMsg(kind, cid, src, tag, seq,
                                     len(payload), None, payload)
@@ -418,6 +463,11 @@ class PmlOb1:
             self._cant_match.setdefault(key, {})[msg.seq] = msg
             return
         self._advance_seq(msg.cid, msg.src)
+        if msg.kind == MATCH_OBJ:
+            # object messages wait for recv_obj; a posted byte recv
+            # must never bind one (its payload is not a buffer)
+            self._unexpected.setdefault(msg.cid, []).append(msg)
+            return
         req = self._match_posted(msg.cid, msg.src, msg.tag)
         if req is not None:
             if peruse.enabled:

@@ -1,0 +1,21 @@
+"""Cross-process device p2p driver (run under mpirun): the payload
+host-stages through the wrapper's pickle exactly once."""
+import numpy as np
+
+import ompi_tpu
+
+comm = ompi_tpu.init()
+if comm.rank == 0:
+    try:
+        import jax.numpy as jnp
+        x = jnp.arange(16.0)
+    except Exception:
+        x = np.arange(16.0)
+    comm.send_arr(x, 1, tag=5)
+else:
+    got = comm.recv_arr(0, tag=5)
+    assert float(np.asarray(got)[15]) == 15.0
+comm.Barrier()
+if comm.rank == 0:
+    print("devp2p ok", flush=True)
+ompi_tpu.finalize()
