@@ -83,6 +83,11 @@ def send_arr(comm, x, dst: int, tag: int = 0) -> None:
     if pdev is not None:
         import jax
         x = jax.device_put(x, pdev)
+    elif isinstance(x, np.ndarray):
+        # host-only path delivers by reference within a process: copy
+        # so the user may reuse the send buffer immediately (jax
+        # arrays are immutable and need no copy)
+        x = x.copy()
     comm.state.pml.isend_obj(DeviceArrayPayload(x), dst, tag, comm)
 
 

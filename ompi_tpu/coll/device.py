@@ -168,6 +168,12 @@ class Rendezvous:
                 self.gen += 1
                 self.cv.notify_all()
             else:
+                # No spin before the condvar wait: under the GIL a
+                # lock-free spin HOLDS the interpreter for up to the
+                # switch interval (5 ms) and sched_yield burns CFS
+                # quanta on shared cores — measured strictly worse
+                # than parking on the condvar, which hands the GIL
+                # straight to the rank that can make progress.
                 t0 = time.monotonic()
                 while gen not in self.results:
                     if not self.cv.wait(timeout=poll):
@@ -474,16 +480,26 @@ class HbmCollModule(CollModule):
         self.fallback = fallback
 
     def _eligible(self, comm, *arrays) -> bool:
-        # comm-consistent only (see TpuCollModule._eligible)
-        if comm.size == 1:
-            return False
-        devs = set()
-        for g in comm.group:
-            st = comm._peer_state(g)
-            if st is None or st.device is None:
-                return False
-            devs.add(st.device.id)
-        return len(devs) == 1 and all(
+        # comm-consistent only (see TpuCollModule._eligible).  The
+        # device-layout half (all members on ONE chip) never changes
+        # for a comm, so it is computed once; per call only the dtype
+        # check remains (4-byte-floor hot path).
+        one_dev = comm.__dict__.get("_hbm_one_device")
+        if one_dev is None:
+            if comm.size == 1:
+                one_dev = False
+            else:
+                devs = set()
+                one_dev = True
+                for g in comm.group:
+                    st = comm._peer_state(g)
+                    if st is None or st.device is None:
+                        one_dev = False
+                        break
+                    devs.add(st.device.id)
+                one_dev = one_dev and len(devs) == 1
+            comm.__dict__["_hbm_one_device"] = one_dev
+        return one_dev and all(
             _dtype_of(a).fields is None for a in arrays)
 
     _abort_check = TpuCollModule._abort_check
@@ -558,13 +574,24 @@ class HbmCollModule(CollModule):
 
     def _run(self, comm, kind, opname, x, extra=None):
         x = self._deposit(comm, x)
-        jbody, out = self._stacked(kind, opname, comm.size, x.shape,
-                                   x.dtype, extra)
+        # pre-resolved plan: the (kind, op, shape, dtype) -> closure
+        # resolution is cached on the comm so the per-call cost is one
+        # dict hit, not key construction + jit-cache lookup + closure
+        # rebuild (VERDICT r2 #3)
+        plans = comm.__dict__.get("_hbm_plans")
+        if plans is None:
+            plans = comm.__dict__["_hbm_plans"] = {}
+        pkey = (kind, opname, x.shape, x.dtype, extra)
+        fn = plans.get(pkey)
+        if fn is None:
+            jbody, out = self._stacked(kind, opname, comm.size,
+                                       x.shape, x.dtype, extra)
+            size = comm.size
 
-        def fn(shards):
-            r = jbody(*shards)
-            return out(r, comm.size)
+            def fn(shards, _j=jbody, _o=out, _n=size):
+                return _o(_j(*shards), _n)
 
+            plans[pkey] = fn
         rv = _get_rendezvous(comm)
         return rv.run(comm.rank, x, fn, self._abort_check(comm))
 

@@ -298,16 +298,23 @@ class Communicator:
     # -- TPU mesh mapping (SURVEY.md §2.8) -------------------------------
     def mesh(self):
         """1-D jax Mesh over member devices, or None when members
-        don't own distinct devices (then coll/tpu is not eligible)."""
+        don't own distinct devices (then coll/tpu is not eligible).
+        Both verdicts are cached: device ownership is fixed for a
+        comm's members, and the walk over peer states costs more than
+        a small collective at the 4-byte floor."""
         if self._mesh is not None:
             return self._mesh
+        if self.__dict__.get("_mesh_none"):
+            return None
         devs = []
         for g in self.group:
             st = self._peer_state(g)
             if st is None or st.device is None:
+                self.__dict__["_mesh_none"] = True
                 return None
             devs.append(st.device)
         if len({d.id for d in devs}) != len(devs):
+            self.__dict__["_mesh_none"] = True
             return None
         import numpy as _np
         from jax.sharding import Mesh

@@ -174,9 +174,12 @@ class PmlOb1:
               mode=MODE_STANDARD, offset: int = 0) -> Request:
         if dst == PROC_NULL:
             return CompletedRequest(self.state.progress)
+        # convertor construction FIRST: an argument error must not
+        # consume the (cid,dst) sequence number (a burned seq wedges
+        # the channel — the receiver can never match past the hole)
+        conv = make_convertor(datatype, count, buf, offset=offset)
         gdst, ep, seq = self._envelope(dst, tag, comm)
         btl = ep.btl
-        conv = make_convertor(datatype, count, buf, offset=offset)
         cid = comm.cid
         src = comm.rank
         req_id = next(self._req_counter)
@@ -237,17 +240,10 @@ class PmlOb1:
             return None
         while True:
             self.state.progress.progress()
-            cid = comm.cid
-            best = None
-            for m in self._unexpected.get(cid, []):
-                if m.kind == MATCH_OBJ and \
-                        (src == ANY_SOURCE or m.src == src) and \
-                        (m.tag == tag or (tag == ANY_TAG
-                                          and m.tag >= 0)):
-                    if best is None or m.arrival < best.arrival:
-                        best = m
+            best = self._find_unexpected(comm.cid, src, tag,
+                                         want_obj=True)
             if best is not None:
-                self._unexpected[cid].remove(best)
+                self._unexpected[comm.cid].remove(best)
                 return best
             self.state.progress.idle_tick()
 
@@ -327,15 +323,17 @@ class PmlOb1:
     def _matchable(self, cid: int, src: int, seq: int) -> bool:
         return self._next_seq.get((cid, src), 0) == seq
 
-    def _find_unexpected(self, cid, src, tag) -> Optional[UnexpectedMsg]:
+    def _find_unexpected(self, cid, src, tag,
+                         want_obj: bool = False) -> Optional[UnexpectedMsg]:
         # messages here already consumed their sequence number at
         # arrival dispatch; FIFO per source is preserved by arrival
-        # order, so match the earliest arrival only
+        # order, so match the earliest arrival only.  ``want_obj``
+        # selects the object channel (MATCH_OBJ) vs byte messages —
+        # the two never match each other's receives.
         best = None
         for m in self._unexpected.get(cid, []):
-            # ANY_TAG never matches reserved internal (negative) tags;
-            # object messages (MATCH_OBJ) belong to recv_obj only
-            if m.kind != MATCH_OBJ and \
+            # ANY_TAG never matches reserved internal (negative) tags
+            if (m.kind == MATCH_OBJ) == want_obj and \
                (src == ANY_SOURCE or m.src == src) and \
                (m.tag == tag or (tag == ANY_TAG and m.tag >= 0)):
                 if best is None or m.arrival < best.arrival:
@@ -548,13 +546,22 @@ class PmlOb1:
                     # it is consumed by OUR upcoming phase, never
                     # snapshotted
                     continue
+                if m.kind == MATCH_OBJ:
+                    # in-flight device payload (send_arr completed,
+                    # recv_arr pending): host-stage it into the
+                    # snapshot; restore reinjects it as an object
+                    # message whose array is reborn on device at
+                    # recv_arr time
+                    msgs.append((cid, m.src, m.tag, m.total, "obj",
+                                 np.asarray(m.payload.arr)))
+                    continue
                 if m.kind != MATCH:
                     raise RuntimeError(
                         f"cr_capture: {m.kind} message unmatched at "
                         "quiesce (sender's request could not have "
                         "completed — user requests must complete "
                         "before checkpoint)")
-                msgs.append((cid, m.src, m.tag, m.total,
+                msgs.append((cid, m.src, m.tag, m.total, "bytes",
                              bytes(m.payload)))
         return msgs
 
@@ -563,9 +570,14 @@ class PmlOb1:
         Sequence numbers restart from zero on both sides after a
         restart, so reinjection bypasses sequencing (these envelopes
         already consumed their pre-checkpoint sequence slots)."""
-        for cid, src, tag, total, payload in msgs:
-            m = UnexpectedMsg(MATCH, cid, src, tag, 0, total, None,
-                              payload)
+        for cid, src, tag, total, kind, payload in msgs:
+            if kind == "obj":
+                from ompi_tpu.btl.tpu import DeviceArrayPayload
+                m = UnexpectedMsg(MATCH_OBJ, cid, src, tag, 0, total,
+                                  None, DeviceArrayPayload(payload))
+            else:
+                m = UnexpectedMsg(MATCH, cid, src, tag, 0, total,
+                                  None, payload)
             self._unexpected.setdefault(cid, []).append(m)
 
     # -- cancel ----------------------------------------------------------
