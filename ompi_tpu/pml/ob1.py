@@ -570,7 +570,13 @@ class PmlOb1:
         Sequence numbers restart from zero on both sides after a
         restart, so reinjection bypasses sequencing (these envelopes
         already consumed their pre-checkpoint sequence slots)."""
-        for cid, src, tag, total, kind, payload in msgs:
+        for entry in msgs:
+            if len(entry) == 5:
+                # pre-object-channel snapshot (5-tuple, bytes only)
+                cid, src, tag, total, payload = entry
+                kind = "bytes"
+            else:
+                cid, src, tag, total, kind, payload = entry
             if kind == "obj":
                 from ompi_tpu.btl.tpu import DeviceArrayPayload
                 m = UnexpectedMsg(MATCH_OBJ, cid, src, tag, 0, total,

@@ -35,8 +35,14 @@ def mpi_init(state: ProcState, device=None) -> ProcState:
         import signal as _signal
         faulthandler.register(_signal.SIGUSR1, all_threads=True,
                               chain=True)
+        # crash backtraces (SIGSEGV/SIGFPE/SIGABRT -> all-thread
+        # dumps): the opal/mca/backtrace analog for native-code
+        # faults in jax/XLA/our C++ ring
+        faulthandler.enable(all_threads=True)
     except (ImportError, AttributeError, ValueError, OSError):
         pass  # non-main thread or unsupported platform
+    from ompi_tpu.runtime import pstat as _pstat
+    _pstat.register_pvars(state.rank)
     from ompi_tpu.runtime import topology as _topo
     _world = getattr(state.rte, "world", None)
     if _world is not None:

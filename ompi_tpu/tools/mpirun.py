@@ -77,7 +77,11 @@ def _wire_abort(server: KVServer, sm: smx.StateMachine) -> None:
 
 def _errmgr_table(sm: smx.StateMachine, drain) -> None:
     """The errmgr/default_hnp policy as state handlers: any failure
-    state drains the job with a diagnostic; DRAINING is idempotent."""
+    state drains the job with a diagnostic; DRAINING is idempotent.
+    Failures also route to the admin notifier sinks (orte/mca/notifier
+    analog; off unless --mca orte_notifier_sinks is set)."""
+    from ompi_tpu.runtime.notifier import notify as _notify
+    _job = f"job-{os.getpid()}"
 
     def _already_drained(sm) -> bool:
         # a late failure/timeout event must never rewrite the exit
@@ -92,6 +96,8 @@ def _errmgr_table(sm: smx.StateMachine, drain) -> None:
         sys.stderr.write(
             f"mpirun: {info['who']} exited with status "
             f"{info['code']}{extra}; terminating job\n")
+        _notify("error", _job,
+                f"{info['who']} exited with status {info['code']}")
         sm.exit_code = code
         sm.activate(smx.DRAINING, failed=True)
 
@@ -101,6 +107,7 @@ def _errmgr_table(sm: smx.StateMachine, drain) -> None:
         sys.stderr.write(
             f"mpirun: lost contact with daemon on node(s) "
             f"[{info['node']}]; terminating job\n")
+        _notify("crit", _job, f"daemon lost on node {info['node']}")
         sm.exit_code = 1
         sm.activate(smx.DRAINING, failed=True)
 
@@ -111,12 +118,15 @@ def _errmgr_table(sm: smx.StateMachine, drain) -> None:
         sys.stderr.write(
             f"mpirun: rank {info['rank']} called "
             f"MPI_Abort({sm.exit_code}): {info['msg']}\n")
+        _notify("error", _job,
+                f"rank {info['rank']} called MPI_Abort")
         sm.activate(smx.DRAINING, failed=True)
 
     def on_timeout(sm, info):
         if _already_drained(sm):
             return
         sys.stderr.write("mpirun: job exceeded --timeout; killing\n")
+        _notify("warn", _job, "job exceeded --timeout")
         sm.exit_code = 124
         sm.activate(smx.DRAINING, failed=True)
 
@@ -620,6 +630,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                 sys.stderr.write(
                     f"mpirun: cannot write job.json: {e}\n")
     opts.ckpt_env = ckpt_env
+    # --mca pairs apply to the LAUNCHER's own registry too (the
+    # reference's orterun reads MCA params itself — e.g. the notifier
+    # sinks used by the errmgr handlers), not only to rank env
+    from ompi_tpu.mca.params import registry as _registry
+    for _k, _v in opts.mca:
+        try:
+            _registry.set(_k, _v)
+        except KeyError:
+            pass  # rank-side-only param unknown to the launcher
     rpp = opts.np if opts.rpp == "all" else opts.rpp
     # 'all' always means hybrid (even -np 1: device assignment and the
     # app shell still apply); an explicit integer 1 means one process

@@ -183,3 +183,42 @@ def test_mpisync_reports_offsets():
     assert data["rtts_us"][1] > 0 and data["rtts_us"][2] > 0
     # same-host clocks: offsets bounded by a loose sanity envelope
     assert all(abs(o) < 5e6 for o in data["offsets_us"])
+
+
+@pytest.mark.skipif(sys.platform != "linux",
+                    reason="pstat scrapes Linux /proc")
+def test_pstat_snapshot_and_pvars():
+    """opal/mca/pstat analog: /proc stats + live MPI_T pvars."""
+    from ompi_tpu.runtime import pstat
+
+    st = pstat.snapshot()
+    assert st, "Linux /proc scrape failed"
+    assert st["rss_mb"] > 0 and st["threads"] >= 1
+    assert st["utime_s"] >= 0
+
+    def fn(comm):
+        pv = next(p for p in registry.all_pvars()
+                  if p.full_name == f"opal_pstat_rss_mb_r{comm.rank}")
+        return pv.read() > 0
+
+    assert all(run_ranks(2, fn))
+
+
+def test_notifier_file_sink(tmp_path):
+    """orte/mca/notifier analog: events route to configured sinks;
+    default is off."""
+    from ompi_tpu.runtime import notifier
+
+    log = tmp_path / "events.log"
+    registry.set("orte_notifier_sinks", f"file:{log}")
+    try:
+        notifier.notify("error", "job-x", "rank 3 exploded")
+        notifier.notify("bogus-severity", "job-x", "still logged")
+    finally:
+        registry.set("orte_notifier_sinks", "")
+    lines = log.read_text().splitlines()
+    assert len(lines) == 2
+    assert "error job=job-x rank 3 exploded" in lines[0]
+    assert "notice" in lines[1]  # unknown severity mapped to notice
+    # default (empty) sinks: no-op, never raises
+    notifier.notify("error", "job-x", "dropped")
