@@ -177,6 +177,12 @@ class VarRegistry:
         if full in self._overrides:
             return _coerce(self._overrides[full], var.typ), SOURCE_OVERRIDE
         env = os.environ.get(ENV_PREFIX + full)
+        if env is None:
+            # schizo analog (ref: orte/mca/schizo/ompi — per-frontend
+            # env translation): accept the reference's OMPI_MCA_*
+            # spelling so users migrating from Open MPI keep their
+            # environment verbatim; our prefix wins when both are set
+            env = os.environ.get("OMPI_MCA_" + full)
         if env is not None:
             return _coerce(env, var.typ), SOURCE_ENV
         fv = self._load_files().get(full)

@@ -102,3 +102,21 @@ def test_parse_mca_args():
     assert rest == ["prog", "arg1"]
     var = params.registry.register("tst", "comp", "gamma", 0, int)
     assert var.value == 5
+
+
+def test_schizo_accepts_ompi_mca_env(monkeypatch):
+    """schizo/ompi analog: OMPI_MCA_* spellings resolve; the native
+    TPUMPI_MCA_* prefix wins when both are set."""
+    from ompi_tpu.mca.params import registry
+
+    v = registry.register("test", "schizo", "knob", 1, int)
+    monkeypatch.setenv("OMPI_MCA_test_schizo_knob", "5")
+    registry.refresh()
+    assert registry.get("test_schizo_knob") == 5
+    monkeypatch.setenv("TPUMPI_MCA_test_schizo_knob", "9")
+    registry.refresh()
+    assert registry.get("test_schizo_knob") == 9
+    monkeypatch.delenv("OMPI_MCA_test_schizo_knob")
+    monkeypatch.delenv("TPUMPI_MCA_test_schizo_knob")
+    registry.refresh()
+    assert registry.get("test_schizo_knob") == 1
