@@ -219,6 +219,12 @@ def run_multinode(opts, nodes, rpp: int, hybrid: bool) -> int:
             node_env[n.node_id] = env
 
         job_env = {
+            # MCA environment forwards to remote ranks (the schizo
+            # discipline: reference users' OMPI_MCA_* env applies
+            # job-wide, not just on the mpirun host); explicit --mca
+            # pairs below still win
+            **{k: v for k, v in os.environ.items()
+               if k.startswith(("TPUMPI_MCA_", "OMPI_MCA_"))},
             **getattr(opts, "ckpt_env", {}),
             "TPUMPI_BIND": opts.bind_to,
             "TPUMPI_SIZE": str(opts.np),
