@@ -334,3 +334,19 @@ def test_comm_free_drops_rendezvous():
 
     res = run_ranks(2, fn, devices=True)
     assert res[0][0] is True and res[0][1] is False
+
+
+def test_ring_attention_example_exact():
+    """The long-context flagship: ring attention via ppermute_arr is
+    EXACT full attention over the comm-wide sequence (online-softmax
+    accumulation while KV blocks rotate the mesh ring)."""
+    import importlib.util
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "ring_attention_example",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "examples",
+            "ring_attention.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mod.main()
