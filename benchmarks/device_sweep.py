@@ -222,6 +222,21 @@ def run_device_sweep(nranks: int, max_ar: int, max_bcast: int,
             comm.Barrier()
             t = _forced_time(comm, make_op, read_token, read_const,
                              deadline)
+            # outlier guard: a single scheduler hiccup on a shared
+            # host can blow one point by 10-50x (observed: 69 ms
+            # between 1.4 ms neighbors).  If this point is >5x the
+            # previous size's time — physically times should GROW
+            # smoothly — re-measure once and keep the minimum (both
+            # measurements are full forced-completion runs, so the
+            # min is still an honest upper bound on the op time).
+            prev = out[kind].get(getattr(one, "_prev_key", None))
+            if (t > 0 and prev and t * 1e6 > 5 * prev
+                    and should_continue(comm, deadline)):
+                t2 = _forced_time(comm, make_op, read_token,
+                                  read_const, deadline)
+                if t2 > 0:
+                    t = min(t, t2)
+            one._prev_key = size_key
             # -1 = deadline hit before the point could be amortized
             # past the read-constant jitter: unmeasurable, not a number
             out[kind][size_key] = round(t * 1e6, 2) if t > 0 else None
