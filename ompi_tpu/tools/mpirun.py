@@ -279,7 +279,13 @@ def run_multinode(opts, nodes, rpp: int, hybrid: bool) -> int:
     def on_launch_apps(sm, info):
         prog = os.path.abspath(opts.prog) if os.path.exists(opts.prog) \
             else opts.prog
-        d["hnp"].launch(prog, opts.args, d["job_env"], opts.wdir)
+        if opts.preload and not os.path.isfile(prog):
+            sm.activate(smx.LAUNCH_FAILED, code=2,
+                        msg=f"--preload: cannot read program "
+                            f"{opts.prog!r}")
+            return
+        d["hnp"].launch(prog, opts.args, d["job_env"], opts.wdir,
+                        preload=opts.preload)
         sm.activate(smx.RUNNING)
 
     def ev_proc_exit(sm, info):  # only abnormal exits are posted
@@ -598,6 +604,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "'python -m ompi_tpu.tools.localssh')")
     ap.add_argument("--tree-radix", type=int, default=32,
                     help="PLM launch-tree fan-out per daemon")
+    ap.add_argument("--preload", action="store_true",
+                    help="Ship the program file to each node inside "
+                         "the launch message (filem/raw analog: no "
+                         "shared filesystem needed)")
     ap.add_argument("--ckpt-dir", default=None, dest="ckpt_dir",
                     help="Checkpoint store root exported to ranks as "
                          "TPUMPI_CKPT_DIR; mpirun records job.json "
@@ -631,7 +641,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                     import json as _json
                     _json.dump({"np": opts.np, "prog": opts.prog,
                                 "args": opts.args, "mca": opts.mca,
-                                "rpp": opts.rpp}, jf)
+                                "rpp": opts.rpp,
+                                "preload": opts.preload}, jf)
             except OSError as e:
                 sys.stderr.write(
                     f"mpirun: cannot write job.json: {e}\n")

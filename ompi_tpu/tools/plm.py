@@ -158,7 +158,17 @@ class HNP:
 
     # ---- job launch + supervision ----------------------------------
     def launch(self, prog: str, args: List[str],
-               env: Dict[str, str], wdir: Optional[str]) -> None:
+               env: Dict[str, str], wdir: Optional[str],
+               preload: bool = False) -> None:
+        """``preload``: ship the program's bytes in the launch message
+        (filem/raw analog, ref: orte/mca/filem/raw — pre-stage files
+        to nodes without a shared filesystem); each daemon writes it
+        into its session dir and runs that copy."""
+        prog_data = None
+        if preload:
+            import base64
+            with open(prog, "rb") as fh:
+                prog_data = base64.b64encode(fh.read()).decode("ascii")
         for m in self.maps:
             if not m.procs:
                 self.events.activate("EV_NODE_DONE",
@@ -170,6 +180,7 @@ class HNP:
                     ch = self.channels[nid]
                 ch.send({
                     "op": "launch", "prog": prog, "args": args,
+                    "prog_data": prog_data,
                     "wdir": wdir, "env": env,
                     "procs": [{"rank_base": p.rank_base,
                                "nlocal": p.nlocal} for p in m.procs],

@@ -27,6 +27,8 @@ def build_cmd(store_dir: str, extra: List[str]) -> List[str]:
     rpp = job.get("rpp", 1)
     if rpp != 1:
         cmd += ["--ranks-per-proc", str(rpp)]
+    if job.get("preload"):
+        cmd += ["--preload"]
     cmd += extra
     cmd += [job["prog"]] + list(job.get("args") or [])
     return cmd

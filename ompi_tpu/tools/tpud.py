@@ -157,8 +157,17 @@ def main(argv: Optional[List[str]] = None) -> int:
             except OSError:
                 pass  # fall back to direct connections
         prog = msg["prog"]
+        # filem/raw analog: a preloaded program arrives as bytes in
+        # the launch message; write it into the session dir and run
+        # the staged copy (no shared filesystem required)
+        if msg.get("prog_data"):
+            staged = os.path.join(
+                session, "staged_" + os.path.basename(prog))
+            with open(staged, "wb") as fh:
+                fh.write(base64.b64decode(msg["prog_data"]))
+            os.chmod(staged, 0o755)  # binaries exec directly
+            prog = staged
         args = msg.get("args") or []
-        node_ranks = sum(max(1, p["nlocal"]) for p in msg["procs"])
         node_base = min(p["rank_base"] for p in msg["procs"])
         env_base["TPUMPI_NODE_RANK_BASE"] = str(node_base)
         local_idx = 0  # rank index WITHIN this node (binding input)

@@ -199,3 +199,35 @@ def test_kv_proxy_aggregates_connections():
     served = int(m.group(1))
     assert served <= 4, f"expected O(daemons) connections, saw {served}"
     assert b"Hello" in r.stdout
+
+
+def test_preload_stages_program_to_nodes(tmp_path):
+    """filem/raw analog: --preload ships the program bytes in the
+    launch message; daemons run the staged copy from their session
+    dir (no shared-filesystem assumption)."""
+    prog = tmp_path / "myprog.py"
+    prog.write_text(
+        "import os\n"
+        "import ompi_tpu\n"
+        "comm = ompi_tpu.init()\n"
+        "print('RAN', comm.rank, os.path.abspath(__file__), flush=True)\n"
+        "ompi_tpu.finalize()\n")
+    r = subprocess.run(
+        [sys.executable, "-m", "ompi_tpu.tools.mpirun", "-np", "2",
+         "--simulate-nodes", "2x1", "--devices", "none", "--preload",
+         "--timeout", "120", str(prog)],
+        capture_output=True, timeout=180,
+        env={**os.environ,
+             "PYTHONPATH": REPO + os.pathsep
+             + os.environ.get("PYTHONPATH", "")},
+        cwd=REPO)
+    assert r.returncode == 0, r.stderr.decode()
+    out = r.stdout.decode()
+    ran = [ln for ln in out.splitlines() if ln.startswith("RAN")]
+    assert len(ran) == 2
+    # the executed file is the STAGED copy in a session dir, not the
+    # original path
+    for ln in ran:
+        path = ln.split()[-1]
+        assert str(prog) != path
+        assert os.path.basename(path) == "staged_myprog.py"
