@@ -1,0 +1,142 @@
+"""coll/sm analog: single-meeting collectives for thread-rank worlds
+(ref: ompi/mca/coll/sm).  Results must match the p2p path
+bit-for-bit, including rank-order folds for non-commutative ops."""
+
+import numpy as np
+import pytest
+
+from ompi_tpu.op import op as mpi_op
+from ompi_tpu.testing import run_ranks
+
+
+def test_allreduce_matches_p2p_bitwise():
+    def fn(comm):
+        x = (np.arange(8, dtype=np.float64) + comm.rank * 0.1)
+        r_sm = np.empty_like(x)
+        comm.Allreduce(x, r_sm, mpi_op.SUM)
+        # p2p result via the tuned module directly.  Exact ORDER
+        # equivalence is covered by the non-commutative test below;
+        # here different fold trees (sm left fold vs p2p binomial)
+        # may differ in float rounding, so compare numerically.
+        from ompi_tpu.coll.tuned import TunedModule
+        from ompi_tpu.datatype import engine as dt
+        r_p2p = np.empty_like(x)
+        TunedModule().allreduce(comm, x, r_p2p, 8, dt.DOUBLE,
+                                mpi_op.SUM)
+        np.testing.assert_allclose(r_sm, r_p2p, rtol=1e-12)
+        return True
+
+    assert all(run_ranks(4, fn))
+
+
+def test_noncommutative_user_op_rank_order():
+    def fn(comm):
+        # left-fold of string-like concat encoded as base-10 digits:
+        # (((r0 op r1) op r2) op r3) — order-sensitive
+        def user(invec, inoutvec, _dt):
+            inoutvec[:] = invec * 10 + inoutvec
+
+        op = mpi_op.create(user, commute=False)
+        x = np.array([comm.rank + 1], dtype=np.int64)
+        r = np.empty_like(x)
+        comm.Allreduce(x, r, op)
+        # left fold: ((1*10+2)*10+3)*10+4 = 1234 for size 4
+        want = 0
+        for d in range(1, comm.size + 1):
+            want = want * 10 + d
+        # user op convention: invec is the LOWER-rank partial
+        assert r[0] == want, (r[0], want)
+        return True
+
+    assert all(run_ranks(4, fn))
+
+
+def test_bcast_and_root_buffer_reuse():
+    def fn(comm):
+        buf = np.full(16, float(comm.rank), np.float64)
+        if comm.rank == 2:
+            buf[:] = 7.25
+        comm.Bcast(buf, root=2)
+        # root may clobber its buffer immediately after returning
+        if comm.rank == 2:
+            buf[:] = -1.0
+        comm.Barrier()
+        if comm.rank != 2:
+            assert (buf == 7.25).all()
+        return True
+
+    assert all(run_ranks(6, fn))
+
+
+def test_reduce_only_root_receives():
+    def fn(comm):
+        x = np.full(4, comm.rank + 1.0)
+        r = np.zeros(4) if comm.rank == 1 else None
+        comm.Reduce(x, r, mpi_op.MAX, root=1)
+        if comm.rank == 1:
+            assert (r == comm.size).all()
+        return True
+
+    assert all(run_ranks(5, fn))
+
+
+def test_allgather_and_alltoall():
+    def fn(comm):
+        n = comm.size
+        mine = np.array([comm.rank * 10 + 1], np.int32)
+        allg = np.empty(n, np.int32)
+        comm.Allgather(mine, allg)
+        assert list(allg) == [r * 10 + 1 for r in range(n)]
+
+        sb = np.array([comm.rank * n + d for d in range(n)], np.int64)
+        rb = np.empty_like(sb)
+        comm.Alltoall(sb, rb)
+        assert list(rb) == [s * n + comm.rank for s in range(n)]
+        return True
+
+    assert all(run_ranks(4, fn))
+
+
+def test_minloc_pair_and_in_place():
+    def fn(comm):
+        from ompi_tpu.datatype import engine as dt
+        pair = np.zeros(2, dtype=[("v", "f8"), ("i", "i8")])
+        pair["v"] = [comm.rank + 0.5, 10 - comm.rank]
+        pair["i"] = comm.rank
+        out = np.empty_like(pair)
+        comm.Allreduce((pair, 2, dt.DOUBLE_INT), (out, 2, dt.DOUBLE_INT),
+                       mpi_op.MINLOC)
+        assert out["i"][0] == 0          # min of rank+0.5 at rank 0
+        assert out["i"][1] == comm.size - 1
+
+        buf = np.full(3, comm.rank + 1.0)
+        from ompi_tpu.coll.buffers import IN_PLACE
+        comm.Allreduce(IN_PLACE, buf, mpi_op.SUM)
+        assert (buf == sum(range(1, comm.size + 1))).all()
+        return True
+
+    assert all(run_ranks(3, fn))
+
+
+def test_derived_datatype_goes_through_pack():
+    def fn(comm):
+        from ompi_tpu.datatype import engine as dt
+        vec = dt.vector(3, 1, 2, dt.DOUBLE).commit()
+        sb = np.arange(6, dtype=np.float64) + comm.rank
+        rb = np.zeros(6, dtype=np.float64)
+        comm.Allreduce((sb, 1, vec), (rb, 1, vec), mpi_op.SUM)
+        n = comm.size
+        base = sum(range(n))
+        # strided elements reduced; gaps untouched
+        assert rb[0] == 0 * n + base and rb[2] == 2 * n + base
+        assert rb[1] == 0.0
+        return True
+
+    assert all(run_ranks(4, fn))
+
+
+def test_sm_actually_selected_in_thread_world():
+    def fn(comm):
+        return comm.coll.providers.get("allreduce") == "sm"
+
+    assert all(run_ranks(2, fn))
