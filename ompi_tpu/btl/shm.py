@@ -350,6 +350,20 @@ class ShmModule(BTLModule):
         return events
 
     def finalize(self) -> None:
+        if self._parked is not None:
+            # clear OUR parked byte first: a stale parked=1 flag makes
+            # every surviving peer pay the doorbell syscall for a rank
+            # that is gone (ADVICE r3 #5).  Hooks come out of the
+            # progress engine BEFORE the mmap closes — a stale hook
+            # would dereference the freed mapping on a later park.
+            self.state.progress.unregister_park_hooks(
+                self._park_set, self._park_clear)
+            try:
+                self._parked[self.rank] = 0
+                self._parked = None
+                self._parked_mm.close()
+            except (OSError, ValueError):
+                pass
         if self._db_rfd >= 0:
             self.state.progress.unregister_idle_fd(self._db_rfd)
             try:

@@ -38,6 +38,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from ompi_tpu.coll.framework import CollComponent, CollModule, coll_framework
+from ompi_tpu.pml.monitoring import count_offload
 from ompi_tpu.coll.tuned import TunedModule
 from ompi_tpu.mca.params import registry
 from ompi_tpu.op.op import MAX, MIN, PROD, SUM, Op
@@ -125,18 +126,32 @@ class Rendezvous:
         self.results: Dict[int, List[Any]] = {}
         self.errors: Dict[int, BaseException] = {}
         self.readers: Dict[int, int] = {}
+        self._progs: Dict[int, Any] = {}  # rank -> Progress (wake targets)
 
     def run(self, rank: int, value: Any, fn: Callable[[List[Any]], List[Any]],
-            abort_check: Optional[Callable[[], None]] = None) -> Any:
+            abort_check: Optional[Callable[[], None]] = None,
+            progress: Any = None) -> Any:
         """Deposit `value`; last arriver runs fn(slots) -> outputs.
         Waits poll at ``coll_device_rendezvous_poll`` (abort flags are
         checked each tick, bounding abort latency) and fail after
         ``coll_device_rendezvous_timeout`` of no progress — a stuck
-        peer must become a diagnosable error, not a silent hang."""
+        peer must become a diagnosable error, not a silent hang.
+
+        A waiter keeps its rank's ``progress`` engine turning while
+        blocked (the opal_progress-in-every-blocking-call discipline,
+        ref: opal/runtime/opal_progress.c:186): passive-target RMA —
+        osc lock grants, fetch_and_op application, the sharedfp file
+        pointer — targets THIS rank while it sits in a collective, and
+        a rank parked on a bare condvar would starve those handlers
+        forever.  Waiters park on the progress idle selector, which
+        both frag arrival (inproc send → wakeup) and rendezvous
+        completion (_wake_peers) ring, so parking costs no latency."""
         import time
 
         poll = _rv_poll_var.value
         stall = _rv_timeout_var.value
+        if progress is not None:
+            self._progs[rank] = progress
 
         def tick(t_start: float, what: str) -> None:
             if abort_check:
@@ -147,12 +162,39 @@ class Rendezvous:
                     f"({what}; peers dead or diverged? tune "
                     f"coll_device_rendezvous_timeout)")
 
+        def wait_for(cond, what: str) -> None:
+            # cv held on entry and exit
+            t0 = time.monotonic()
+            if progress is None:
+                while not cond():
+                    if not self.cv.wait(timeout=poll):
+                        tick(t0, what)
+                return
+            park = min(poll, 0.05)
+            while not cond():
+                # progress outside the cv: handlers may send replies
+                # (osc acks) and must never run under the meeting lock
+                self.cv.release()
+                try:
+                    events = progress.progress()
+                    if events == 0 and progress.has_idle_fds:
+                        # park in the idle selector: woken by frag
+                        # arrival AND by rendezvous completion
+                        progress.idle_wait(park)
+                finally:
+                    self.cv.acquire()
+                if events == 0 and not progress.has_idle_fds:
+                    # no kernel-wakeable fds: park on the condvar (a
+                    # GIL-holding spin here is measured strictly worse
+                    # on shared cores) with a short timeout so the pml
+                    # still gets swept every few ms
+                    self.cv.wait(timeout=0.002)
+                tick(t0, what)
+
         with self.cv:
             # wait until my slot from the previous generation is consumed
-            t0 = time.monotonic()
-            while self.slots[rank] is not self._SENTINEL:
-                if not self.cv.wait(timeout=poll):
-                    tick(t0, "previous generation unconsumed")
+            wait_for(lambda: self.slots[rank] is self._SENTINEL,
+                     "previous generation unconsumed")
             gen = self.gen
             self.slots[rank] = value
             self.count += 1
@@ -167,18 +209,13 @@ class Rendezvous:
                 self.slots = [self._SENTINEL] * self.size
                 self.gen += 1
                 self.cv.notify_all()
+                # wake members parked on their progress idle selector
+                for r, prog in self._progs.items():
+                    if r != rank:
+                        prog.wakeup()
             else:
-                # No spin before the condvar wait: under the GIL a
-                # lock-free spin HOLDS the interpreter for up to the
-                # switch interval (5 ms) and sched_yield burns CFS
-                # quanta on shared cores — measured strictly worse
-                # than parking on the condvar, which hands the GIL
-                # straight to the rank that can make progress.
-                t0 = time.monotonic()
-                while gen not in self.results:
-                    if not self.cv.wait(timeout=poll):
-                        tick(t0, f"waiting for {self.size - self.count} "
-                                 f"peers")
+                wait_for(lambda: gen in self.results,
+                         f"waiting for {self.size - self.count} peers")
             err = self.errors.get(gen)
             out = self.results[gen][rank]
             self.readers[gen] -= 1
@@ -189,6 +226,17 @@ class Rendezvous:
                 raise RuntimeError(
                     f"device collective failed on a peer: {err}") from err
             return out
+
+
+def meet(comm, value, fn, abort_check) -> Any:
+    """The one rendezvous entry point for offloaded collectives:
+    reports the bypassed traffic to pml/monitoring (the offload fast
+    paths must not blind the observability story), then runs the
+    meeting with this rank's progress engine kept turning."""
+    rv = _get_rendezvous(comm)
+    count_offload(comm, int(getattr(value, "nbytes", 0) or 0))
+    return rv.run(comm.rank, value, fn, abort_check,
+                  progress=comm.state.progress)
 
 
 def _get_rendezvous(comm) -> Rendezvous:
@@ -360,8 +408,7 @@ class TpuCollModule(CollModule):
         return check
 
     def _run(self, comm, value, fn):
-        rv = _get_rendezvous(comm)
-        out = rv.run(comm.rank, value, fn, self._abort_check(comm))
+        out = meet(comm, value, fn, self._abort_check(comm))
         self.pvar_offload.add(1)
         return out
 
@@ -592,8 +639,7 @@ class HbmCollModule(CollModule):
                 return _o(_j(*shards), _n)
 
             plans[pkey] = fn
-        rv = _get_rendezvous(comm)
-        return rv.run(comm.rank, x, fn, self._abort_check(comm))
+        return meet(comm, x, fn, self._abort_check(comm))
 
     def allreduce_arr(self, comm, x, op: Op):
         if not self._eligible(comm, x) or (
@@ -630,8 +676,7 @@ class HbmCollModule(CollModule):
         def fn(shards):
             return [shards[root]] * comm.size
 
-        rv = _get_rendezvous(comm)
-        return rv.run(comm.rank, x, fn, self._abort_check(comm))
+        return meet(comm, x, fn, self._abort_check(comm))
 
     def reduce_arr(self, comm, x, op: Op, root: int):
         if not _reduce_as_allreduce_var.value:
@@ -658,8 +703,7 @@ class HbmCollModule(CollModule):
                     outs[i] = z
             return outs
 
-        rv = _get_rendezvous(comm)
-        return rv.run(comm.rank, x, fn, self._abort_check(comm))
+        return meet(comm, x, fn, self._abort_check(comm))
 
 
 class HostArrModule(CollModule):

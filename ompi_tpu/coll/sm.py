@@ -29,7 +29,7 @@ from typing import List, Optional
 import numpy as np
 
 from ompi_tpu.coll.buffers import IN_PLACE, typed
-from ompi_tpu.coll.device import TpuCollModule, _get_rendezvous
+from ompi_tpu.coll.device import TpuCollModule, meet
 from ompi_tpu.coll.framework import CollComponent, coll_framework
 from ompi_tpu.coll.tuned import TunedModule
 from ompi_tpu.mca.params import registry
@@ -60,8 +60,7 @@ class SmCollModule(TunedModule):
         return cached
 
     def _meet(self, comm, value, fn):
-        rv = _get_rendezvous(comm)
-        return rv.run(comm.rank, value, fn, self._abort_check(comm))
+        return meet(comm, value, fn, self._abort_check(comm))
 
     # -- collectives -----------------------------------------------------
     def barrier(self, comm) -> None:

@@ -71,10 +71,17 @@ def _oversubscribed(comm) -> bool:
         return cached
     verdict = False
     if comm.size > 1:
+        rte = comm.state.rte
+        per_node: dict = {}
+        cores_of: dict = {}
+        # modex lookups may NOT be swallowed into a default verdict:
+        # one rank silently defaulting while its peers compute true
+        # is exactly the algorithm divergence (reduce_bcast vs ring)
+        # this function exists to prevent — deadlock.  A missing key
+        # (pre-modex bootstrap comms) is deterministic across members
+        # and may default; a transport error must propagate loudly
+        # (ADVICE r3 #4).
         try:
-            rte = comm.state.rte
-            per_node: dict = {}
-            cores_of: dict = {}
             for g in comm.group:
                 node = rte.modex_get(g, "node_id")
                 per_node[node] = per_node.get(node, 0) + 1
@@ -82,7 +89,10 @@ def _oversubscribed(comm) -> bool:
                     cores_of[node] = int(rte.modex_get(g, "cores"))
             verdict = any(cnt > cores_of[n]
                           for n, cnt in per_node.items())
-        except Exception:
+        except (KeyError, LookupError, AttributeError, TypeError,
+                ValueError):
+            # deterministic data-shape outcomes (key absent on every
+            # member, non-modex rte): same default everywhere
             verdict = False
     comm._oversub_verdict = verdict
     return verdict

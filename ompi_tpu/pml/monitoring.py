@@ -176,6 +176,24 @@ class MonitoringPml:
                              f"{self.sent.bytes[peer]}\n")
 
 
+def count_offload(comm, nbytes: int) -> None:
+    """Count a collective that bypassed the pml entirely (sm/device
+    rendezvous: the collective happens in shared memory or on-device,
+    ref coll/sm's shared segment which the reference's pml/monitoring
+    also cannot see).  We do better than the reference here: the coll
+    modules report the traffic the pml WOULD have carried — one
+    internal message of ``nbytes`` to every other member — so the
+    observability story survives the offload fast paths."""
+    pml = getattr(comm.state, "pml", None)
+    if not isinstance(pml, MonitoringPml):
+        return
+    me = comm.rank
+    with pml._lock:
+        for r in range(comm.size):
+            if r != me:
+                pml.sent.count(comm.group[r], nbytes, True)
+
+
 def maybe_wrap(pml, state):
     """Called from mpi_init after pml selection (the reference winning
     component interposes the same way at init)."""

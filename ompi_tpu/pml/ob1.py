@@ -76,7 +76,8 @@ class SendRequest(Request):
 
 class RecvRequest(Request):
     __slots__ = ("conv", "req_id", "src", "tag", "cid", "matched",
-                 "expected", "received", "incoming", "_canceller")
+                 "expected", "received", "incoming", "_canceller",
+                 "_held")
 
     def __init__(self, progress, conv, req_id, src, tag, cid):
         super().__init__(progress)
@@ -88,8 +89,9 @@ class RecvRequest(Request):
         self.cid = cid
         self.matched = False
         self.expected = 0   # bytes that will actually arrive
-        self.received = 0
+        self.received = 0   # contiguous coverage watermark
         self.incoming = 0   # sender's total (for truncation check)
+        self._held = None   # out-of-order coverage intervals {pos: end}
 
 
 class UnexpectedMsg:
@@ -458,6 +460,12 @@ class PmlOb1:
     def _dispatch_arrival(self, msg: UnexpectedMsg) -> None:
         key = (msg.cid, msg.src)
         if not self._matchable(msg.cid, msg.src, msg.seq):
+            if msg.seq < self._next_seq.get(key, 0):
+                # already-consumed sequence: a reconnect-resent
+                # duplicate envelope.  Drop it — parking it in
+                # _cant_match would leak it forever (its seq can
+                # never become next; ADVICE r3 #3)
+                return
             self._cant_match.setdefault(key, {})[msg.seq] = msg
             return
         self._advance_seq(msg.cid, msg.src)
@@ -506,13 +514,35 @@ class PmlOb1:
             take = min(len(payload), capacity - pos)
             req.conv.set_position(pos)
             req.conv.unpack(payload[:take])
-        # contiguous coverage only: duplicated segments (transport
-        # reconnect resends) never double-count, and a LOST segment
-        # (the unrecoverable kernel-buffer window of a dead
-        # connection) leaves received short forever — the recv fails
-        # stop via timeout instead of completing with a hole
+        # coverage as watermark + held intervals: duplicated segments
+        # (transport reconnect resends) never double-count, and a
+        # segment arriving AHEAD of the watermark (a reconnected
+        # conn's resend processed before the old conn's in-flight
+        # data — the selector may interleave the two) is remembered
+        # and merged once the gap fills, instead of silently dropped
+        # (which stalled the recv forever; ADVICE r3 #1).  A LOST
+        # segment (the unrecoverable kernel-buffer window of a dead
+        # connection) still leaves a hole forever — the recv fails
+        # stop via timeout instead of completing with one
         if pos <= req.received:
             req.received = max(req.received, pos + len(payload))
+            held = req._held
+            if held:
+                # merge any held intervals the new watermark reaches
+                while True:
+                    nxt = [p for p in held if p <= req.received]
+                    if not nxt:
+                        break
+                    for p in nxt:
+                        end = held.pop(p)
+                        if end > req.received:
+                            req.received = end
+        else:
+            if req._held is None:
+                req._held = {}
+            end = pos + len(payload)
+            if end > req._held.get(pos, 0):
+                req._held[pos] = end
         if req.received >= req.incoming:
             req.status.count = min(req.incoming, capacity)
             self._finish_recv(req)

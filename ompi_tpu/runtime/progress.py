@@ -77,6 +77,15 @@ class Progress:
         self._park_set.append(set_cb)
         self._park_clear.append(clear_cb)
 
+    def unregister_park_hooks(self, set_cb, clear_cb) -> None:
+        """Transports must remove their hooks at finalize: a stale
+        hook dereferences freed transport state on any later idle
+        park."""
+        if set_cb in self._park_set:
+            self._park_set.remove(set_cb)
+        if clear_cb in self._park_clear:
+            self._park_clear.remove(clear_cb)
+
     def register_idle_fd(self, fd: int, drain: Callable[[], None] | None = None) -> None:
         import selectors
         if self._idle_sel is None:

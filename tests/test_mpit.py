@@ -213,3 +213,44 @@ def test_info_tool_output(capsys):
     assert info.main(["--param", "all", "all", "--parsable"]) == 0
     out = capsys.readouterr().out
     assert "mca:" in out and ":param:" in out and ":source:" in out
+
+
+def test_pvar_counts_fast_and_slow_send_paths():
+    """The ob1 bytes_sent pvar must count BOTH convertor paths: the
+    contiguous fast path (ContigConvertor) and the stack-machine slow
+    path (strided buffer) — the r3 fast path must not bypass
+    accounting (VERDICT r3 weak #3)."""
+    from ompi_tpu.datatype.convertor import ContigConvertor
+    from ompi_tpu.datatype.convertor import make_convertor
+    from ompi_tpu.datatype import engine as dtmod
+
+    # path sanity: contiguous dtype -> fast path, vector dtype -> slow
+    vec = dtmod.vector(8, 1, 2, dtmod.DOUBLE).commit()
+    flat = np.arange(16, dtype=np.float64)
+    assert isinstance(make_convertor(dtmod.DOUBLE, 16, flat),
+                      ContigConvertor)
+    assert not isinstance(make_convertor(vec, 1, flat),
+                          ContigConvertor)
+
+    def fn(comm):
+        pv = comm.state.pml.pvar_sent
+        got = {}
+        if comm.rank == 0:
+            base = pv.read()
+            comm.Send(flat, dest=1, tag=7)              # fast path
+            got["fast"] = pv.read() - base
+            base = pv.read()
+            comm.Send((flat, 1, vec), dest=1, tag=9)    # slow path
+            got["slow"] = pv.read() - base
+        else:
+            r = np.empty(16, dtype=np.float64)
+            comm.Recv(r, source=0, tag=7)
+            r8 = np.empty(8, dtype=np.float64)
+            comm.Recv(r8, source=0, tag=9)
+            got["strided_recv_ok"] = bool((r8 == flat[::2]).all())
+        return got
+
+    res = run_ranks(2, fn)
+    assert res[0]["fast"] == 16 * 8
+    assert res[0]["slow"] == 8 * 8  # vector packs 8 doubles
+    assert res[1]["strided_recv_ok"]
