@@ -170,7 +170,9 @@ def main() -> None:
             result["vs_baseline"] = round(sw_ar[big] / dev_ar[big], 3)
 
     per_size, beats = northstar(dev_ar, sw_ar)
-    result["northstar_beats_sw_ge_4KiB"] = beats
+    # None (not false) when no size was actually compared: the field
+    # must encode "no data", never read as a losing perf verdict
+    result["northstar_beats_sw_ge_4KiB"] = beats if per_size else None
     result["read_const_us"] = dev.get("read_const_us")
     trunc = []
     for side, d in (("device", dev), ("software", sw)):
