@@ -84,8 +84,9 @@ def bench_allreduce(comm, max_bytes: int) -> dict:
         n = max(1, nbytes // 4)
         x = np.full(n, comm.rank + 1.0, dtype=np.float32)
         r = np.empty_like(x)
+        comm.Allreduce(x, r, mpi_op.SUM)  # warmup (segment/page-fault setup)
         t0 = time.perf_counter()
-        comm.Allreduce(x, r, mpi_op.SUM)  # warmup + probe
+        comm.Allreduce(x, r, mpi_op.SUM)  # probe
         probe = time.perf_counter() - t0
         dt_s = _timeit(comm, lambda: comm.Allreduce(x, r, mpi_op.SUM),
                        probe)
@@ -104,6 +105,7 @@ def bench_bcast(comm, max_bytes: int) -> dict:
             return out
         n = max(1, nbytes // 4)
         x = np.full(n, 7.0 if comm.rank == 0 else 0.0, dtype=np.float32)
+        comm.Bcast(x, root=0)  # warmup
         t0 = time.perf_counter()
         comm.Bcast(x, root=0)
         probe = time.perf_counter() - t0
@@ -125,6 +127,7 @@ def bench_alltoall(comm, max_bytes: int) -> dict:
         n = max(1, nbytes // 4) * comm.size
         x = np.full(n, comm.rank + 1.0, dtype=np.float32)
         r = np.empty_like(x)
+        comm.Alltoall(x, r)  # warmup
         t0 = time.perf_counter()
         comm.Alltoall(x, r)
         probe = time.perf_counter() - t0
@@ -157,6 +160,7 @@ def bench_rsb_vector(comm, max_bytes: int) -> dict:
             comm.Reduce_scatter_block((x, comm.size, vec), (r, 1, vec),
                                       mpi_op.MAX)
 
+        op_()  # warmup
         t0 = time.perf_counter()
         op_()
         probe = time.perf_counter() - t0

@@ -1,0 +1,743 @@
+"""coll/seg: shared-segment collectives for same-node PROCESS ranks.
+
+Re-design of ompi/mca/coll/sm for the process-rank side (ref:
+coll_sm_module.c:102,167 and coll_sm_bcast.c — ranks on one node
+meet in a shared segment of per-rank "fan-in/fan-out" slots guarded
+by operation flags, instead of exchanging point-to-point messages).
+The thread-rank analog is ompi_tpu/coll/sm (a Python-object
+rendezvous); this component is its mmap twin for ranks that are
+separate PROCESSES on one host, where the r3 software baseline paid
+6 sequential pml hops (3-4 ms for a 4-byte 8-rank allreduce on an
+oversubscribed host — each hop is a full scheduler round trip).
+Here every collective is one segment visit per rank: write your
+slot, flag it, wait for the flags you need, read.  On a 1-core host
+that is ~P scheduler wakeups total instead of ~2 log P sequential
+round trips through the matching engine.
+
+Segment protocol (per communicator, double-banked):
+
+  * Each op gets a generation number g (a local counter — MPI orders
+    collectives identically on every member).  Slot data and seq
+    flags are double-banked by g parity: a fast rank in op g+1 works
+    the other bank while a slow rank still reads op g.
+  * write bank[g%2], THEN seq[me][g%2] = g (x86 TSO + numpy 8-byte
+    aligned stores keep the order and atomicity; same discipline as
+    the shm btl ring indices).
+  * done[me] = g published when the rank has fully LEFT op g
+    (including reads) — before touching a bank for op g, a rank
+    waits all done >= g-2, which proves nobody still reads that
+    bank (it was last used in op g-2).  A rank can never be 2 ops
+    ahead: completing op g+1 needs flags my op-g state has not
+    produced.
+  * Blocked waits keep the pml progress engine turning (the
+    opal_progress discipline — passive-target RMA may target a rank
+    parked in a collective) and sleep briefly between polls: on an
+    oversubscribed host a polling spin burns the very quantum the
+    flag-writer needs.
+
+Eligibility (cached per comm at first use, identical on every
+member): every member's modex (node_id, session_dir) equals ours —
+same host AND same mpirun session (a dpm connect/accept peer from a
+different job has a different session dir and no shared segment).
+Payloads larger than the slot fall back per-call to the tuned p2p
+stack (both sides compute the same verdict from count*datatype).
+
+Segment files live in the session directory and are cleaned with it
+at job teardown (launcher-owned lifetime, like the shm btl rings).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import mmap
+import os
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ompi_tpu.coll.buffers import IN_PLACE, typed
+from ompi_tpu.coll.framework import CollComponent, coll_framework
+from ompi_tpu.coll.tuned import TunedModule
+from ompi_tpu.mca.params import registry
+from ompi_tpu.op.op import Op
+
+_prio_var = registry.register(
+    "coll", "seg", "priority", 55, int,
+    help="Selection priority of the shared-segment (same-node "
+         "process-rank) collective component (below coll/sm, above "
+         "tuned)")
+_slot_var = registry.register(
+    "coll", "seg", "slot_bytes", 256 * 1024, int,
+    help="Per-rank segment slot size; larger payloads fall back to "
+         "the p2p stack")
+_poll_var = registry.register(
+    "coll", "seg", "poll_us", 50, int,
+    help="Sleep between segment flag polls in microseconds (bounds "
+         "the scheduler pressure of blocked ranks on oversubscribed "
+         "hosts)")
+_timeout_var = registry.register(
+    "coll", "seg", "timeout", 300.0, float,
+    help="Seconds a segment collective may stall before raising "
+         "(dead/diverged peer diagnosis)")
+_stride_var = registry.register(
+    "coll", "seg", "progress_stride", 16, int,
+    help="Run a full pml progress sweep every Nth flag poll: the "
+         "sweep costs 10-50x a numpy flag read, and a blocked "
+         "collective only needs it for background service (passive "
+         "RMA at this rank), not for its own completion")
+
+_MAGIC = 0x5E6C011
+
+
+class _timespec(ctypes.Structure):
+    _fields_ = [("tv_sec", ctypes.c_long), ("tv_nsec", ctypes.c_long)]
+
+
+class _Futex:
+    """futex(2) on 32-bit words inside the shared segment: waiters
+    park in the kernel and the flag WRITER wakes them directly — the
+    wake-to-run path is a scheduler enqueue (~10 us) instead of a
+    sleep-poll granularity (~60+ us), and idle waiters cost zero CPU.
+    The reference gets this from pthread condition variables in its
+    shared segment; raw futexes are the no-pthread-in-Python analog.
+    Non-Linux or blocked syscalls degrade to the sleep-poll path."""
+
+    SYS_FUTEX = 202  # x86_64
+    WAIT = 0
+    WAKE = 1
+
+    def __init__(self) -> None:
+        try:
+            self._libc = ctypes.CDLL(None, use_errno=True)
+            self._syscall = self._libc.syscall
+            # probe: wake on a private word must not raise
+            probe = (ctypes.c_int32 * 1)()
+            r = self._syscall(self.SYS_FUTEX, ctypes.byref(probe),
+                              self.WAKE, 1, None, None, 0)
+            self.ok = r >= 0
+        except Exception:
+            self.ok = False
+
+    def wait(self, addr: int, expected: int, timeout_s: float) -> None:
+        """Park while *addr == expected (racy-safe: a changed value
+        returns immediately with EAGAIN)."""
+        ts = _timespec(int(timeout_s),
+                       int((timeout_s % 1.0) * 1e9))
+        self._syscall(self.SYS_FUTEX, ctypes.c_void_p(addr),
+                      self.WAIT, ctypes.c_int32(expected),
+                      ctypes.byref(ts), None, 0)
+
+    def wake(self, addr: int) -> None:
+        self._syscall(self.SYS_FUTEX, ctypes.c_void_p(addr),
+                      self.WAKE, (1 << 30), None, None, 0)
+
+
+_futex = _Futex()
+
+
+class _Seg:
+    """The mapped per-communicator segment: flags + banked slots."""
+
+    def __init__(self, comm, slot: int) -> None:
+        size = comm.size
+        rte = comm.state.rte
+        # layout: [magic u64][done u64*P][seq u64*P*2][data P*2*slot]
+        self._off_done = 8
+        self._off_seq = self._off_done + 8 * size
+        self._off_data = self._off_seq + 8 * size * 2
+        total = self._off_data + size * 2 * slot
+        gid = f"{comm.cid}_{abs(hash(tuple(comm.group))) & 0xFFFFFFFF:08x}"
+        path = os.path.join(rte.session_dir, f"coll_seg_{gid}.buf")
+        creator = comm.rank == 0
+        if creator and not os.path.exists(path):
+            tmp = f"{path}.tmp.{os.getpid()}"
+            fd = os.open(tmp, os.O_CREAT | os.O_RDWR, 0o600)
+            os.ftruncate(fd, total)
+            m = mmap.mmap(fd, total)
+            np.frombuffer(m, np.uint64, count=1)[0] = _MAGIC
+            m.flush()
+            m.close()
+            os.close(fd)
+            os.rename(tmp, path)  # attachers never see a short file
+        else:
+            deadline = time.monotonic() + _timeout_var.value
+            while True:
+                try:
+                    if os.path.getsize(path) >= total:
+                        break
+                except OSError:
+                    pass
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"coll/seg segment {path} never appeared "
+                        "(creator dead?)")
+                time.sleep(200e-6)
+        fd = os.open(path, os.O_RDWR)
+        self.mm = mmap.mmap(fd, total)
+        os.close(fd)
+        self.slot = slot
+        magic = np.frombuffer(self.mm, np.uint64, count=1)
+        assert int(magic[0]) == _MAGIC, "corrupt coll/seg segment"
+        self.done = np.frombuffer(self.mm, np.int64, count=size,
+                                  offset=self._off_done)
+        self.seq = np.frombuffer(self.mm, np.int64, count=size * 2,
+                                 offset=self._off_seq).reshape(size, 2)
+        self.data = np.frombuffer(self.mm, np.uint8,
+                                  offset=self._off_data
+                                  ).reshape(size, 2, slot)
+        self.gen = 0
+        # int32 low-word views of the same counters (little-endian):
+        # the futex word the kernel waits on.  Generations are capped
+        # well under 2^31 by any real run.
+        self.seq32 = np.frombuffer(
+            self.mm, np.int32, count=size * 4,
+            offset=self._off_seq).reshape(size, 2, 2)[:, :, 0]
+        self.done32 = np.frombuffer(
+            self.mm, np.int32, count=size * 2,
+            offset=self._off_done).reshape(size, 2)[:, 0]
+        self._base = ctypes.addressof(ctypes.c_char.from_buffer(self.mm))
+        lib = _seg_lib()
+        self.fn = lib.tpumpi_seg_coll if lib is not None else None
+
+    def seq_addr(self, p: int, b: int) -> int:
+        return self._base + self._off_seq + (p * 2 + b) * 8
+
+    def done_addr(self, p: int) -> int:
+        return self._base + self._off_done + p * 8
+
+    def flag_seq(self, rank: int, b: int, g: int) -> None:
+        self.seq[rank, b] = g
+        if _futex.ok:
+            _futex.wake(self.seq_addr(rank, b))
+
+    def flag_done(self, rank: int, g: int) -> None:
+        self.done[rank] = g
+        if _futex.ok:
+            _futex.wake(self.done_addr(rank))
+
+
+def _get_seg(comm) -> Optional[_Seg]:
+    seg = comm.__dict__.get("_coll_seg")
+    if seg is None:
+        seg = _Seg(comm, _slot_var.value)
+        comm.__dict__["_coll_seg"] = seg
+    return seg
+
+
+# ---------------------------------------------------------------------------
+# native fast path: one reentrant C call per collective (collseg.cpp).
+# The Python protocol below costs ~133 us of cache-cold interpreter
+# work per rank per op under process rotation; the C path touches
+# only the protocol words.  Python and C speak the SAME segment
+# protocol, so ranks may mix paths (e.g. one rank's native build
+# failed) without divergence.
+# ---------------------------------------------------------------------------
+
+_K_BARRIER, _K_BCAST, _K_ALLREDUCE, _K_REDUCE = 0, 1, 2, 3
+_K_ALLGATHER, _K_ALLTOALL, _K_REDUCE_SCATTER = 4, 5, 6
+
+_NAT_DT = {np.dtype(t): i for i, t in enumerate(
+    (np.float32, np.float64, np.int8, np.uint8, np.int16, np.uint16,
+     np.int32, np.uint32, np.int64, np.uint64))}
+_NAT_OP = {"MPI_SUM": 0, "MPI_PROD": 1, "MPI_MAX": 2, "MPI_MIN": 3,
+           "MPI_BAND": 4, "MPI_BOR": 5, "MPI_BXOR": 6,
+           "MPI_LAND": 7, "MPI_LOR": 8, "MPI_LXOR": 9}
+_REDUCTIONS = (_K_ALLREDUCE, _K_REDUCE, _K_REDUCE_SCATTER)
+
+
+def _seg_lib():
+    from ompi_tpu import native
+    return native.load()
+
+
+_nat_cache: Dict[tuple, Optional[tuple]] = {}
+
+
+def _nat_codes(kind: int, op: Optional[Op], dtype) -> Optional[tuple]:
+    """(dt_code, op_code) when the C path supports the combination,
+    else None (Python protocol fallback).  Deterministic in (kind,
+    op, dtype) so every rank picks the same eligibility — though the
+    protocol tolerates mixed paths anyway.  Cached: this sits on the
+    per-op hot path."""
+    key = (kind in _REDUCTIONS, id(op), str(dtype))
+    hit = _nat_cache.get(key, _nat_cache)
+    if hit is not _nat_cache:
+        return hit
+    if not key[0]:
+        out = (0, 99)
+    else:
+        dtc = _NAT_DT.get(np.dtype(dtype))
+        opc = _NAT_OP.get(op.name) if op is not None else None
+        if dtc is None or opc is None or (dtc <= 1 and opc > 3):
+            out = None  # float ops: SUM/PROD/MAX/MIN only
+        else:
+            out = (dtc, opc)
+    _nat_cache[key] = out
+    return out
+
+
+class SegCollModule(TunedModule):
+    """Shared-segment collectives; p2p fallback via the tuned
+    superclass for ineligible comms/payloads."""
+
+    name = "seg"
+
+    def _seg_ok(self, comm) -> bool:
+        cached = comm.__dict__.get("_seg_eligible")
+        if cached is not None:
+            return cached
+        ok = False
+        rte = comm.state.rte
+        session = getattr(rte, "session_dir", None)
+        world = getattr(rte, "world", None)
+        if comm.size > 1 and session and not getattr(
+                comm, "is_inter", False):
+            # thread-rank-only comms are served better by coll/sm
+            # (object rendezvous, no copies); seg earns its keep when
+            # at least one member is a separate process
+            all_threads = bool(
+                world is not None
+                and all(world.is_local(g) for g in comm.group))
+            if not all_threads:
+                try:
+                    me = (rte.modex_get(comm.state.rank, "node_id"),
+                          rte.modex_get(comm.state.rank, "seg_session"))
+                    ok = all(
+                        (rte.modex_get(g, "node_id"),
+                         rte.modex_get(g, "seg_session")) == me
+                        for g in comm.group)
+                except Exception:
+                    ok = False  # missing modex: deterministic on all
+        comm.__dict__["_seg_eligible"] = ok
+        return ok
+
+    # -- segment machinery -----------------------------------------------
+    def _wait(self, comm, cond, what: str) -> None:
+        """Poll ``cond`` with a cheap flag read per iteration, a brief
+        sleep between polls (oversubscribed hosts: the flag-writer
+        needs the core), and a full progress sweep every Nth poll so
+        background pml traffic (passive-target RMA at this rank) is
+        still serviced while blocked."""
+        if cond():
+            return
+        progress = comm.state.progress
+        sleep_s = _poll_var.value * 1e-6
+        stride = max(1, _stride_var.value)
+        deadline = time.monotonic() + _timeout_var.value
+        spins = 0
+        while True:
+            spins += 1
+            if spins % stride == 0:
+                progress.progress()
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"coll/seg stalled >{_timeout_var.value}s "
+                        f"({what}; peer dead or diverged?)")
+            if cond():
+                return
+            if spins > 2:
+                time.sleep(sleep_s)
+
+    def _wait_ge(self, comm, vals32: np.ndarray, addr_fn, g: int,
+                 what: str) -> None:
+        """Wait until every counter in ``vals32`` (int32 segment
+        views) reaches ``g``: futex-park on the first laggard's word
+        so the writer's flag store wakes us directly; on timeout
+        sweep the pml (passive-target RMA may target this rank) and
+        check the stall clock.  Falls back to sleep-polling when the
+        futex syscall is unavailable."""
+        if not _futex.ok:
+            return self._wait(
+                comm, lambda: bool((vals32 >= g).all()), what)
+        if (vals32 >= g).all():
+            return
+        progress = comm.state.progress
+        park = 0.002
+        deadline = time.monotonic() + _timeout_var.value
+        me = comm.rank
+        k = len(vals32)
+        while True:
+            pend = np.nonzero(vals32 < g)[0]
+            if pend.size == 0:
+                return
+            # stagger: each waiter parks on a DIFFERENT laggard's
+            # word (first pending index after my own rank, cyclic) —
+            # if everyone watched the same word, every flag write
+            # would wake the whole herd, O(P^2) scheduler wakeups
+            # per op instead of O(P)
+            after = pend[pend > me]
+            i = int(after[0] if after.size else pend[0])
+            cur = int(vals32[i])
+            if cur >= g:
+                continue
+            t0 = time.monotonic()
+            _futex.wait(addr_fn(i), cur, park)
+            if vals32[i] < g and time.monotonic() - t0 >= park / 2:
+                # timed out, not event-woken: background service
+                progress.progress()
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"coll/seg stalled >{_timeout_var.value}s "
+                        f"({what}; peer dead or diverged?)")
+
+    def _enter(self, comm) -> tuple:
+        """Begin op: bump gen, prove nobody still reads this bank."""
+        seg = _get_seg(comm)
+        seg.gen += 1
+        g = seg.gen
+        if g >= 2:
+            self._wait_ge(comm, seg.done32, seg.done_addr, g - 2,
+                          f"bank reuse guard gen {g}")
+        return seg, g, g & 1
+
+    def _native_run(self, comm, kind: int, root: int,
+                    inp: Optional[np.ndarray],
+                    out: Optional[np.ndarray], nbytes: int,
+                    codes: tuple) -> bool:
+        """Run one collective through the C segment path; True when
+        handled.  Reentry loop: a return of 1 means the C side parked
+        once without completion — sweep the pml (passive-target RMA
+        may target this blocked rank) and re-enter.  The happy path
+        (op completed within one park) costs one ctypes call and no
+        clock reads — every microsecond here is multiplied by P
+        scheduler visits per op on an oversubscribed host."""
+        seg = comm.__dict__.get("_coll_seg")
+        if seg is None:
+            if _seg_lib() is None:
+                return False
+            seg = _get_seg(comm)
+        fn = seg.fn
+        if fn is None:
+            return False
+        seg.gen += 1
+        g = seg.gen
+        dtc, opc = codes
+        call = (seg._base, comm.size, seg.slot, comm.rank, g, kind,
+                root, inp.ctypes.data if inp is not None else None,
+                out.ctypes.data if out is not None else None,
+                nbytes, dtc, opc, 2000)
+        r = fn(*call)
+        if r == 0:
+            return True
+        if r < 0:
+            # unsupported probe fires before any segment mutation;
+            # undo the gen and let Python take over
+            seg.gen -= 1
+            return False
+        progress = comm.state.progress
+        deadline = time.monotonic() + _timeout_var.value
+        while True:
+            progress.progress()
+            r = fn(*call)
+            if r == 0:
+                return True
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"coll/seg stalled >{_timeout_var.value}s "
+                    f"(native gen {g}; peer dead or diverged?)")
+
+    def _post(self, seg, comm, g, b, arr: Optional[np.ndarray]) -> None:
+        """Write my slot (optional) and flag it."""
+        if arr is not None:
+            view = np.ascontiguousarray(arr).view(np.uint8).reshape(-1)
+            seg.data[comm.rank, b, :view.size] = view
+        seg.flag_seq(comm.rank, b, g)
+
+    def _slot_of(self, seg, peer: int, b: int, nbytes: int,
+                 dtype) -> np.ndarray:
+        return seg.data[peer, b, :nbytes].view(dtype)
+
+    def _fold(self, arrs: List[np.ndarray], op: Op) -> np.ndarray:
+        # one ufunc reduction over the stacked slots when the op has
+        # one (SUM/MAX/... are numpy ufuncs): P-1 Python-level reduce
+        # calls collapse to a single C loop.  Ops without a ufunc
+        # (pair types, user ops) keep the rank-order left fold; ufunc
+        # .reduce is the same left-to-right order, so results stay
+        # bit-identical across paths.
+        red = getattr(op.np_fn, "reduce", None)
+        if red is not None and arrs[0].dtype.fields is None:
+            return red(np.stack(arrs), axis=0)
+        acc = np.array(arrs[0], copy=True)
+        for s in arrs[1:]:
+            acc = op.reduce(acc, s)
+        return acc
+
+    # -- collectives -----------------------------------------------------
+    def barrier(self, comm) -> None:
+        if comm.size == 1:
+            return
+        if not self._seg_ok(comm):
+            return super().barrier(comm)
+        if self._native_run(comm, _K_BARRIER, 0, None, None, 0,
+                            (0, 99)):
+            return
+        seg, g, b = self._enter(comm)
+        self._post(seg, comm, g, b, None)
+        self._wait_ge(comm, seg.seq32[:, b],
+                             lambda i: seg.seq_addr(i, b), g,
+                             f"barrier gen {g}")
+        seg.flag_done(comm.rank, g)
+
+    def _fits(self, nbytes: int) -> bool:
+        return nbytes <= _slot_var.value
+
+    def bcast(self, comm, buf, count, datatype, root) -> None:
+        if comm.size == 1 or count == 0:
+            return
+        nbytes = count * datatype.size
+        if not self._seg_ok(comm) or not self._fits(nbytes):
+            return super().bcast(comm, buf, count, datatype, root)
+        tb = typed(buf, count, datatype, writable=True)
+        if _seg_lib() is not None:
+            if comm.rank == root:
+                src_c = np.ascontiguousarray(tb.arr)
+                handled = self._native_run(
+                    comm, _K_BCAST, root, src_c, None, nbytes, (0, 99))
+            else:
+                out_c = tb.arr if tb.arr.flags.c_contiguous \
+                    else np.empty_like(tb.arr)
+                handled = self._native_run(
+                    comm, _K_BCAST, root, None, out_c, nbytes, (0, 99))
+                if handled:
+                    if out_c is not tb.arr:
+                        tb.arr[:] = out_c
+                    tb.flush()
+            if handled:
+                return
+        seg, g, b = self._enter(comm)
+        if comm.rank == root:
+            self._post(seg, comm, g, b, tb.arr)
+            # root is NOT done until its payload is flagged; readers'
+            # bank-reuse guard (done >= g-2) protects the data
+            seg.flag_done(comm.rank, g)
+        else:
+            self._wait_ge(comm, seg.seq32[root:root + 1, b],
+                          lambda i: seg.seq_addr(root, b), g,
+                          f"bcast gen {g}")
+            flat = self._slot_of(seg, root, b, nbytes, np.uint8)
+            tb.arr.view(np.uint8).reshape(-1)[:] = flat
+            tb.flush()
+            seg.flag_done(comm.rank, g)
+
+    def allreduce(self, comm, sbuf, rbuf, count, datatype,
+                  op: Op) -> None:
+        nbytes = count * datatype.size
+        rb = typed(rbuf, count, datatype, writable=True)
+        sarr = rb.arr.copy() if sbuf is IN_PLACE \
+            else typed(sbuf, count, datatype).arr
+        if comm.size == 1:
+            rb.arr[:] = sarr
+            rb.flush()
+            return
+        if not self._seg_ok(comm) or not self._fits(nbytes) \
+                or not op.valid_for(sarr.dtype) or count == 0:
+            return super().allreduce(comm, sbuf, rbuf, count,
+                                     datatype, op)
+        codes = _nat_codes(_K_ALLREDUCE, op, sarr.dtype)
+        if codes is not None:
+            sc = np.ascontiguousarray(sarr)
+            out_c = rb.arr if (rb.arr.flags.c_contiguous
+                               and rb.arr.dtype == sc.dtype) \
+                else np.empty(sc.size, sc.dtype)
+            if self._native_run(comm, _K_ALLREDUCE, 0, sc, out_c,
+                                nbytes, codes):
+                if out_c is not rb.arr:
+                    rb.arr.reshape(-1)[:] = out_c.reshape(-1)
+                rb.flush()
+                return
+        seg, g, b = self._enter(comm)
+        self._post(seg, comm, g, b, sarr)
+        self._wait_ge(comm, seg.seq32[:, b],
+                             lambda i: seg.seq_addr(i, b), g,
+                             f"allreduce gen {g}")
+        # every rank folds locally in rank order (deterministic left
+        # fold = basic_linear order, bit-identical across members)
+        arrs = [self._slot_of(seg, p, b, nbytes, sarr.dtype)
+                for p in range(comm.size)]
+        out = self._fold(arrs, op)
+        rb.arr.reshape(-1)[:] = out.reshape(-1)
+        rb.flush()
+        seg.flag_done(comm.rank, g)
+
+    def reduce(self, comm, sbuf, rbuf, count, datatype, op: Op,
+               root) -> None:
+        nbytes = count * datatype.size
+        rb = typed(rbuf, count, datatype, writable=True) \
+            if comm.rank == root else None
+        sarr = rb.arr.copy() if sbuf is IN_PLACE \
+            else typed(sbuf, count, datatype).arr
+        if comm.size == 1:
+            rb.arr[:] = sarr
+            rb.flush()
+            return
+        if not self._seg_ok(comm) or not self._fits(nbytes) \
+                or not op.valid_for(sarr.dtype) or count == 0:
+            return super().reduce(comm, sbuf, rbuf, count, datatype,
+                                  op, root)
+        codes = _nat_codes(_K_REDUCE, op, sarr.dtype)
+        if codes is not None:
+            sc = np.ascontiguousarray(sarr)
+            out_c = None
+            if comm.rank == root:
+                out_c = rb.arr if (rb.arr.flags.c_contiguous
+                                   and rb.arr.dtype == sc.dtype) \
+                    else np.empty(sc.size, sc.dtype)
+            if self._native_run(comm, _K_REDUCE, root, sc, out_c,
+                                nbytes, codes):
+                if rb is not None:
+                    if out_c is not rb.arr:
+                        rb.arr.reshape(-1)[:] = out_c.reshape(-1)
+                    rb.flush()
+                return
+        seg, g, b = self._enter(comm)
+        self._post(seg, comm, g, b, sarr)
+        if comm.rank == root:
+            self._wait_ge(comm, seg.seq32[:, b],
+                                 lambda i: seg.seq_addr(i, b), g,
+                                 f"reduce gen {g}")
+            arrs = [self._slot_of(seg, p, b, nbytes, sarr.dtype)
+                    for p in range(comm.size)]
+            out = self._fold(arrs, op)
+            rb.arr.reshape(-1)[:] = out.reshape(-1)
+            rb.flush()
+        seg.flag_done(comm.rank, g)
+
+    def allgather(self, comm, sbuf, scount, sdtype, rbuf, rcount,
+                  rdtype) -> None:
+        if not self._seg_ok(comm):
+            return super().allgather(comm, sbuf, scount, sdtype,
+                                     rbuf, rcount, rdtype)
+        rb = typed(rbuf, rcount * comm.size, rdtype, writable=True)
+        n = rb.arr.size // comm.size
+        if sbuf is IN_PLACE:
+            sarr = rb.arr.reshape(-1)[comm.rank * n:(comm.rank + 1) * n].copy()
+        else:
+            sarr = typed(sbuf, scount, sdtype).arr
+        nbytes = sarr.size * sarr.itemsize
+        if not self._fits(nbytes):
+            return super().allgather(comm, sbuf, scount, sdtype,
+                                     rbuf, rcount, rdtype)
+        if _seg_lib() is not None:
+            sc = np.ascontiguousarray(sarr)
+            contig = rb.arr.flags.c_contiguous
+            flat = rb.arr.reshape(-1) if contig \
+                else np.empty(rb.arr.size, rb.arr.dtype)
+            if self._native_run(comm, _K_ALLGATHER, 0, sc, flat,
+                                nbytes, (0, 99)):
+                if not contig:
+                    rb.arr.reshape(-1)[:] = flat
+                rb.flush()
+                return
+        seg, g, b = self._enter(comm)
+        self._post(seg, comm, g, b, sarr)
+        self._wait_ge(comm, seg.seq32[:, b],
+                             lambda i: seg.seq_addr(i, b), g,
+                             f"allgather gen {g}")
+        flat = rb.arr.reshape(-1)
+        for p in range(comm.size):
+            flat[p * n:(p + 1) * n] = \
+                self._slot_of(seg, p, b, nbytes, sarr.dtype)
+        rb.flush()
+        seg.flag_done(comm.rank, g)
+
+    def alltoall(self, comm, sbuf, scount, sdtype, rbuf, rcount,
+                 rdtype) -> None:
+        if not self._seg_ok(comm) or sbuf is IN_PLACE:
+            return super().alltoall(comm, sbuf, scount, sdtype,
+                                    rbuf, rcount, rdtype)
+        sarr = typed(sbuf, scount * comm.size, sdtype).arr
+        nbytes = sarr.size * sarr.itemsize
+        if not self._fits(nbytes):
+            return super().alltoall(comm, sbuf, scount, sdtype,
+                                    rbuf, rcount, rdtype)
+        rb = typed(rbuf, rcount * comm.size, rdtype, writable=True)
+        n = rb.arr.size // comm.size
+        if _seg_lib() is not None:
+            sc = np.ascontiguousarray(sarr)
+            contig = rb.arr.flags.c_contiguous
+            flat = rb.arr.reshape(-1) if contig \
+                else np.empty(rb.arr.size, rb.arr.dtype)
+            if self._native_run(comm, _K_ALLTOALL, 0, sc, flat,
+                                nbytes, (0, 99)):
+                if not contig:
+                    rb.arr.reshape(-1)[:] = flat
+                rb.flush()
+                return
+        seg, g, b = self._enter(comm)
+        self._post(seg, comm, g, b, sarr)  # my full P-block row
+        self._wait_ge(comm, seg.seq32[:, b],
+                             lambda i: seg.seq_addr(i, b), g,
+                             f"alltoall gen {g}")
+        flat = rb.arr.reshape(-1)
+        for p in range(comm.size):
+            row = self._slot_of(seg, p, b, nbytes, sarr.dtype)
+            flat[p * n:(p + 1) * n] = \
+                row.reshape(comm.size, n)[comm.rank]
+        rb.flush()
+        seg.flag_done(comm.rank, g)
+
+    def reduce_scatter_block(self, comm, sbuf, rbuf, rcount,
+                             datatype, op: Op) -> None:
+        if not self._seg_ok(comm) or sbuf is IN_PLACE:
+            return super().reduce_scatter_block(comm, sbuf, rbuf,
+                                                rcount, datatype, op)
+        sarr = typed(sbuf, rcount * comm.size, datatype).arr
+        nbytes = sarr.size * sarr.itemsize
+        if not self._fits(nbytes) or not op.valid_for(sarr.dtype):
+            return super().reduce_scatter_block(comm, sbuf, rbuf,
+                                                rcount, datatype, op)
+        rb = typed(rbuf, rcount, datatype, writable=True)
+        n = rb.arr.size
+        codes = _nat_codes(_K_REDUCE_SCATTER, op, sarr.dtype)
+        if codes is not None:
+            sc = np.ascontiguousarray(sarr)
+            out_c = rb.arr if (rb.arr.flags.c_contiguous
+                               and rb.arr.dtype == sc.dtype) \
+                else np.empty(rb.arr.size, sc.dtype)
+            if self._native_run(comm, _K_REDUCE_SCATTER, 0, sc, out_c,
+                                nbytes, codes):
+                if out_c is not rb.arr:
+                    rb.arr.reshape(-1)[:] = out_c.reshape(-1)
+                rb.flush()
+                return
+        seg, g, b = self._enter(comm)
+        self._post(seg, comm, g, b, sarr)
+        self._wait_ge(comm, seg.seq32[:, b],
+                             lambda i: seg.seq_addr(i, b), g,
+                             f"reduce_scatter_block gen {g}")
+        lo, hi = comm.rank * n, (comm.rank + 1) * n
+        arrs = [self._slot_of(seg, p, b, nbytes,
+                              sarr.dtype).reshape(-1)[lo:hi]
+                for p in range(comm.size)]
+        out = self._fold(arrs, op)
+        rb.arr.reshape(-1)[:] = out
+        rb.flush()
+        seg.flag_done(comm.rank, g)
+
+
+class SegComponent(CollComponent):
+    name = "seg"
+
+    @property
+    def priority(self) -> int:
+        return _prio_var.value
+
+    def comm_query(self, comm):
+        rte = comm.state.rte
+        if not getattr(rte, "session_dir", None):
+            return None
+        # publish once per rank: eligibility compares every member's
+        # (node, session) pair — a dpm peer from another mpirun job
+        # shares neither the session dir nor its segments
+        st = comm.state
+        if not getattr(st, "_seg_modex_done", False):
+            try:
+                rte.modex_put("seg_session", rte.session_dir)
+                st._seg_modex_done = True
+            except Exception:
+                return None
+        return (self.priority, SegCollModule())
+
+
+coll_framework.add_component(SegComponent())

@@ -72,6 +72,13 @@ def load() -> Optional[ctypes.CDLL]:
                                               ctypes.c_int64,
                                               ctypes.c_uint64]
         lib.tpumpi_unpack_strided.restype = None
+        lib.tpumpi_seg_coll.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int64]
+        lib.tpumpi_seg_coll.restype = ctypes.c_int
         _lib = lib
         return _lib
 
