@@ -131,8 +131,11 @@ class Ring:
         if 4 + ln > self.cap:
             raise ValueError(
                 f"frame of {ln} bytes can never fit the "
-                f"{self.cap}-byte shm ring; lower btl_shm_max_send_size "
-                "or raise btl_shm_ring_size")
+                f"{self.cap}-byte shm ring; raise btl_shm_ring_size, "
+                "or lower the producer's frame size "
+                "(btl_shm_max_send_size for byte streams, "
+                "btl_tpu_chunk_bytes for device-array payloads — "
+                "object frags are not split by the btl)")
         if self._lib is not None:
             if not payload:
                 return self.push_native(hdr)

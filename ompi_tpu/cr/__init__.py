@@ -245,6 +245,11 @@ def checkpoint(comm, payload: Any, store_dir: Optional[str] = None,
         "pml_msgs": msgs,
         "rank": comm.rank,
     }
+    eng = getattr(comm.state, "_tpu_rndv", None)
+    if eng is not None and eng.pending:
+        # sender halves of in-flight chunked device transfers (the
+        # receiver halves are the xferhdr entries in pml_msgs)
+        blob["tpu_xfers"] = eng.cr_capture()
     if shmem_ctx is not None:
         blob["shmem_heap"] = shmem_ctx.heap.copy()
         blob["shmem_holes"] = list(shmem_ctx._holes)
@@ -284,6 +289,9 @@ def restore(comm, store_dir: Optional[str] = None, shmem_ctx=None
             f"{meta['nprocs']} ranks, job has {comm.size}")
     blob = store.read_rank(seq, comm.rank)
     comm.state.pml.cr_restore(blob["pml_msgs"])
+    if blob.get("tpu_xfers"):
+        from ompi_tpu.btl.tpu import _engine
+        _engine(comm.state).cr_restore(blob["tpu_xfers"])
     if shmem_ctx is not None and "shmem_heap" in blob:
         shmem_ctx.heap[:] = blob["shmem_heap"]
         shmem_ctx._holes = [tuple(h) for h in blob["shmem_holes"]]

@@ -234,6 +234,21 @@ class PmlOb1:
         ep.send((MATCH_OBJ, comm.cid, comm.rank, tag, seq,
                  self.state.rank, obj))
 
+    def poll_obj_any(self, tag):
+        """Non-blocking: pop one buffered object message with ``tag``
+        from ANY communicator's unexpected queue (no progress call —
+        this runs INSIDE a progress sweep).  The btl/tpu pull
+        protocol services its PULL requests this way: an active-
+        message handler in the reference (ref:
+        ompi/mca/osc/pt2pt's AM dispatch), a progress-driven poll
+        here."""
+        for lst in self._unexpected.values():
+            for m in lst:
+                if m.kind == MATCH_OBJ and m.tag == tag:
+                    lst.remove(m)
+                    return m
+        return None
+
     def recv_obj(self, src, tag, comm):
         """Blocking matched receive of an object message (kind
         MATCH_OBJ only) returning the UnexpectedMsg with its payload
@@ -577,6 +592,19 @@ class PmlOb1:
                     # snapshotted
                     continue
                 if m.kind == MATCH_OBJ:
+                    from ompi_tpu.btl.tpu import _XferHdr
+                    if isinstance(m.payload, _XferHdr):
+                        # chunked-transfer header whose DATA is parked
+                        # on the sender (captured there by the tpu
+                        # rndv engine's cr_capture); snapshot the
+                        # metadata so the pull protocol resumes after
+                        # restart
+                        h = m.payload
+                        msgs.append((cid, m.src, m.tag, m.total,
+                                     "xferhdr",
+                                     (h.xfer_id, tuple(h.shape),
+                                      h.dtype, h.nbytes, h.chunk)))
+                        continue
                     # in-flight device payload (send_arr completed,
                     # recv_arr pending): host-stage it into the
                     # snapshot; restore reinjects it as an object
@@ -607,7 +635,14 @@ class PmlOb1:
                 kind = "bytes"
             else:
                 cid, src, tag, total, kind, payload = entry
-            if kind == "obj":
+            if kind == "xferhdr":
+                from ompi_tpu.btl.tpu import _XferHdr
+                xid, shape, dtype, nbytes, chunk = payload
+                m = UnexpectedMsg(MATCH_OBJ, cid, src, tag, 0, total,
+                                  None,
+                                  _XferHdr(xid, shape, dtype, nbytes,
+                                           chunk))
+            elif kind == "obj":
                 from ompi_tpu.btl.tpu import DeviceArrayPayload
                 m = UnexpectedMsg(MATCH_OBJ, cid, src, tag, 0, total,
                                   None, DeviceArrayPayload(payload))

@@ -1,0 +1,46 @@
+"""Chunked cross-process device-array rendezvous: a large array must
+stream in bounded chunks (peak host staging <= a few chunks), never
+as one giant pickled frame (ref: pml_ob1_sendreq.c:404-453 pipelined
+schedule)."""
+import sys
+
+import numpy as np
+
+import ompi_tpu
+import ompi_tpu.btl.tpu  # register btl_tpu_* params
+from ompi_tpu.mca.params import registry
+
+comm = ompi_tpu.init()
+MB = 1024 * 1024
+chunk = registry.get("btl_tpu_chunk_bytes")
+n_mb = int(sys.argv[sys.argv.index("--mb") + 1]) if "--mb" in sys.argv else 48
+n = n_mb * MB // 4  # float32 elements; >> chunk (4 MiB)
+
+if comm.rank == 0:
+    x = np.arange(n, dtype=np.float32).reshape(4, -1)
+    comm.send_arr(x, 1, tag=3)
+    # service pulls until the transfer drains
+    eng = comm.state._tpu_rndv
+    import time
+    deadline = time.monotonic() + 120
+    while (eng.pending or eng._inflight) and time.monotonic() < deadline:
+        comm.state.progress.progress()
+        comm.state.progress.idle_tick()
+    assert not eng.pending, "transfer never drained"
+    comm.Barrier()
+    staged = eng.max_staged_bytes
+    depth = registry.get("btl_tpu_pipeline_depth")
+    bound = (depth + 2) * chunk
+    assert staged <= bound, (staged, bound)
+    print(f"devp2p-big ok staged={staged} bound={bound}", flush=True)
+else:
+    got = comm.recv_arr(0, tag=3)
+    a = np.asarray(got)
+    assert a.shape == (4, n // 4)
+    flat = a.reshape(-1)
+    assert flat[0] == 0.0 and flat[-1] == float(n - 1)
+    step = max(1, n // 997)
+    idx = np.arange(0, n, step)
+    assert (flat[idx] == idx.astype(np.float32)).all()
+    comm.Barrier()
+ompi_tpu.finalize()

@@ -106,3 +106,71 @@ def test_halo_exchange_uses_device_path():
         return True
 
     assert all(run_ranks(4, fn, devices=True))
+
+
+def test_chunked_transfer_bounded_staging():
+    """>chunk-sized arrays stream via the pull rendezvous: correct
+    content and host staging bounded at a few chunks (ref:
+    pml_ob1_sendreq.c:404-453 pipelined schedule)."""
+    from ompi_tpu.testing import mpirun_run
+    r = mpirun_run(2, os.path.join("tests", "_devp2p_big_prog.py"),
+                   timeout=300, job_timeout=250)
+    assert r.returncode == 0, r.stderr.decode()[-2000:]
+    assert b"devp2p-big ok" in r.stdout
+
+
+def test_chunked_256mib_across_simulated_nodes():
+    """The VERDICT r3 #5 gate: a 256 MiB device send crosses a
+    simulated two-node job (tcp transport) with bounded staging."""
+    from ompi_tpu.testing import mpirun_run
+    r = mpirun_run(2, os.path.join("tests", "_devp2p_big_prog.py"),
+                   "--mb", "256",
+                   extra=("--simulate-nodes", "2"),
+                   timeout=400, job_timeout=350)
+    assert r.returncode == 0, r.stderr.decode()[-2000:]
+    assert b"devp2p-big ok" in r.stdout
+
+
+def test_chunked_header_checkpoint_roundtrip():
+    """A not-yet-received chunked transfer survives capture/restore:
+    the receiver snapshots the header, the sender snapshots the
+    parked data, and the pull completes after reinjection."""
+    import numpy as np
+    from ompi_tpu.btl import tpu as tpumod
+    from ompi_tpu.mca.params import registry
+
+    def fn(comm):
+        if comm.rank == 0:
+            eng = tpumod._engine(comm.state)
+            flat = np.arange(5000, dtype=np.float64)
+            xid = eng.begin_send(flat)
+            cap = eng.cr_capture()
+            assert len(cap) == 1 and cap[0][0] == xid
+            eng.pending.clear()
+            eng.cr_restore(cap)
+            assert xid in eng.pending
+            # fresh ids never collide with restored ones
+            assert eng.begin_send(flat) > xid
+            eng.pending.clear()
+        else:
+            # receiver-side: a captured xferhdr reinjects intact
+            pml = comm.state.pml
+            hdr = tpumod._XferHdr(7, (10, 500), "float64", 40000,
+                                  registry.get("btl_tpu_chunk_bytes"))
+            from ompi_tpu.pml.ob1 import MATCH_OBJ, UnexpectedMsg
+            pml._unexpected.setdefault(comm.cid, []).append(
+                UnexpectedMsg(MATCH_OBJ, comm.cid, 0, 4, 0,
+                              len(hdr), None, hdr))
+            msgs = pml.cr_capture()
+            kinds = [m[4] for m in msgs]
+            assert "xferhdr" in kinds, kinds
+            pml._unexpected[comm.cid].clear()
+            pml.cr_restore(msgs)
+            m = pml._unexpected[comm.cid][0]
+            assert isinstance(m.payload, tpumod._XferHdr)
+            assert m.payload.shape == (10, 500)
+            pml._unexpected[comm.cid].clear()
+        comm.Barrier()
+        return True
+
+    assert all(run_ranks(2, fn))
