@@ -313,8 +313,10 @@ def send_arr(comm, x, dst: int, tag: int = 0) -> None:
             x = x.copy()
         comm.state.pml.isend_obj(DeviceArrayPayload(x), dst, tag, comm)
         return
-    nbytes = int(getattr(x, "nbytes", 0) or np.asarray(x).nbytes)
-    dt = np.dtype(getattr(x, "dtype", None) or np.asarray(x).dtype)
+    if not hasattr(x, "nbytes") or not hasattr(x, "reshape"):
+        x = np.asarray(x)  # lists/tuples: one materialization
+    nbytes = int(x.nbytes)
+    dt = np.dtype(x.dtype)
     chunkable = dt.fields is None and not dt.hasobject \
         and np.dtype(str(dt)) == dt
     if nbytes > _chunk_var.value and chunkable:

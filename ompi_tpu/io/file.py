@@ -43,6 +43,25 @@ SEEK_CUR = 1
 SEEK_END = 2
 
 
+# user data representations (MPI_Register_datarep, ref:
+# ompi/mpi/c/register_datarep.c + ompi/mca/io/base registration):
+# name -> (read_fn, write_fn, extent_fn, extra_state).  Conversion
+# functions take (filebytes_or_userbytes, datatype, count, extra)
+# and return converted bytes of the SAME length (length-changing
+# representations are out of scope, as in the reference's ompio,
+# which rejects datareps it cannot serve).
+_datareps: dict = {}
+
+
+def register_datarep(name: str, read_fn=None, write_fn=None,
+                     extent_fn=None, extra_state=None) -> None:
+    if name in ("native", "external32", "internal") \
+            or name in _datareps:
+        raise ValueError(
+            f"datarep {name!r} already defined (MPI_ERR_DUP_DATAREP)")
+    _datareps[name] = (read_fn, write_fn, extent_fn, extra_state)
+
+
 def _posix_flags(amode: int) -> int:
     if amode & MODE_RDWR:
         flags = os.O_RDWR
@@ -74,6 +93,7 @@ class File:
             else dict(info or {})
         self.errhandler = _eh.ERRORS_RETURN
         self.attrs = {}
+        self._datarep = "native"
         self.state = comm.state
         self._lock = threading.Lock()
         # fs: open is collective; every rank opens its own descriptor
@@ -180,7 +200,8 @@ class File:
     # -- views -----------------------------------------------------------
     def set_view(self, disp: int = 0, etype=None, filetype=None,
                  datarep: str = "native") -> None:
-        if datarep not in ("native", "external32"):
+        if datarep not in ("native", "external32") \
+                and datarep not in _datareps:
             raise ValueError(f"unsupported datarep {datarep!r}")
         self.view = FileView(disp, etype, filetype)
         self.pos = 0
@@ -244,6 +265,14 @@ class File:
         tb = typed(buf, count, dt, writable=True)
         segs = self.view.map_bytes(offset, tb.arr.nbytes)
         data, actual = self._pread_segs_counted(segs)
+        rep = _datareps.get(self._datarep)
+        if rep is not None and rep[0] is not None:
+            before = len(data)
+            data = rep[0](bytes(data), dt, count, rep[3])
+            if len(data) != before:
+                raise ValueError(
+                    f"datarep {self._datarep!r} read conversion "
+                    "changed the byte length (unsupported)")
         tb.arr.view(np.uint8)[:len(data)] = np.frombuffer(
             data, dtype=np.uint8)
         tb.flush()
@@ -255,6 +284,14 @@ class File:
         buf, count, dt = self._spec(spec)
         tb = typed(buf, count, dt)
         raw = tb.arr.view(np.uint8).data
+        rep = _datareps.get(self._datarep)
+        if rep is not None and rep[1] is not None:
+            conv = rep[1](bytes(raw), dt, count, rep[3])
+            if len(conv) != len(raw):
+                raise ValueError(
+                    f"datarep {self._datarep!r} write conversion "
+                    "changed the byte length (unsupported)")
+            raw = memoryview(conv)
         segs = self.view.map_bytes(offset, tb.arr.nbytes)
         n = self._pwrite_segs(segs, raw)
         st = Status()

@@ -1274,6 +1274,32 @@ def MPI_Alltoallw(sbuf, scounts, sdispls, stypes, rbuf, rcounts,
         r.wait()
 
 
+def MPI_Ialltoallw(sbuf, scounts, sdispls, stypes, rbuf, rcounts,
+                   rdispls, rtypes, comm):
+    """Nonblocking byte-displacement alltoall with per-peer datatypes
+    (ref: ompi/mpi/c/ialltoallw.c): one round of typed isend/irecv
+    progressed as an nbc schedule."""
+    from ompi_tpu.coll.nbc import NBCRequest, _nbc_tag
+    sview = _byteview(sbuf)
+    rview = _byteview(rbuf)
+    pml = comm.state.pml
+    tag = _nbc_tag(comm)  # per-instance: overlapping i-colls never
+    thunks = []           # cross-match (the nbc tag discipline)
+    for peer in range(comm.size):
+        if rcounts[peer]:
+            thunks.append(
+                lambda p=peer: pml.irecv(rview[rdispls[p]:],
+                                         rcounts[p], rtypes[p], p,
+                                         tag, comm))
+    for peer in range(comm.size):
+        if scounts[peer]:
+            thunks.append(
+                lambda p=peer: pml.isend(sview[sdispls[p]:],
+                                         scounts[p], stypes[p], p,
+                                         tag, comm))
+    return NBCRequest(comm, [thunks])
+
+
 # -- datatype extras ---------------------------------------------------------
 from ompi_tpu.datatype.engine import (  # noqa: E402,F401
     hindexed as MPI_Type_create_hindexed,
@@ -1816,6 +1842,48 @@ def MPI_Neighbor_alltoallw(sbuf, scounts, sdispls, stypes, rbuf,
                                   stypes[i], dst, -132, comm))
     for r in reqs:
         r.wait()
+
+
+def MPI_Ineighbor_alltoallw(sbuf, scounts, sdispls, stypes, rbuf,
+                            rcounts, rdispls, rtypes, comm):
+    """Nonblocking per-neighbor-datatype exchange
+    (ref: ompi/mpi/c/ineighbor_alltoallw.c)."""
+    from ompi_tpu.coll.nbc import NBCRequest, _nbc_tag
+    topo = comm.topo
+    srcs = topo.in_neighbors(comm.rank)
+    dsts = topo.out_neighbors(comm.rank)
+    sview = _byteview(sbuf)
+    rview = _byteview(rbuf)
+    pml = comm.state.pml
+    tag = _nbc_tag(comm)
+    thunks = []
+    for i, src in enumerate(srcs):
+        if rcounts[i]:
+            thunks.append(
+                lambda j=i, s=src: pml.irecv(rview[rdispls[j]:],
+                                             rcounts[j], rtypes[j],
+                                             s, tag, comm))
+    for i, dst in enumerate(dsts):
+        if scounts[i]:
+            thunks.append(
+                lambda j=i, d=dst: pml.isend(sview[sdispls[j]:],
+                                             scounts[j], stypes[j],
+                                             d, tag, comm))
+    return NBCRequest(comm, [thunks])
+
+
+def MPI_Register_datarep(datarep, read_conversion_fn=None,
+                         write_conversion_fn=None,
+                         dtype_file_extent_fn=None,
+                         extra_state=None):
+    """Register a user data representation for file views
+    (ref: ompi/mpi/c/register_datarep.c).  Conversion callables take
+    (raw_bytes, datatype, count, extra_state) and return converted
+    bytes of equal length."""
+    from ompi_tpu.io.file import register_datarep
+    register_datarep(datarep, read_conversion_fn,
+                     write_conversion_fn, dtype_file_extent_fn,
+                     extra_state)
 
 
 def MPI_Dist_graph_create(comm, n, sources, degrees, destinations,

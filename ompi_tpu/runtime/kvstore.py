@@ -13,12 +13,48 @@ small address blobs, never data-plane traffic).
 
 from __future__ import annotations
 
+import hmac
 import json
+import os
 import socket
 import struct
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
+
+
+def job_secret() -> Optional[str]:
+    """The per-job control-plane secret (launcher-generated,
+    env-forwarded).  The sec/basic analog (ref:
+    opal/mca/sec/basic/sec_basic.c — credentials checked at
+    connection acceptance): without it any local process could dial
+    the rendezvous server and inject aborts or spawns."""
+    return os.environ.get("TPUMPI_JOB_SECRET") or None
+
+
+def _require_hello(conn, secret: Optional[str]) -> bool:
+    """Server side of the hello frame: when a secret is configured,
+    the FIRST message must be an authenticating hello.  Returns True
+    when the connection may proceed."""
+    if not secret:
+        return True
+    msg = _recv_msg(conn)
+    if msg is None:
+        return False
+    if msg.get("op") != "hello" or not isinstance(
+            msg.get("secret"), str) or not hmac.compare_digest(
+            msg["secret"], secret):
+        try:
+            _send_msg(conn, {"error": "unauthenticated"})
+        except OSError:
+            pass
+        try:
+            conn.close()
+        except OSError:
+            pass
+        return False
+    _send_msg(conn, {"ok": True})
+    return True
 
 
 def _send_msg(sock: socket.socket, obj: dict) -> None:
@@ -52,6 +88,7 @@ class KVServer:
         ``advertise`` is the address clients are told to dial (the
         HNP's reachable IP when binding wildcard)."""
         self.nprocs = nprocs
+        self.secret = job_secret()
         self.data: Dict[str, Any] = {}
         self.lock = threading.Lock()
         self.cv = threading.Condition(self.lock)
@@ -99,13 +136,18 @@ class KVServer:
 
     def _serve(self, conn: socket.socket) -> None:
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        if not _require_hello(conn, self.secret):
+            return
         try:
             while True:
                 msg = _recv_msg(conn)
                 if msg is None:
                     return
                 op = msg.get("op")
-                if op == "put":
+                if op == "hello":
+                    # secretless server: ack so mixed configs work
+                    _send_msg(conn, {"ok": True})
+                elif op == "put":
                     with self.cv:
                         self.data[msg["key"]] = msg["value"]
                         self.cv.notify_all()
@@ -247,6 +289,15 @@ class KVClient:
         # protection is the server-side get timeout + mpirun --timeout
         s.settimeout(None)
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        secret = job_secret()
+        if secret:
+            _send_msg(s, {"op": "hello", "secret": secret})
+            resp = _recv_msg(s)
+            if not resp or not resp.get("ok"):
+                s.close()
+                raise PermissionError(
+                    "kv server refused the job secret "
+                    "(TPUMPI_JOB_SECRET mismatch)")
         return s
 
     def put(self, key: str, value: Any) -> None:
@@ -374,6 +425,7 @@ class KVProxy:
 
     def __init__(self, upstream_addr: str, local_expected: int) -> None:
         self.local_expected = max(1, local_expected)
+        self.secret = job_secret()
         self.up = KVClient(upstream_addr)
         # dedicated fence channel, reused across fences (a pending
         # fence must never block ops; fences of one job are
@@ -421,13 +473,17 @@ class KVProxy:
 
     def _serve(self, conn: socket.socket) -> None:
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        if not _require_hello(conn, self.secret):
+            return
         try:
             while True:
                 msg = _recv_msg(conn)
                 if msg is None:
                     return
                 op = msg.get("op")
-                if op == "put":
+                if op == "hello":
+                    _send_msg(conn, {"ok": True})
+                elif op == "put":
                     self.up.put(msg["key"], msg["value"])
                     _send_msg(conn, {"ok": True})
                 elif op == "get":

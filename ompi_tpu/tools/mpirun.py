@@ -46,8 +46,22 @@ import threading
 import time
 from typing import List, Optional
 
+from ompi_tpu.mca.params import registry as _params
 from ompi_tpu.runtime import statemachine as smx
 from ompi_tpu.runtime.kvstore import KVServer
+
+_errmgr_policy_var = _params.register(
+    "errmgr", "base", "policy", "abort", str,
+    help="What the launcher does when a proc/daemon fails: 'abort' "
+         "(first failure kills the job — the errmgr/default_hnp "
+         "policy) or 'restart' (with --ckpt-dir: relaunch the job "
+         "from the latest complete snapshot — the elastic-recovery "
+         "slice of rmaps/resilient + errmgr ft, ref: "
+         "orte/mca/rmaps/resilient/rmaps_resilient.c)")
+_errmgr_max_restarts_var = _params.register(
+    "errmgr", "base", "max_restarts", 2, int,
+    help="Automatic relaunch attempts before giving up (restart "
+         "policy only)")
 
 
 def _forward(stream, out, tag: str, tag_output: bool) -> None:
@@ -209,7 +223,8 @@ def run_multinode(opts, nodes, rpp: int, hybrid: bool) -> int:
         # via a forced M-device CPU platform (ras/simulator analog)
         node_env = {}
         for n in nodes:
-            env = {}
+            env = {"TPUMPI_JOB_SECRET":
+                   os.environ["TPUMPI_JOB_SECRET"]}
             if n.simulated and opts.devices != "none":
                 env["JAX_PLATFORMS"] = "cpu"
                 flags = os.environ.get("XLA_FLAGS", "")
@@ -230,6 +245,7 @@ def run_multinode(opts, nodes, rpp: int, hybrid: bool) -> int:
             "TPUMPI_SIZE": str(opts.np),
             "TPUMPI_KV_ADDR": server.addr,
             "TPUMPI_JOBID": f"job-{os.getpid()}",
+            "TPUMPI_JOB_SECRET": os.environ["TPUMPI_JOB_SECRET"],
         }
         if hybrid:
             job_env["TPUMPI_DEVICES"] = opts.devices
@@ -622,6 +638,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("prog")
     ap.add_argument("args", nargs=argparse.REMAINDER)
     opts = ap.parse_args(argv)
+    # per-job control-plane secret (sec/basic analog): KV/OOB servers
+    # refuse connections without it.  setdefault so a relaunch under
+    # an outer job reuses the outer credential.
+    import secrets as _secrets
+    os.environ.setdefault("TPUMPI_JOB_SECRET", _secrets.token_hex(16))
     # checkpoint/restart store plumbing (cr stack; orte-checkpoint /
     # orte-restart tool analogs live in ompi_tpu.tools.restart)
     ckpt_env = {}
@@ -677,10 +698,43 @@ def main(argv: Optional[List[str]] = None) -> int:
     # any EXPLICIT allocation goes through the PLM (slot counts and
     # mapping policy enforced uniformly, even for one local node);
     # only the implicit local default uses the direct fork/exec path
-    if any(x is not None for x in (opts.hosts, opts.hostfile,
-                                   opts.simulate)):
-        return run_multinode(opts, nodes, rpp, hybrid)
-    return run_local(opts, rpp, hybrid, ckpt_env)
+    explicit = any(x is not None for x in (opts.hosts, opts.hostfile,
+                                           opts.simulate))
+
+    def run_once() -> int:
+        if explicit:
+            return run_multinode(opts, nodes, rpp, hybrid)
+        return run_local(opts, rpp, hybrid, ckpt_env)
+
+    rc = run_once()
+    # errmgr restart policy (elastic-recovery slice): instead of the
+    # default first-failure-kills-the-job, relaunch from the latest
+    # complete snapshot.  Exit 124 is the --timeout kill — restarting
+    # a job that legitimately ran out of wall clock only doubles the
+    # damage, so it never retries.
+    if rc not in (0, 124) and opts.ckpt_dir \
+            and _errmgr_policy_var.value == "restart":
+        from ompi_tpu import cr as _cr
+        attempts = 0
+        max_r = int(_errmgr_max_restarts_var.value)
+        while rc not in (0, 124) and attempts < max_r:
+            seq = _cr.Store(ckpt_root).latest_complete()
+            if seq is None:
+                sys.stderr.write(
+                    "mpirun: errmgr restart policy: no complete "
+                    "snapshot to restart from; giving up\n")
+                break
+            attempts += 1
+            if "state" in (opts.verbose or ""):
+                sys.stderr.write(
+                    f"[mpirun:hnp:state] DRAINING -> RESTARTING "
+                    f"(snapshot={seq} attempt={attempts}/{max_r})\n")
+            sys.stderr.write(
+                f"mpirun: errmgr restart policy: relaunching from "
+                f"snapshot {seq} (attempt {attempts}/{max_r})\n")
+            ckpt_env["TPUMPI_RESTART"] = "1"
+            rc = run_once()
+    return rc
 
 
 if __name__ == "__main__":

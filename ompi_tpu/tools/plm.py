@@ -131,7 +131,26 @@ class HNP:
 
     def _dispatch(self, msg: dict, holder: List) -> None:
         op = msg.get("op")
+        if holder[0] is None and op != "register" \
+                and os.environ.get("TPUMPI_JOB_SECRET"):
+            # nothing but an authenticating register may ride an
+            # unregistered channel: an injected proc_exit/iof from a
+            # stray local process must never reach the state machine
+            # (sec/basic: the whole connection is gated)
+            if len(holder) > 1:
+                holder[1].close()
+            return
         if op == "register":
+            import hmac
+            want = os.environ.get("TPUMPI_JOB_SECRET")
+            if want and not (isinstance(msg.get("secret"), str)
+                             and hmac.compare_digest(msg["secret"],
+                                                     want)):
+                # unauthenticated daemon registration: drop the
+                # channel (sec/basic: credential checked at accept)
+                if len(holder) > 1:
+                    holder[1].close()
+                return
             node = msg["node"]
             with self.lock:
                 holder[0] = node

@@ -255,7 +255,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             entry, opts.hnp, opts.agent, opts.python, opts.pythonpath))
 
     chan.send({"op": "register", "node": opts.node, "name": opts.name,
-               "if_ip": if_ip})
+               "if_ip": if_ip,
+               "secret": os.environ.get("TPUMPI_JOB_SECRET", "")})
 
     # monitor loop: report unit exits; finish when every unit the
     # launch message promised has been spawned AND exited (guards the

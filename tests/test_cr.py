@@ -186,3 +186,66 @@ def test_checkpoint_kill_restart_under_mpirun(tmp_path):
     # job.json recorded the launch for the restart tool
     job = json.load(open(os.path.join(store, "job.json")))
     assert job["np"] == 4
+
+
+def test_errmgr_restart_policy_auto_recovers(tmp_path):
+    """Elastic-recovery slice (VERDICT r3 #6): with the MCA-selected
+    errmgr restart policy, a SIGKILL'd rank mid-run leads to an
+    automatic relaunch from the latest complete snapshot and the job
+    completes with the uninterrupted run's results."""
+    prog = os.path.join(REPO, "tests", "_ckpt_prog.py")
+    store = str(tmp_path / "store")
+    ref = _run([sys.executable, "-m", "ompi_tpu.tools.mpirun",
+                "-np", "4", "--ckpt-dir", str(tmp_path / "ref"), prog])
+    assert ref.returncode == 0, ref.stderr.decode()
+    ref_line = [ln for ln in ref.stdout.decode().splitlines()
+                if ln.startswith("final ")][0]
+
+    r = _run([sys.executable, "-m", "ompi_tpu.tools.mpirun",
+              "-np", "4", "--ckpt-dir", store, "--verbose", "state",
+              "--mca", "errmgr_base_policy", "restart", prog],
+             env={"CKPT_CRASH_AT": "5"})
+    err = r.stderr.decode()
+    assert r.returncode == 0, err[-2000:]
+    assert "DRAINING -> RESTARTING" in err
+    line = [ln for ln in r.stdout.decode().splitlines()
+            if ln.startswith("final ")][0]
+    assert "resumed=True" in line
+    assert line.replace("resumed=True", "resumed=False") == ref_line
+
+
+def test_store_compression_roundtrip_and_back_compat(tmp_path):
+    """Images gzip by default (format marker), shrink compressible
+    payloads, and pre-compression raw images still read."""
+    import pickle as _pickle
+
+    from ompi_tpu.mca.params import registry
+
+    store = cr.Store(str(tmp_path))
+    blob = {"payload": np.zeros(64 * 1024, dtype=np.float64),
+            "pml_msgs": []}
+    store.write_rank(1, 0, blob)
+    path = os.path.join(store.seq_path(1), "rank_0.ckpt")
+    size_gz = os.path.getsize(path)
+    got = store.read_rank(1, 0)
+    assert np.array_equal(got["payload"], blob["payload"])
+    # compressible payload shrinks by a lot
+    assert size_gz < 64 * 1024 * 8 / 4, size_gz
+
+    # raw (pre-marker) image: written uncompressed, still readable
+    registry.set("cr_base_compress", False)
+    try:
+        store.write_rank(2, 0, blob)
+        assert os.path.getsize(
+            os.path.join(store.seq_path(2), "rank_0.ckpt")) > 64 * 1024
+        got = store.read_rank(2, 0)
+        assert np.array_equal(got["payload"], blob["payload"])
+    finally:
+        registry.set("cr_base_compress", True)
+
+    # hand-written legacy raw file (no marker)
+    with open(os.path.join(store.seq_path(1), "rank_9.ckpt"),
+              "wb") as f:
+        _pickle.dump(blob, f)
+    got = store.read_rank(1, 9)
+    assert np.array_equal(got["payload"], blob["payload"])

@@ -358,3 +358,84 @@ def test_version_and_misc():
     mem = mpi.MPI_Alloc_mem(64)
     assert mem.nbytes == 64
     mpi.MPI_Free_mem(mem)
+
+
+def test_ialltoallw_and_ineighbor_alltoallw():
+    """Surface tail (VERDICT r3 #8): the nonblocking w-variants."""
+    def fn(comm):
+        n = comm.size
+        sbuf = np.array([comm.rank * 10.0 + p for p in range(n)])
+        rbuf = np.zeros(n, dtype=np.float64)
+        counts = [1] * n
+        displs = [8 * p for p in range(n)]
+        types = [mpi.MPI_DOUBLE] * n
+        req = mpi.MPI_Ialltoallw(sbuf, counts, displs, types, rbuf,
+                                 counts, displs, types, comm)
+        req.wait()
+        assert rbuf.tolist() == [p * 10.0 + comm.rank
+                                 for p in range(n)]
+
+        # ring cart: one double to each of left/right
+        cart = comm.Create_cart([n], periods=[True])
+        nbrs = cart.topo.in_neighbors(cart.rank)
+        k = len(nbrs)
+        s2 = np.array([cart.rank + 100.0 * i for i in range(k)])
+        r2 = np.zeros(k, dtype=np.float64)
+        cnt = [1] * k
+        dsp = [8 * i for i in range(k)]
+        tps = [mpi.MPI_DOUBLE] * k
+        req = mpi.MPI_Ineighbor_alltoallw(s2, cnt, dsp, tps, r2, cnt,
+                                          dsp, tps, cart)
+        req.wait()
+        # each neighbor sent us the slot addressed to us in its
+        # out-neighbor order
+        for i, src in enumerate(nbrs):
+            their_out = cart.topo.out_neighbors(src)
+            j = their_out.index(cart.rank)
+            assert r2[i] == src + 100.0 * j, (r2, i, src, j)
+        return True
+
+    assert run_ranks(4, fn) == [True] * 4
+
+
+def test_register_datarep_roundtrip():
+    """MPI_Register_datarep: user representation applied on the file
+    byte path; duplicate names rejected."""
+    import os
+    import tempfile
+
+    def fn(comm):
+        from ompi_tpu.io import file as iof
+
+        def enc(raw, dt, count, extra):
+            return bytes(b ^ extra for b in raw)
+
+        name = f"xor_rep_{comm.state.rank}"
+        mpi.MPI_Register_datarep(name, read_conversion_fn=enc,
+                                 write_conversion_fn=enc,
+                                 extra_state=0x5A)
+        try:
+            mpi.MPI_Register_datarep(name)
+            return False  # duplicate must raise
+        except ValueError:
+            pass
+        with tempfile.TemporaryDirectory() as td:
+            path = os.path.join(td, "f.dat")
+            self_comm = comm.Split(comm.rank)  # per-rank file
+            fh = iof.open(self_comm, path,
+                          iof.MODE_CREATE | iof.MODE_RDWR)
+            fh.set_view(0, datarep=name)
+            x = np.arange(8, dtype=np.float64)
+            fh.write_at(0, x)
+            got = np.zeros_like(x)
+            fh.read_at(0, got)
+            assert (got == x).all()
+            # on disk the bytes are the CONVERTED representation
+            disk = np.fromfile(path, dtype=np.uint8)
+            plain = x.view(np.uint8)
+            assert not np.array_equal(disk[:64], plain)
+            assert np.array_equal(disk[:64] ^ 0x5A, plain)
+            fh.close()
+        return True
+
+    assert run_ranks(2, fn) == [True] * 2
