@@ -179,11 +179,15 @@ class Store:
     def read_rank(self, seq: int, rank: int) -> dict:
         with open(os.path.join(self.seq_path(seq),
                                f"rank_{rank}.ckpt"), "rb") as f:
-            data = f.read()
-        if data[:4] == _CKPT_GZ_MAGIC:
-            import gzip
-            data = gzip.decompress(data[4:])
-        return pickle.loads(data)
+            magic = f.read(4)
+            if magic == _CKPT_GZ_MAGIC:
+                import gzip
+                # stream-decompress: never hold compressed + raw
+                # images at once (mirror of the write path)
+                with gzip.GzipFile(fileobj=f, mode="rb") as gz:
+                    return pickle.load(gz)
+            f.seek(0)
+            return pickle.load(f)
 
     def mark_complete(self, seq: int, meta: dict) -> None:
         d = self.seq_path(seq)
@@ -274,6 +278,12 @@ def checkpoint(comm, payload: Any, store_dir: Optional[str] = None,
     prunes to the newest N complete snapshots (0 = keep all)."""
     store = _store_for(store_dir)
     quiesce(comm)
+    from ompi_tpu.pml.vprotocol import find as _vfind
+    _v = _vfind(comm.state.pml)
+    if _v is not None:
+        # quiesce proved every logged message consumed: the
+        # coordinated checkpoint is the pessimist log's GC point
+        _v.clear_log()
     msgs = comm.state.pml.cr_capture()
     blob = {
         "payload": _encode(payload),
@@ -287,7 +297,7 @@ def checkpoint(comm, payload: Any, store_dir: Optional[str] = None,
         blob["tpu_xfers"] = eng.cr_capture()
     if shmem_ctx is not None:
         blob["shmem_heap"] = shmem_ctx.heap.copy()
-        blob["shmem_holes"] = list(shmem_ctx._holes)
+        blob["shmem_alloc"] = shmem_ctx.memheap.state()
 
     seq = np.array([store.next_seq() if comm.rank == 0 else 0],
                    dtype=np.int64)
@@ -304,6 +314,74 @@ def checkpoint(comm, payload: Any, store_dir: Optional[str] = None,
             store.prune(keep)
     comm.Barrier()  # ...before anyone trusts the snapshot exists
     return int(seq[0])
+
+
+def _vlayer(comm):
+    from ompi_tpu.pml.vprotocol import find
+    v = find(comm.state.pml)
+    if v is None:
+        raise RuntimeError(
+            "uncoordinated checkpoint requires sender-based message "
+            "logging: launch with --mca pml_vprotocol pessimist")
+    return v
+
+
+def checkpoint_local(comm, payload: Any,
+                     store_dir: Optional[str] = None,
+                     keep: int = 0) -> int:
+    """UNCOORDINATED snapshot (vprotocol/pessimist): no quiesce, no
+    collective, no drain — each rank snapshots at its own moment and
+    writes its own sequence under ``local_r<rank>/``.  Messages
+    mid-wire or arrived-but-unconsumed at the cut are NOT captured;
+    the sender's log redelivers them at restore (replay), and the
+    snapshotted sequence maps make redelivery exactly-once.  The
+    only local contract: wait your own requests first (same as MPI
+    C/R semantics)."""
+    store = _store_for(store_dir)
+    v = _vlayer(comm)
+    base = v._base
+    blob = {
+        "payload": _encode(payload),
+        "vlog": v.cr_capture_vlog(),
+        "replay_want": base.cr_capture_lenient(),
+        "rank": comm.rank,
+    }
+    sub = Store(os.path.join(store.root, f"local_r{comm.rank}"))
+    seq = sub.next_seq()
+    sub.write_rank(seq, comm.rank, blob)
+    sub.mark_complete(seq, {"rank": comm.rank, "seq": seq})
+    if keep:
+        sub.prune(keep)
+    return seq
+
+
+def restore_local(comm, store_dir: Optional[str] = None
+                  ) -> Optional[Any]:
+    """Restore from MY latest local (uncoordinated) snapshot, then
+    replay the sender logs so every in-flight message of the cut
+    line is redelivered.  Collective only in the sense that every
+    rank must pass through here before user traffic resumes (the
+    internal barrier orders replay against restored counters)."""
+    root = store_dir or os.environ.get(ENV_DIR)
+    if not root or not os.environ.get(ENV_RESTART):
+        return None
+    sub = Store(os.path.join(root, f"local_r{comm.rank}"))
+    seq = sub.latest_complete()
+    if seq is None:
+        return None
+    blob = sub.read_rank(seq, comm.rank)
+    v = _vlayer(comm)
+    v.cr_restore_vlog(blob["vlog"])
+    v._base._replay_want = {tuple(w) for w in blob["replay_want"]}
+    # every rank's counters restored BEFORE any replay frag can
+    # arrive.  The rendezvous must NOT ride the pml: a pml barrier's
+    # own fragments would queue BEHIND the unreplayed sequence holes
+    # (symmetric in-flight cuts deadlock).  The control-plane fence
+    # (KV server) is hole-free.
+    comm.state.rte.fence()
+    v.replay()
+    out = _decode(blob["payload"], comm.state.device)
+    return out
 
 
 def restore(comm, store_dir: Optional[str] = None, shmem_ctx=None
@@ -329,7 +407,27 @@ def restore(comm, store_dir: Optional[str] = None, shmem_ctx=None
         _engine(comm.state).cr_restore(blob["tpu_xfers"])
     if shmem_ctx is not None and "shmem_heap" in blob:
         shmem_ctx.heap[:] = blob["shmem_heap"]
-        shmem_ctx._holes = [tuple(h) for h in blob["shmem_holes"]]
+        if "shmem_alloc" in blob:
+            from ompi_tpu.shmem import memheap as _mh
+            shmem_ctx.memheap = _mh.restore(blob["shmem_alloc"],
+                                            shmem_ctx.heap_size)
+        else:
+            # pre-framework snapshot: hole list of the old first-fit.
+            # Live regions are the holes' complement; boundaries of
+            # adjacent allocations inside one live run are lost
+            # (legacy format limitation) — each run frees as a unit.
+            from ompi_tpu.shmem.memheap import FirstFit as _FF
+            ff = _FF(shmem_ctx.heap_size)
+            ff._holes = [tuple(h) for h in blob["shmem_holes"]]
+            ff._live = {}
+            pos = 0
+            for off, sz in sorted(ff._holes):
+                if off > pos:
+                    ff._live[pos] = off - pos
+                pos = off + sz
+            if pos < shmem_ctx.heap_size:
+                ff._live[pos] = shmem_ctx.heap_size - pos
+            shmem_ctx.memheap = ff
     out = _decode(blob["payload"], comm.state.device)
     comm.Barrier()
     return out

@@ -75,7 +75,9 @@ def mpi_init(state: ProcState, device=None) -> ProcState:
     # 1. select the single pml engine (ref: ompi_mpi_init.c:640),
     # optionally interposed by pml/monitoring
     comp, pml_cls = _pml_ob1.pml_framework.select_one(state)
-    state.pml = _pml_monitoring.maybe_wrap(pml_cls(state), state)
+    from ompi_tpu.pml import vprotocol as _pml_vprotocol
+    state.pml = _pml_vprotocol.maybe_wrap(
+        _pml_monitoring.maybe_wrap(pml_cls(state), state), state)
     # 2. btl modules + endpoint wiring (modex happens inside init)
     modules = []
     for c in btl_base.btl_framework.components():

@@ -32,6 +32,48 @@ def job_secret() -> Optional[str]:
     return os.environ.get("TPUMPI_JOB_SECRET") or None
 
 
+_DFS_REMOTE = 1 << 30  # proxy fd-namespace offset for forwarded files
+
+
+def dfs_parse_uri(uri: str) -> Tuple[str, str]:
+    """'file://HOST/abs/path' -> (HOST, /abs/path); a bare path is
+    ('', path) — local.  (ref: orte/mca/dfs/dfs.h:50 — the uri names
+    the host the file lives on.)"""
+    if uri.startswith("file://"):
+        rest = uri[len("file://"):]
+        host, sep, path = rest.partition("/")
+        return host, "/" + path if sep else ""
+    return "", uri
+
+
+def _dfs_serve(op: str, msg: dict, fds: Dict[int, int]) -> dict:
+    """Serve one dfs request against THIS host's filesystem (the
+    daemon/HNP side of orte/mca/dfs — read-only by design).  ``fds``
+    is the per-connection descriptor table; the connection's close
+    cleans it up."""
+    try:
+        if op == "dfs_open":
+            _, path = dfs_parse_uri(msg["uri"])
+            fd = os.open(path, os.O_RDONLY)
+            fds[fd] = fd
+            return {"fd": fd, "size": os.fstat(fd).st_size}
+        fd = fds.get(int(msg.get("fd", -1)), -1)
+        if fd < 0:
+            return {"error": "bad dfs fd"}
+        if op == "dfs_read":
+            data = os.pread(fd, int(msg["len"]), int(msg["offset"]))
+            return {"data": data.decode("latin-1")}
+        if op == "dfs_size":
+            return {"size": os.fstat(fd).st_size}
+        if op == "dfs_close":
+            fds.pop(fd, None)
+            os.close(fd)
+            return {"ok": True}
+        return {"error": f"unknown dfs op {op}"}
+    except OSError as e:
+        return {"error": str(e)}
+
+
 def _require_hello(conn, secret: Optional[str]) -> bool:
     """Server side of the hello frame: when a secret is configured,
     the FIRST message must be an authenticating hello.  Returns True
@@ -138,6 +180,7 @@ class KVServer:
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         if not _require_hello(conn, self.secret):
             return
+        dfs_fds: Dict[int, int] = {}
         try:
             while True:
                 msg = _recv_msg(conn)
@@ -147,6 +190,8 @@ class KVServer:
                 if op == "hello":
                     # secretless server: ack so mixed configs work
                     _send_msg(conn, {"ok": True})
+                elif op.startswith("dfs_"):
+                    _send_msg(conn, _dfs_serve(op, msg, dfs_fds))
                 elif op == "put":
                     with self.cv:
                         self.data[msg["key"]] = msg["value"]
@@ -261,6 +306,15 @@ class KVServer:
                     _send_msg(conn, {"base": base})
         except OSError:
             return
+        finally:
+            # a client gone without dfs_close must not leak this
+            # long-lived process's descriptors (EMFILE would take
+            # down the whole control plane)
+            for fd in dfs_fds.values():
+                try:
+                    os.close(fd)
+                except OSError:
+                    pass
 
     def close(self) -> None:
         self._stop = True
@@ -394,6 +448,33 @@ class KVClient:
                                    "code": code, "msg": msg})
             _recv_msg(self._sock)
 
+    # -- dfs (orte/mca/dfs/app analog: remote read-only file access) ----
+    def _dfs_req(self, msg: dict) -> dict:
+        with self._lock:
+            _send_msg(self._sock, msg)
+            resp = _recv_msg(self._sock)
+        if resp is None:
+            raise ConnectionError("kv server closed")
+        if "error" in resp:
+            raise OSError(f"dfs: {resp['error']}")
+        return resp
+
+    def dfs_open(self, uri: str) -> Tuple[int, int]:
+        resp = self._dfs_req({"op": "dfs_open", "uri": uri})
+        return int(resp["fd"]), int(resp["size"])
+
+    def dfs_read(self, fd: int, offset: int, n: int) -> bytes:
+        resp = self._dfs_req({"op": "dfs_read", "fd": fd,
+                              "offset": offset, "len": n})
+        return resp["data"].encode("latin-1")
+
+    def dfs_size(self, fd: int) -> int:
+        return int(self._dfs_req({"op": "dfs_size",
+                                  "fd": fd})["size"])
+
+    def dfs_close(self, fd: int) -> None:
+        self._dfs_req({"op": "dfs_close", "fd": fd})
+
     def close(self) -> None:
         try:
             self._sock.close()
@@ -471,10 +552,18 @@ class KVProxy:
             except RuntimeError as e:  # job abort rides the reply
                 return {"abort": str(e)}
 
+    def _dfs_upstream(self, msg: dict) -> dict:
+        with self.up._lock:
+            _send_msg(self.up._sock, msg)
+            resp = _recv_msg(self.up._sock)
+        return resp or {"error": "upstream gone"}
+
     def _serve(self, conn: socket.socket) -> None:
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         if not _require_hello(conn, self.secret):
             return
+        dfs_fds: Dict[int, int] = {}
+        dfs_owner: Dict[int, str] = {}  # forwarded fd -> remote host
         try:
             while True:
                 msg = _recv_msg(conn)
@@ -483,6 +572,36 @@ class KVProxy:
                 op = msg.get("op")
                 if op == "hello":
                     _send_msg(conn, {"ok": True})
+                elif op.startswith("dfs_"):
+                    # client-visible REMOTE fds are offset by _DFS_REMOTE
+                    # so they live in a namespace disjoint from this
+                    # node's os fds (a collision would silently route
+                    # local reads to the wrong remote file)
+                    fd_in = int(msg.get("fd", -1))
+                    if op == "dfs_open":
+                        host = dfs_parse_uri(msg.get("uri", ""))[0]
+                        local = host in (
+                            "", "localhost",
+                            os.environ.get("TPUMPI_NODE_NAME", ""))
+                        if local:
+                            _send_msg(conn,
+                                      _dfs_serve(op, msg, dfs_fds))
+                        else:
+                            resp = self._dfs_upstream(msg)
+                            if "fd" in resp:
+                                up = int(resp["fd"])
+                                dfs_owner[_DFS_REMOTE + up] = up
+                                resp["fd"] = _DFS_REMOTE + up
+                            _send_msg(conn, resp)
+                    elif fd_in in dfs_owner:
+                        fwd = dict(msg)
+                        fwd["fd"] = dfs_owner[fd_in]
+                        resp = self._dfs_upstream(fwd)
+                        if op == "dfs_close":
+                            dfs_owner.pop(fd_in, None)
+                        _send_msg(conn, resp)
+                    else:
+                        _send_msg(conn, _dfs_serve(op, msg, dfs_fds))
                 elif op == "put":
                     self.up.put(msg["key"], msg["value"])
                     _send_msg(conn, {"ok": True})
@@ -525,6 +644,17 @@ class KVProxy:
                     _send_msg(conn, resp or {"error": "upstream gone"})
         except OSError:
             return
+        finally:
+            for fd in dfs_fds.values():
+                try:
+                    os.close(fd)
+                except OSError:
+                    pass
+            for cfd, up in dfs_owner.items():
+                try:
+                    self._dfs_upstream({"op": "dfs_close", "fd": up})
+                except Exception:
+                    pass
 
     def _fence(self, conn: socket.socket, msg: dict) -> None:
         fid = msg["id"]

@@ -35,12 +35,12 @@ import numpy as np
 
 from ompi_tpu.mca.params import registry
 from ompi_tpu.op.op import (BAND, BOR, BXOR, MAX, MIN, PROD, SUM)
+from ompi_tpu.shmem import memheap as memheap_mod
+from ompi_tpu.shmem import scoll as scoll_mod
 
 _heap_var = registry.register(
     "shmem", "memheap", "size", 1 << 22, int,
     help="Symmetric heap size in bytes (memheap analog)")
-
-_ALIGN = 64
 
 
 class SymArray:
@@ -81,43 +81,27 @@ class ShmemCtx:
         self.win = oscmod.Window(self.comm, self.heap, disp_unit=1,
                                  name="shmem-heap")
         self.win.lock_all()  # passive epoch for the life of the ctx
-        # deterministic first-fit free list: [(offset, size)] of holes
-        self._holes: List[Tuple[int, int]] = [(0, self.heap_size)]
-        self._live: Dict[int, int] = {}  # offset -> size
+        # MCA-selected components: the memheap allocator (buddy by
+        # default, ref oshmem/mca/memheap/buddy) and the scoll module
+        # (scoll/mpi: PE collectives ride the comm's coll stack)
+        self.memheap = memheap_mod.select(self.heap_size)
+        self.scoll = scoll_mod.select(self)
         self._finalized = False
 
     # -- memheap allocator (ref: oshmem/mca/memheap) --------------------
     def malloc(self, shape, dtype=np.uint8) -> SymArray:
         shape = (shape,) if isinstance(shape, int) else tuple(shape)
         nbytes = int(np.prod(shape)) * np.dtype(dtype).itemsize
-        # zero-size allocations still get a distinct slot, else they
-        # alias the next malloc and free() releases live memory
-        want = max((nbytes + _ALIGN - 1) // _ALIGN * _ALIGN, _ALIGN)
-        for i, (off, size) in enumerate(self._holes):
-            if size >= want:
-                self._holes[i] = (off + want, size - want)
-                if self._holes[i][1] == 0:
-                    del self._holes[i]
-                self._live[off] = want
-                return SymArray(self, off, shape, dtype)
-        raise MemoryError(
-            f"symmetric heap exhausted ({nbytes} wanted; raise "
-            f"--mca shmem_memheap_size)")
+        try:
+            off = self.memheap.malloc(nbytes)
+        except MemoryError:
+            raise MemoryError(
+                f"symmetric heap exhausted ({nbytes} wanted; raise "
+                f"--mca shmem_memheap_size)") from None
+        return SymArray(self, off, shape, dtype)
 
     def free(self, arr: SymArray) -> None:
-        size = self._live.pop(arr.offset, None)
-        if size is None:
-            return
-        self._holes.append((arr.offset, size))
-        self._holes.sort()
-        # coalesce adjacent holes
-        merged: List[Tuple[int, int]] = []
-        for off, sz in self._holes:
-            if merged and merged[-1][0] + merged[-1][1] == off:
-                merged[-1] = (merged[-1][0], merged[-1][1] + sz)
-            else:
-                merged.append((off, sz))
-        self._holes = merged
+        self.memheap.free(arr.offset)
 
     # -- spml data plane (ref: oshmem/mca/spml) -------------------------
     @staticmethod
@@ -224,25 +208,19 @@ class ShmemCtx:
                 raise TimeoutError(
                     f"shmem_wait_until({cmp}, {value}) timed out")
 
-    # -- scoll (ref: oshmem/mca/scoll — reuses the comm coll stack) -----
+    # -- scoll (oshmem/mca/scoll framework; see shmem/scoll.py) ---------
     def broadcast(self, dest: SymArray, src: SymArray, root: int) -> None:
-        buf = src.local.copy() if self.comm.rank == root \
-            else np.empty(src.shape, dtype=src.dtype)
-        self.comm.Bcast(buf, root=root)
-        dest.local[...] = buf
+        self.scoll.broadcast(dest, src, root)
 
     def collect(self, dest: SymArray, src: SymArray) -> None:
         """fcollect: concatenation of every PE's src block."""
-        self.comm.Allgather(np.ascontiguousarray(src.local.reshape(-1)),
-                            dest.local.reshape(-1))
+        self.scoll.collect(dest, src)
 
     def alltoall(self, dest: SymArray, src: SymArray) -> None:
-        self.comm.Alltoall(np.ascontiguousarray(src.local.reshape(-1)),
-                           dest.local.reshape(-1))
+        self.scoll.alltoall(dest, src)
 
     def _to_all(self, dest: SymArray, src: SymArray, op) -> None:
-        self.comm.Allreduce(np.ascontiguousarray(src.local.reshape(-1)),
-                            dest.local.reshape(-1), op)
+        self.scoll.to_all(dest, src, op)
 
     def sum_to_all(self, dest, src):
         self._to_all(dest, src, SUM)

@@ -1,0 +1,84 @@
+"""scoll: the SHMEM collectives framework.
+
+Re-design of oshmem/mca/scoll (ref: oshmem/mca/scoll/basic — PE
+collectives as their own component family; scoll/mpi delegates to
+the MPI coll stack).  Here the `mpi` component is the default and
+the point: the per-communicator coll stack already holds the best
+available path for this topology (coll/sm object rendezvous for
+thread ranks, coll/seg shared segments for same-node processes,
+coll/tpu on devices, tuned p2p otherwise), so SHMEM collectives
+inherit every one of those wins by riding ``comm.coll`` — the
+scoll-over-coll reuse the architecture promises."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ompi_tpu.mca.base import Component, frameworks
+from ompi_tpu.op.op import BAND, BOR, BXOR, MAX, MIN, PROD, SUM
+
+scoll_framework = frameworks.create("shmem", "scoll")
+
+
+class MpiScollModule:
+    """PE collectives delegated to the context comm's merged coll
+    vtable (scoll/mpi analog)."""
+
+    name = "mpi"
+
+    def __init__(self, ctx) -> None:
+        self.ctx = ctx
+
+    def barrier_all(self) -> None:
+        self.ctx.comm.Barrier()
+
+    def broadcast(self, dest, src, root: int) -> None:
+        comm = self.ctx.comm
+        buf = src.local.copy() if comm.rank == root \
+            else np.empty(src.shape, dtype=src.dtype)
+        comm.Bcast(buf, root=root)
+        dest.local[...] = buf
+
+    def collect(self, dest, src) -> None:
+        """fcollect: concatenation of every PE's src block."""
+        self.ctx.comm.Allgather(
+            np.ascontiguousarray(src.local.reshape(-1)),
+            dest.local.reshape(-1))
+
+    def alltoall(self, dest, src) -> None:
+        self.ctx.comm.Alltoall(
+            np.ascontiguousarray(src.local.reshape(-1)),
+            dest.local.reshape(-1))
+
+    def to_all(self, dest, src, op) -> None:
+        self.ctx.comm.Allreduce(
+            np.ascontiguousarray(src.local.reshape(-1)),
+            dest.local.reshape(-1), op)
+
+
+class MpiScollComponent(Component):
+    name = "mpi"
+    priority = 50
+
+    def query(self, ctx=None):
+        if ctx is None:
+            return (self.priority, None)
+        return (self.priority, MpiScollModule(ctx))
+
+
+scoll_framework.add_component(MpiScollComponent())
+
+OPS = {"sum": SUM, "max": MAX, "min": MIN, "prod": PROD,
+       "and": BAND, "or": BOR, "xor": BXOR}
+
+
+def select(ctx) -> MpiScollModule:
+    best = None
+    for comp in scoll_framework.components():
+        got = comp.query(ctx)
+        if got and got[1] is not None and (
+                best is None or got[0] > best[0]):
+            best = got
+    if best is None:
+        raise RuntimeError("no scoll component available")
+    return best[1]

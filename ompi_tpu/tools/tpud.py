@@ -143,6 +143,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         env_base.update(msg.get("env") or {})
         env_base["TPUMPI_SESSION_DIR"] = session
         env_base["TPUMPI_NODE"] = str(opts.node)
+        # node NAME identity: dfs uri host matching (ranks and the
+        # proxy both resolve file://<this-name>/... locally)
+        env_base["TPUMPI_NODE_NAME"] = opts.name
+        os.environ["TPUMPI_NODE_NAME"] = opts.name
         env_base.setdefault("TPUMPI_MCA_btl_tcp_if_ip", if_ip)
         # KV aggregation proxy (grpcomm analog): local ranks talk to
         # this daemon, the central server sees ONE connection per node

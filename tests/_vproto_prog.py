@@ -1,0 +1,43 @@
+"""Uncoordinated checkpoint e2e (vprotocol/pessimist): rank 0 SENDS
+then checkpoints immediately — no quiesce, the message may still be
+in flight; rank 1 checkpoints BEFORE receiving.  A crash after the
+snapshots and a restart must replay the in-flight message from rank
+0's sender log so rank 1's receive completes correctly."""
+import os
+
+import numpy as np
+
+import ompi_tpu
+from ompi_tpu import cr
+from ompi_tpu.op import op as mpi_op
+
+crash = os.environ.get("VPROTO_CRASH") == "1"
+comm = ompi_tpu.init()
+
+state = cr.restore_local(comm)
+if state is None:
+    state = {"phase": 0}
+    # warm the channel with one exchanged value
+    x = np.full(4, comm.rank + 1.0)
+    r = np.empty(4)
+    comm.Allreduce(x, r, mpi_op.SUM)
+    if comm.rank == 0:
+        comm.Send(np.arange(8.0), dest=1, tag=11)
+        # send IN FLIGHT: snapshot without quiesce or drain
+        state["phase"] = 1
+        cr.checkpoint_local(comm, state)
+    else:
+        state["phase"] = 1
+        cr.checkpoint_local(comm, state)  # BEFORE receiving tag 11
+    if crash and comm.rank == 1:
+        os._exit(17)
+
+if state["phase"] == 1:
+    if comm.rank == 1:
+        got = np.empty(8)
+        comm.Recv(got, source=0, tag=11)
+        assert (got == np.arange(8.0)).all(), got
+    comm.Barrier()
+    if comm.rank == 0:
+        print("vproto ok", flush=True)
+ompi_tpu.finalize()

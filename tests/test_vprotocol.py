@@ -1,0 +1,147 @@
+"""pml/vprotocol pessimist: sender-based message logging for
+uncoordinated checkpoints (ref: ompi/mca/vprotocol/pessimist;
+VERDICT r3 missing #2)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from ompi_tpu.mca.params import registry
+from ompi_tpu.testing import mpirun_run, run_ranks
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def pessimist():
+    registry.set("pml_vprotocol", "pessimist")
+    yield
+    registry.set("pml_vprotocol", "")
+
+
+def test_log_and_replay_redelivers_in_flight(pessimist):
+    """The core protocol, in-process: a message consumed into the
+    unexpected queue at the cut is dropped from the snapshot and
+    exactly redelivered by the sender-log replay."""
+    from ompi_tpu.pml.vprotocol import find
+
+    def fn(comm):
+        v = find(comm.state.pml)
+        assert v is not None
+        base = v._base
+        if comm.rank == 0:
+            comm.Send(np.arange(4, dtype=np.float64), dest=1, tag=7)
+            comm.Barrier()
+            # peer simulated restart: replay everything logged
+            comm.Barrier()
+            v.replay()
+            comm.Barrier()
+            return True
+        # rank 1: let the message land in the unexpected queue
+        while not base._unexpected.get(comm.cid):
+            comm.state.progress.progress()
+        comm.Barrier()
+        want = base.cr_capture_lenient()
+        assert len(want) >= 1
+        # simulate the restart cut: drop the unconsumed message,
+        # keep the counters, arm replay_want
+        vlog = v.cr_capture_vlog()
+        base._unexpected[comm.cid].clear()
+        v.cr_restore_vlog(vlog)
+        base._replay_want = {tuple(w) for w in want}
+        comm.Barrier()  # sender replays now
+        got = np.empty(4)
+        comm.Recv(got, source=0, tag=7)
+        assert (got == np.arange(4.0)).all(), got
+        assert not base._replay_want
+        comm.Barrier()
+        return True
+
+    assert all(run_ranks(2, fn))
+
+
+def test_duplicate_replay_is_dropped(pessimist):
+    """Replaying the whole log twice must deliver once: consumed
+    sequence numbers are dropped, not re-matched."""
+    from ompi_tpu.pml.vprotocol import find
+
+    def fn(comm):
+        v = find(comm.state.pml)
+        if comm.rank == 0:
+            comm.Send(np.full(2, 5.0), dest=1, tag=3)
+            comm.Barrier()
+            v.replay()   # gratuitous full replay
+            v.replay()
+            comm.Barrier()
+        else:
+            got = np.empty(2)
+            comm.Recv(got, source=0, tag=3)
+            comm.Barrier()
+            comm.Barrier()
+            # the replays must not create matchable duplicates
+            comm.state.progress.progress()
+            from ompi_tpu.pml.request import ANY_TAG
+            assert comm.Iprobe(source=0, tag=3) in (False, None), \
+                "duplicate redelivery"
+        return True
+
+    assert all(run_ranks(2, fn))
+
+
+def test_coordinated_checkpoint_gc_clears_log(pessimist, tmp_path):
+    from ompi_tpu import cr
+    from ompi_tpu.pml.vprotocol import find
+
+    def fn(comm):
+        v = find(comm.state.pml)
+        x = np.full(4, comm.rank + 1.0)
+        r = np.empty(4)
+        from ompi_tpu.op import op as mpi_op
+        comm.Allreduce(x, r, mpi_op.SUM)
+        assert v.log_bytes >= 0
+        cr.checkpoint(comm, {"x": 1}, store_dir=str(tmp_path))
+        assert v.log_bytes == 0 and not v.log
+        return True
+
+    assert all(run_ranks(2, fn))
+
+
+def test_uncoordinated_checkpoint_restart_e2e(tmp_path):
+    """mpirun e2e: snapshot with a message IN FLIGHT (no quiesce),
+    crash, restart — the sender log replays it and the job completes
+    (the capability the r3 C/R stack lacked: every checkpoint needed
+    a global drain)."""
+    prog = os.path.join(REPO, "tests", "_vproto_prog.py")
+    store = str(tmp_path / "store")
+    mca = (("pml_vprotocol", "pessimist"),)
+
+    r1 = mpirun_run(2, prog, mca=mca,
+                    extra=("--ckpt-dir", store),
+                    timeout=200, job_timeout=150)
+    # rank 1 died after its snapshot
+    import subprocess
+    env = {**os.environ, "VPROTO_CRASH": "1"}
+    del r1
+    import sys
+    r1 = subprocess.run(
+        [sys.executable, "-m", "ompi_tpu.tools.mpirun", "-np", "2",
+         "--timeout", "150", "--ckpt-dir", store,
+         "--mca", "pml_vprotocol", "pessimist", prog],
+        capture_output=True, timeout=200,
+        env={**env, "PYTHONPATH": REPO + os.pathsep
+             + env.get("PYTHONPATH", ""), "JAX_PLATFORMS": "cpu"},
+        cwd=REPO)
+    assert r1.returncode != 0  # crashed as scripted
+
+    r2 = subprocess.run(
+        [sys.executable, "-m", "ompi_tpu.tools.mpirun", "-np", "2",
+         "--timeout", "150", "--restart", store,
+         "--mca", "pml_vprotocol", "pessimist", prog],
+        capture_output=True, timeout=200,
+        env={**os.environ, "PYTHONPATH": REPO + os.pathsep
+             + os.environ.get("PYTHONPATH", ""),
+             "JAX_PLATFORMS": "cpu"},
+        cwd=REPO)
+    assert r2.returncode == 0, r2.stderr.decode()[-2000:]
+    assert b"vproto ok" in r2.stdout
