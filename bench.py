@@ -111,8 +111,8 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
                     help="Tiny sizes for development runs")
-    ap.add_argument("--dev-budget", type=float, default=240.0)
-    ap.add_argument("--sw-budget", type=float, default=150.0)
+    ap.add_argument("--dev-budget", type=float, default=480.0)
+    ap.add_argument("--sw-budget", type=float, default=300.0)
     opts = ap.parse_args()
 
     if opts.quick:

@@ -244,20 +244,25 @@ def run_device_sweep(nranks: int, max_ar: int, max_bcast: int,
             comm.Barrier()
             t = _forced_time(comm, x0, make_op, chain, read_token,
                              read_const, deadline)
-            # outlier guard: a single scheduler hiccup on a shared
-            # host can blow one point by 10-50x (observed: 69 ms
-            # between 1.4 ms neighbors).  If this point is >5x the
-            # previous size's time — physically times should GROW
-            # smoothly — re-measure once and keep the minimum (both
-            # measurements are full forced-completion runs, so the
-            # min is still an honest upper bound on the op time).
-            prev = out[kind].get(getattr(one, "_prev_key", None))
-            if (t > 0 and prev and t * 1e6 > 5 * prev
-                    and should_continue(comm, deadline)):
+            # min-of-2: tunnel RPC jitter on a shared bench host can
+            # inflate a single measurement 2-3x (observed: 9.6 ms vs
+            # a 2.2 ms repeat at 4 B); every point gets one repeat and
+            # keeps the minimum — both runs are full forced-completion
+            # measurements, so the min is still an honest upper bound
+            # on the op time.  A >5x-the-neighbor outlier earns a
+            # third attempt.
+            if t > 0 and should_continue(comm, deadline):
                 t2 = _forced_time(comm, x0, make_op, chain, read_token,
                                   read_const, deadline)
                 if t2 > 0:
                     t = min(t, t2)
+            prev = out[kind].get(getattr(one, "_prev_key", None))
+            if (t > 0 and prev and t * 1e6 > 5 * prev
+                    and should_continue(comm, deadline)):
+                t3 = _forced_time(comm, x0, make_op, chain, read_token,
+                                  read_const, deadline)
+                if t3 > 0:
+                    t = min(t, t3)
             one._prev_key = size_key
             # -1 = deadline hit before the point could be amortized
             # past the read-constant jitter: unmeasurable, not a number

@@ -240,6 +240,7 @@ extern "C" int tpumpi_seg_coll(
     int32_t kind, int32_t root, const uint8_t* in, uint8_t* out,
     int64_t nbytes, int32_t dt, int32_t op, int64_t park_us) {
     if (!supported(kind, op, dt)) return -1;
+    if (nbytes > slot) return -1;  // never overflow a slot (caller bug)
     Seg seg(base, P, slot);
     const int64_t b = gen & 1;
     const long park_ns = park_us * 1000L;

@@ -15,10 +15,11 @@ PROG = os.path.join(REPO, "tests", "_collseg_prog.py")
 
 
 def _run(np_, *args, mca=()):
-    r = mpirun_run(np_, PROG, *args, mca=mca, timeout=180,
-                   job_timeout=150)
+    r = mpirun_run(np_, PROG, *args, mca=mca, timeout=240,
+                   job_timeout=200)
     assert r.returncode == 0, r.stderr.decode()[-2000:]
     assert b"collseg ok" in r.stdout
+    assert b"collseg chunked ok" in r.stdout
     return r
 
 
