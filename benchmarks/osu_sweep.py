@@ -74,10 +74,10 @@ def _timeit(comm, fn, dt_probe: float) -> float:
     return float(worst[0])
 
 
-def bench_allreduce(comm, max_bytes: int) -> dict:
+def bench_allreduce(comm, max_bytes: int, start: int = 4) -> dict:
     out = {}
     last = 0.0
-    for nbytes in sizes_upto(max_bytes):
+    for nbytes in sizes_upto(max_bytes, start=start):
         if not _should_continue(comm, last):
             out["truncated"] = True
             return out
@@ -177,6 +177,9 @@ def main() -> None:
     ap.add_argument("--max-bcast", type=int, default=64 * 1024 * 1024)
     ap.add_argument("--max-a2a", type=int, default=4 * 1024 * 1024)
     ap.add_argument("--max-rsb", type=int, default=16 * 1024 * 1024)
+    ap.add_argument("--start", type=int, default=4,
+                    help="Smallest allreduce size (the tuned-tcp "
+                         "north-star config skips the sub-4KiB tail)")
     ap.add_argument("--budget", type=float, default=0.0,
                     help="Soft wall-clock budget in seconds; later "
                          "sizes are dropped (and marked truncated) "
@@ -188,7 +191,8 @@ def main() -> None:
     comm = ompi_tpu.init()
     results = {}
     if opts.max_ar:
-        results["allreduce"] = bench_allreduce(comm, opts.max_ar)
+        results["allreduce"] = bench_allreduce(comm, opts.max_ar,
+                                               opts.start)
     if opts.max_bcast:
         results["bcast"] = bench_bcast(comm, opts.max_bcast)
     if opts.max_a2a:

@@ -70,17 +70,25 @@ class Endpoint:
     had not fully written are resent by the transport's own
     reconnect (btl/tcp); frames lost inside dead kernel buffers are
     NOT recovered (that needs btl-level acks — the pml/bfo protocol)
-    and fail stop at the receiver.  No frag-level striping: frags
-    are sized against the active rail's eager/max-send limits, so
-    routing them over a different rail would violate its protocol
-    (and no two current transports share an exclusivity tier)."""
+    and fail stop at the receiver.
 
-    __slots__ = ("peer", "btls", "active")
+    Striping (bml/r2 multi-rail): ordered traffic (envelopes, acks)
+    rides the active rail only — per-(cid,src) sequencing requires
+    one FIFO stream.  POSITION-ADDRESSED rendezvous segments
+    (``send_striped``) round-robin across every rail sharing the
+    active rail's exclusivity tier (same component, same protocol
+    limits): arrival order across rails is irrelevant because the
+    receiver accounts coverage as intervals.  A stripe rail that
+    throws falls back to the ordered path's failover."""
+
+    __slots__ = ("peer", "btls", "active", "_rr", "_dead_rails")
 
     def __init__(self, peer: int, btls: List[BTLModule]) -> None:
         self.peer = peer
         self.btls = btls
         self.active = 0
+        self._rr = 0
+        self._dead_rails: set = set()
 
     @property
     def btl(self) -> BTLModule:
@@ -103,6 +111,32 @@ class Endpoint:
             except BtlError:
                 if not self.failover():
                     raise
+
+    def stripe_set(self) -> List[BTLModule]:
+        """Rails eligible for position-addressed striping: the active
+        rail plus every later same-tier rail that has not failed (a
+        dead rail is evicted for good — without eviction every
+        len(rails)-th segment would re-dial it, stalling up to the
+        connect timeout each time)."""
+        tier = self.btls[self.active].exclusivity
+        return [m for m in self.btls[self.active:]
+                if m.exclusivity == tier
+                and id(m) not in self._dead_rails]
+
+    def send_striped(self, frag) -> None:
+        """Round-robin a position-addressed segment across the active
+        tier's rails; a failing rail is evicted and the segment
+        retries on the ordered path (which fails over)."""
+        rails = self.stripe_set()
+        if len(rails) <= 1:
+            return self.send(frag)
+        self._rr = (self._rr + 1) % len(rails)
+        rail = rails[self._rr]
+        try:
+            rail.send(self.peer, frag)
+        except BtlError:
+            self._dead_rails.add(id(rail))
+            self.send(frag)
 
 
 def wire_endpoints(state, modules: List[BTLModule]) -> List[Optional[Endpoint]]:

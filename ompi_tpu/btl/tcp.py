@@ -65,15 +65,29 @@ class _Conn:
         self.dead = False
 
 
+_rails_var = registry.register(
+    "btl", "tcp", "rails", 1, int,
+    help="Parallel tcp rails per peer (multi-rail striping, the "
+         "bml/r2 multi-btl analog): rail 0 carries ordered envelope "
+         "traffic, rendezvous FRAG segments round-robin across all "
+         "rails (position-addressed, order-free).  On multi-NIC "
+         "hosts combine with btl_tcp_advertise_all; on one NIC "
+         "extra rails still parallelize kernel socket work")
+
+
 class TcpModule(BTLModule):
     name = "tcp"
     exclusivity = 10
 
-    def __init__(self, state) -> None:
+    def __init__(self, state, rail: int = 0) -> None:
         self.state = state
+        self.rail = rail
+        self._sfx = "" if rail == 0 else f"_r{rail}"
         self.eager_limit = _eager_var.value
         self.max_send_size = _max_send_var.value
         self.rank = state.rank
+        self.pvar_frags = registry.register_pvar(
+            "btl", "tcp", f"rail{rail}_frags_r{state.rank}")
         self.sel = selectors.DefaultSelector()
         if_ip = _if_ip_var.value or "127.0.0.1"
         advertise_all = _advertise_all_var.value
@@ -92,7 +106,8 @@ class TcpModule(BTLModule):
         self.sel.register(self.listener, selectors.EVENT_READ,
                           ("accept", None))
         port = self.listener.getsockname()[1]
-        state.rte.modex_put("btl_tcp_addr", f"{if_ip}:{port}")
+        state.rte.modex_put(f"btl_tcp_addr{self._sfx}",
+                            f"{if_ip}:{port}")
         # multi-NIC: advertise every usable address (reachable analog,
         # ref: opal/mca/reachable/weighted); the dialing side scores
         # each against its own NICs and picks the best pair.  Always
@@ -104,7 +119,7 @@ class TcpModule(BTLModule):
                                if a != if_ip]
         else:
             addrs = [if_ip]
-        state.rte.modex_put("btl_tcp_addrs",
+        state.rte.modex_put(f"btl_tcp_addrs{self._sfx}",
                             [f"{a}:{port}" for a in addrs])
         self._out: Dict[int, _Conn] = {}
         self._in: List[_Conn] = []
@@ -121,12 +136,14 @@ class TcpModule(BTLModule):
         conn = self._out.get(peer)
         if conn is not None:
             return conn
-        addr = self.state.rte.modex_get(peer, "btl_tcp_addr")
+        addr = self.state.rte.modex_get(
+            peer, f"btl_tcp_addr{self._sfx}")
         try:
             # multi-NIC peers advertise every address; score each
             # against our NICs and dial the best pair (reachable
             # analog).  Single-addr peers skip the lookup.
-            addrs = self.state.rte.modex_get(peer, "btl_tcp_addrs")
+            addrs = self.state.rte.modex_get(
+                peer, f"btl_tcp_addrs{self._sfx}")
         except Exception:
             addrs = None
         if addrs and len(addrs) > 1:
@@ -171,7 +188,8 @@ class TcpModule(BTLModule):
             conn.sock.close()
         except OSError:
             pass
-        addr = self.state.rte.modex_get(conn.peer, "btl_tcp_addr")
+        addr = self.state.rte.modex_get(
+            conn.peer, f"btl_tcp_addr{self._sfx}")
         host, port = addr.rsplit(":", 1)
         try:
             s = socket.create_connection((host, int(port)), timeout=10)
@@ -207,6 +225,7 @@ class TcpModule(BTLModule):
             # endpoint failover consumed this transport for the peer
             del self._out[peer]
             raise BtlError(f"tcp transport to rank {peer} is dead")
+        self.pvar_frags.add(1)
         hdr, payload = wire.encode(frag)
         plen = 0 if payload is None else len(payload)
         # txq holds WHOLE FRAMES (a list of buffers each): retirement
@@ -382,7 +401,8 @@ class TcpComponent(BTLComponent):
     def init_modules(self, state) -> List[BTLModule]:
         if not hasattr(state.rte, "kv") or state.size == 1:
             return []
-        return [TcpModule(state)]
+        rails = max(1, _rails_var.value)
+        return [TcpModule(state, rail=r) for r in range(rails)]
 
 
 btl_framework.add_component(TcpComponent())

@@ -582,8 +582,8 @@ class SegCollModule(TunedModule):
                     piece[:] = self._slot_of(seg, root, b, nb,
                                              piece.dtype)
                 seg.flag_done(comm.rank, g)
-            if comm.rank != root:
-                buf[lo:hi] = piece
+            # piece is a VIEW of contiguous buf: non-root receives
+            # landed in place already
         return True
 
     # -- collectives -----------------------------------------------------

@@ -532,7 +532,9 @@ class PmlOb1:
         while not conv.done:
             pos = conv.position
             payload = conv.pack_bytes(btl.max_send_size)
-            ep.send((FRAG, rreq_id, pos, payload))
+            # position-addressed: stripes across same-tier rails
+            # (receiver coverage is interval-based, order-free)
+            ep.send_striped((FRAG, rreq_id, pos, payload))
         if memchecker.enabled():
             memchecker.verify_send(
                 conv, getattr(req, "mc_crc", None),

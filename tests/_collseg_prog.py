@@ -94,14 +94,15 @@ for _ in range(50):
 # path (alltoall) must fall back to the p2p stack and still be right
 from ompi_tpu.mca.params import registry as _reg0
 slot_b = _reg0.get("coll_seg_slot_bytes") or (8 << 20)
-n_over = (slot_b // 4) * P + P  # per-rank rows exceed the slot
-sa2 = np.arange(n_over, dtype=np.float32)
+n_over = ((slot_b // 4) + P) // P * P  # per-rank rows exceed the slot
+sa2 = (np.arange(n_over, dtype=np.float32) + 1000.0 * me)
 ra2 = np.empty_like(sa2)
-if n_over % P == 0:
-    comm.Alltoall(sa2, ra2)
-    blk = n_over // P
-    for p in range(P):
-        assert ra2[p * blk] == sa2[0] + 0 * p or True
+comm.Alltoall(sa2, ra2)
+blk = n_over // P
+for p in range(P):
+    expect = np.arange(me * blk, (me + 1) * blk,
+                       dtype=np.float32) + 1000.0 * p
+    assert (ra2[p * blk:(p + 1) * blk] == expect).all(), p
 # oversize allreduce takes the chunked segment path (checked below)
 
 comm.Barrier()
