@@ -99,6 +99,7 @@ def main(argv=None) -> int:
     _import_all_components()
     lines: List[str] = []
     import ompi_tpu
+    from ompi_tpu.runtime import installdirs
     if not args.parsable:
         lines.append(f"ompi_tpu version: {ompi_tpu.__version__}")
         try:
@@ -106,8 +107,12 @@ def main(argv=None) -> int:
             lines.append(f"jax: {jax.__version__}")
         except Exception:
             pass
+        for field, value in sorted(installdirs.all_dirs().items()):
+            lines.append(f"{field}: {value}")
     else:
         lines.append(f"version:{ompi_tpu.__version__}")
+        for field, value in sorted(installdirs.all_dirs().items()):
+            lines.append(f"installdirs:{field}:{value}")
 
     if args.param:
         if not args.parsable:

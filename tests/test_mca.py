@@ -6,6 +6,8 @@ Models the reference's variable-system behavior
 
 import os
 
+import pytest
+
 from ompi_tpu.mca import base as mca_base
 from ompi_tpu.mca import params
 
@@ -120,3 +122,35 @@ def test_schizo_accepts_ompi_mca_env(monkeypatch):
     monkeypatch.delenv("TPUMPI_MCA_test_schizo_knob")
     registry.refresh()
     assert registry.get("test_schizo_knob") == 1
+
+
+def test_installdirs_fields_env_override_and_expand(monkeypatch):
+    """installdirs analog (opal/mca/installdirs): package-derived
+    defaults, TPUMPI_* env overrides, ${field} expansion."""
+    from ompi_tpu.runtime import installdirs
+
+    dirs = installdirs.all_dirs()
+    assert os.path.isdir(dirs["prefix"])
+    assert os.path.isdir(dirs["libdir"])
+    monkeypatch.setenv("TPUMPI_SYSCONFDIR", "/tmp/etc-override")
+    assert installdirs.get("sysconfdir") == "/tmp/etc-override"
+    assert installdirs.expand("${sysconfdir}/x.conf") == \
+        "/tmp/etc-override/x.conf"
+    with pytest.raises(KeyError):
+        installdirs.get("no_such_dir")
+
+
+def test_info_tool_reports_installdirs(capsys):
+    from ompi_tpu.tools import info
+    assert info.main(["--parsable"]) == 0
+    out = capsys.readouterr().out
+    assert "installdirs:prefix:" in out
+
+
+def test_installdirs_override_referencing_other_field(monkeypatch):
+    from ompi_tpu.runtime import installdirs
+
+    monkeypatch.setenv("TPUMPI_DATADIR", "${prefix}/share")
+    got = installdirs.get("datadir")
+    assert "${" not in got
+    assert got == installdirs.get("prefix") + "/share"
