@@ -24,6 +24,7 @@ API (on Communicator): ``send_arr`` / ``recv_arr`` /
 from __future__ import annotations
 
 import itertools
+import time
 from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
@@ -74,6 +75,12 @@ _chunk_var = _mca.register(
     help="Cross-process device-array transfers larger than this are "
          "streamed in chunks of this size (bounded host staging); "
          "smaller ones ride one eager object frag")
+_restore_grace_var = _mca.register(
+    "btl", "tpu", "restore_grace_s", 60.0, float,
+    help="Seconds a snapshot-restored parked transfer waits for its "
+         "receiver's first pull before being garbage-collected (the "
+         "receiver may have completed the pull before the snapshot "
+         "was restored — an uncoordinated-capture race)")
 _depth_var = _mca.register(
     "btl", "tpu", "pipeline_depth", 2, int,
     help="Chunks the receiver pulls ahead (overlaps d2h staging, "
@@ -127,6 +134,7 @@ class TpuRndvEngine:
         self._xfer_ids = itertools.count(1)
         self.pending: Dict[int, tuple] = {}   # id -> (flat, sent, total)
         self._inflight: list = []             # (req, nbytes)
+        self._restored: Dict[int, float] = {}  # xid -> restore stamp
         self.staged_bytes = 0
         self.max_staged_bytes = 0
         state.progress.register(self.progress, low_priority=True)
@@ -154,17 +162,22 @@ class TpuRndvEngine:
         self._inflight = alive
         return n
 
-    def cr_capture(self) -> list:
+    def cr_capture(self, lenient: bool = False) -> list:
         """Snapshot parked (not-yet-pulled) transfers: the data half
         of any _XferHdr a peer's cr_capture snapshots.  A partially
-        pulled transfer cannot exist at a quiesce point — the puller
-        would still be inside recv_arr, which no rank can be during a
-        collective checkpoint — so anything else is a protocol bug
-        worth a loud failure."""
+        pulled transfer cannot exist at a QUIESCED checkpoint — the
+        puller would still be inside recv_arr, which no rank can be
+        during a collective checkpoint — so there it is a protocol bug
+        worth a loud failure.  The UNCOORDINATED path (``lenient``)
+        has no quiesce: a peer legitimately mid-recv_arr is snapshot
+        with its FULL parked array and a reset cursor — a restarted
+        receiver re-pulls from chunk 0 (its pull state restarts with
+        it), and a live capture never disturbs the in-progress pull
+        (the snapshot is a copy)."""
         out = []
         for xid, (flat, sent, nchunks, per) in sorted(
                 self.pending.items()):
-            if sent:
+            if sent and not lenient:
                 raise RuntimeError(
                     "cr_capture with a partially pulled device "
                     "transfer (receiver mid-recv_arr at quiesce?)")
@@ -173,9 +186,17 @@ class TpuRndvEngine:
 
     def cr_restore(self, entries: list) -> None:
         top = 0
+        now = time.monotonic()
         for xid, arr, nchunks, per in entries:
             self.pending[xid] = [np.asarray(arr).reshape(-1), 0,
                                  nchunks, per]
+            # a snapshot may predate the receiver FINISHING its pull
+            # (uncoordinated capture): a restored entry no peer ever
+            # claims would otherwise hold its host-staged array
+            # forever.  Stamp it; progress GCs unclaimed restored
+            # entries after restore_grace_s (a live restart's re-pull
+            # arrives within the fence+replay, i.e. seconds).
+            self._restored[xid] = now
             top = max(top, xid)
         if top:
             self._xfer_ids = itertools.count(top + 1)
@@ -183,6 +204,15 @@ class TpuRndvEngine:
     def progress(self) -> int:
         pml = self.state.pml
         n = self._reap()
+        if self._restored:
+            now = time.monotonic()
+            for xid in [x for x, t in self._restored.items()
+                        if now - t > _restore_grace_var.value]:
+                del self._restored[xid]
+                self.pending.pop(xid, None)  # unclaimed: receiver had
+                #                              already completed its
+                #                              pull before the snapshot
+                #                              was restored
         while True:
             msg = pml.poll_obj_any(T_PULL)
             if msg is None:
@@ -190,6 +220,7 @@ class TpuRndvEngine:
             n += 1
             pull: _XferPull = msg.payload
             entry = self.pending.get(pull.xfer_id)
+            self._restored.pop(pull.xfer_id, None)  # claimed: live
             if entry is None:
                 continue  # duplicate/late pull
             flat, _, nchunks, per = entry

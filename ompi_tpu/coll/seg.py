@@ -115,6 +115,13 @@ class _Futex:
     WAKE = 1
 
     def __init__(self) -> None:
+        import platform
+        if platform.machine() != "x86_64":
+            # SYS_futex is 202 only on x86_64; on another arch the
+            # number is a DIFFERENT syscall which may "succeed" and
+            # make wait() a no-op hot spin.  Degrade to sleep-poll.
+            self.ok = False
+            return
         try:
             self._libc = ctypes.CDLL(None, use_errno=True)
             self._syscall = self._libc.syscall
@@ -267,7 +274,13 @@ def _nat_codes(kind: int, op: Optional[Op], dtype) -> Optional[tuple]:
     op, dtype) so every rank picks the same eligibility — though the
     protocol tolerates mixed paths anyway.  Cached: this sits on the
     per-op hot path."""
-    key = (kind in _REDUCTIONS, id(op), str(dtype))
+    # keyed on op.name, not id(op): ids are recycled after gc, and a
+    # stale hit would silently run the WRONG reduction in C.  The
+    # verdict depends only on (name, dtype) anyway — user op names
+    # are monotonic MPI_USER_<n>, never a reused identity.
+    key = (kind in _REDUCTIONS,
+           getattr(op, "name", None) if op is not None else None,
+           str(dtype))
     hit = _nat_cache.get(key, _nat_cache)
     if hit is not _nat_cache:
         return hit
@@ -380,13 +393,18 @@ class SegCollModule(TunedModule):
                 continue
             t0 = time.monotonic()
             _futex.wait(addr_fn(i), cur, park)
-            if vals32[i] < g and time.monotonic() - t0 >= park / 2:
+            now = time.monotonic()
+            if vals32[i] < g and now - t0 >= park / 2:
                 # timed out, not event-woken: background service
                 progress.progress()
-                if time.monotonic() > deadline:
-                    raise RuntimeError(
-                        f"coll/seg stalled >{_timeout_var.value}s "
-                        f"({what}; peer dead or diverged?)")
+            # stall check OUTSIDE the timed-out branch: a wait() that
+            # returns instantly without progress (e.g. a broken futex
+            # probe) must still reach the dead-peer diagnosis instead
+            # of hot-spinning forever
+            if now > deadline and vals32[i] < g:
+                raise RuntimeError(
+                    f"coll/seg stalled >{_timeout_var.value}s "
+                    f"({what}; peer dead or diverged?)")
 
     def _enter(self, comm) -> tuple:
         """Begin op: bump gen, prove nobody still reads this bank."""

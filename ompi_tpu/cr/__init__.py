@@ -346,6 +346,14 @@ def checkpoint_local(comm, payload: Any,
         "replay_want": base.cr_capture_lenient(),
         "rank": comm.rank,
     }
+    eng = getattr(comm.state, "_tpu_rndv", None)
+    if eng is not None and eng.pending:
+        # parked sender halves of chunked device transfers: without
+        # them a replayed _XferHdr's pulls find nothing and the
+        # receiver blocks forever (ADVICE r4).  lenient: no quiesce
+        # here, so a peer mid-pull is normal — capture the full
+        # array; a restarted receiver re-pulls from chunk 0.
+        blob["tpu_xfers"] = eng.cr_capture(lenient=True)
     sub = Store(os.path.join(store.root, f"local_r{comm.rank}"))
     seq = sub.next_seq()
     sub.write_rank(seq, comm.rank, blob)
@@ -372,6 +380,9 @@ def restore_local(comm, store_dir: Optional[str] = None
     blob = sub.read_rank(seq, comm.rank)
     v = _vlayer(comm)
     v.cr_restore_vlog(blob["vlog"])
+    if blob.get("tpu_xfers"):
+        from ompi_tpu.btl.tpu import _engine
+        _engine(comm.state).cr_restore(blob["tpu_xfers"])
     v._base._replay_want = {tuple(w) for w in blob["replay_want"]}
     # every rank's counters restored BEFORE any replay frag can
     # arrive.  The rendezvous must NOT ride the pml: a pml barrier's

@@ -19,9 +19,12 @@ index on ties.
 
 from __future__ import annotations
 
+import itertools
 from typing import Callable, Dict, Optional
 
 import numpy as np
+
+_user_op_ids = itertools.count()
 
 
 class Op:
@@ -136,7 +139,10 @@ def create(user_fn: Callable, commute: bool) -> Op:
         user_fn(a, out, None)
         return out
 
-    op = Op(f"MPI_USER_{id(user_fn):x}", np_fn, None, commute=commute)
+    # monotonic name, never an id(): ids recycle after gc, and op.name
+    # is the stable identity caches key on (coll/seg._nat_codes)
+    op = Op(f"MPI_USER_{next(_user_op_ids)}", np_fn, None,
+            commute=commute)
     op.is_user = True
     return op
 

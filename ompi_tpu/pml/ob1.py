@@ -61,6 +61,11 @@ RNDV = "R"
 ACK = "A"
 SYNC_ACK = "SA"
 FRAG = "F"
+MSEG = "MG"        # segmented MATCH: vprotocol replay of payloads
+#                    larger than one transport frame (a raw MATCH
+#                    bigger than the shm ring can never be pushed;
+#                    ADVICE r4).  Reassembled BEFORE sequencing, then
+#                    dispatched as a normal MATCH / MATCH_OBJ.
 
 
 class SendRequest(Request):
@@ -130,6 +135,9 @@ class PmlOb1:
         self._send_seq: Dict[Tuple[int, int], int] = {}     # (cid,dst)->seq
         self._next_seq: Dict[Tuple[int, int], int] = {}     # (cid,src)->seq
         self._cant_match: Dict[Tuple[int, int], Dict[int, UnexpectedMsg]] = {}
+        # (cid, src, seq, gsrc) -> [bytearray, filled]: in-progress
+        # segmented replay reassembly (MSEG; vprotocol only)
+        self._mseg: Dict[tuple, list] = {}
         # (cid, src, seq) triples an uncoordinated restart expects to
         # be REDELIVERED by vprotocol replay although their sequence
         # slot was consumed pre-snapshot (the message was in the
@@ -383,6 +391,18 @@ class PmlOb1:
     def _advance_seq(self, cid, src) -> None:
         key = (cid, src)
         self._next_seq[key] = self._next_seq.get(key, 0) + 1
+        if self._mseg:
+            # straggler MSEG duplicates may have re-seeded a partial
+            # reassembly for a seq that just got consumed (its full
+            # assembly dispatched from _cant_match); such an entry can
+            # never complete — purge it so cr_capture's in-flight
+            # guard only fires for genuinely undeliverable messages
+            nxt = self._next_seq[key]
+            stale = [k for k in self._mseg
+                     if k[0] == cid and k[1] == src and k[2] < nxt
+                     and (cid, src, k[2]) not in self._replay_want]
+            for k in stale:
+                del self._mseg[k]
         # an out-of-order frag may now be matchable
         held = self._cant_match.get(key)
         if held:
@@ -477,6 +497,54 @@ class PmlOb1:
         elif kind == FRAG:
             _, rreq_id, pos, payload = frag
             self._recv_segment(rreq_id, pos, payload)
+        elif kind == MSEG:
+            self._handle_mseg(frag)
+
+    def _handle_mseg(self, frag: tuple) -> None:
+        """Reassemble a segmented replay MATCH.  Segments are
+        position-addressed (transports may interleave rails); the
+        assembled message enters matching exactly as a single MATCH
+        frame would — including the duplicate-sequence drop for
+        receivers that already consumed it.
+
+        Duplicate segments (a tcp reconnect resends every frame not
+        provably written) must not double-count: coverage is tracked
+        per position, mirroring _recv_segment's discipline.  And a
+        segment for an already-consumed sequence number is dropped
+        BEFORE assembly — after a completed reassembly advanced the
+        sequence, straggler duplicates would otherwise re-seed a
+        stale partial entry that lives forever."""
+        _, cid, src, tag, seq, gsrc, total, kindcode, pos, chunk = frag
+        if seq < self._next_seq.get((cid, src), 0) and \
+                (cid, src, seq) not in self._replay_want:
+            return  # consumed seq: this whole message is a duplicate
+        key = (cid, src, seq, gsrc)
+        entry = self._mseg.get(key)
+        if entry is None:
+            entry = self._mseg[key] = [bytearray(total), 0, set()]
+        buf, got, seen = entry
+        if pos in seen:
+            return  # duplicated segment (transport resend): one replay
+        #           chunks at a fixed stride, so positions identify
+        #           segments exactly
+        seen.add(pos)
+        buf[pos:pos + len(chunk)] = chunk
+        entry[1] = got + len(chunk)
+        if entry[1] < total:
+            return
+        del self._mseg[key]
+        if kindcode == 1:
+            import pickle
+            payload = pickle.loads(bytes(buf))
+            msg = UnexpectedMsg(MATCH_OBJ, cid, src, tag, seq,
+                                len(payload), None, payload)
+        else:
+            payload = bytes(buf)
+            msg = UnexpectedMsg(MATCH, cid, src, tag, seq,
+                                len(payload), None, payload)
+        if tag >= 0:
+            self.cr_arrived[gsrc] = self.cr_arrived.get(gsrc, 0) + 1
+        self._dispatch_arrival(msg)
 
     def _dispatch_arrival(self, msg: UnexpectedMsg) -> None:
         key = (msg.cid, msg.src)
@@ -604,6 +672,11 @@ class PmlOb1:
             raise RuntimeError(
                 "cr_capture with out-of-order frags held (messages "
                 "still in flight)")
+        if self._mseg:
+            raise RuntimeError(
+                "cr_capture with a partially reassembled replay "
+                "message (sender died mid-replay?) — the message is "
+                "neither capturable nor deliverable")
         msgs = []
         for cid, lst in self._unexpected.items():
             for m in sorted(lst, key=lambda u: u.arrival):

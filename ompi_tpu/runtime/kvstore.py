@@ -186,7 +186,7 @@ class KVServer:
                 msg = _recv_msg(conn)
                 if msg is None:
                     return
-                op = msg.get("op")
+                op = msg.get("op") or ""
                 if op == "hello":
                     # secretless server: ack so mixed configs work
                     _send_msg(conn, {"ok": True})
@@ -569,7 +569,7 @@ class KVProxy:
                 msg = _recv_msg(conn)
                 if msg is None:
                     return
-                op = msg.get("op")
+                op = msg.get("op") or ""
                 if op == "hello":
                     _send_msg(conn, {"ok": True})
                 elif op.startswith("dfs_"):

@@ -1,0 +1,32 @@
+"""Replay of a logged payload LARGER than the shm ring (ADVICE r4):
+rank 0 sends 12 MiB (> the 8 MiB default btl_shm_ring_size), then
+gratuitously replays its whole log.  Pre-fix, replay pushed one raw
+MATCH frame and Ring.push raised 'frame can never fit'; now the
+payload rides position-addressed MSEG segments and the receiver
+drops the assembled duplicate (consumed sequence number)."""
+import numpy as np
+
+import ompi_tpu
+from ompi_tpu.pml.vprotocol import find
+
+comm = ompi_tpu.init()
+v = find(comm.state.pml)
+assert v is not None, "launch with --mca pml_vprotocol pessimist"
+N = 12 * 1024 * 1024 // 8
+if comm.rank == 0:
+    comm.Send(np.arange(N, dtype=np.float64), dest=1, tag=5)
+    comm.Barrier()
+    assert v.replay() >= 1
+    comm.Barrier()
+    print("vproto big ok", flush=True)
+else:
+    got = np.empty(N)
+    comm.Recv(got, source=0, tag=5)
+    assert got[0] == 0.0 and got[-1] == N - 1
+    comm.Barrier()   # sender replays now
+    comm.Barrier()   # sender done replaying
+    comm.state.progress.progress()
+    # the assembled duplicate must have been dropped, not re-matched
+    assert comm.Iprobe(source=0, tag=5) in (False, None), \
+        "duplicate redelivery of replayed large message"
+ompi_tpu.finalize()

@@ -89,6 +89,60 @@ def test_duplicate_replay_is_dropped(pessimist):
     assert all(run_ranks(2, fn))
 
 
+def test_replay_segments_large_payloads(pessimist):
+    """A logged payload larger than the btl's eager limit replays as
+    MSEG segments (multi-segment: 8 MiB > inproc max_send_size) and
+    is reassembled + redelivered exactly (ADVICE r4: a raw MATCH
+    bigger than the transport frame limit can never be pushed)."""
+    from ompi_tpu.pml.vprotocol import find
+
+    N = 1024 * 1024  # 8 MiB float64 > inproc 4 MiB max_send_size
+    def fn(comm):
+        v = find(comm.state.pml)
+        base = v._base
+        data = np.arange(N, dtype=np.float64)
+        if comm.rank == 0:
+            # Isend: the RNDV is never ACKed (rank 1 drops it to
+            # simulate the restart cut), so a blocking Send could
+            # not complete — the request is abandoned like a real
+            # restart abandons the pre-crash pml
+            comm.Isend(data, dest=1, tag=9)
+            comm.Barrier()
+            comm.Barrier()
+            v.replay()
+            comm.Barrier()
+            return True
+        # rank 1: let the RNDV land unmatched, then simulate the
+        # uncoordinated-restart cut (drop unconsumed, arm wants)
+        while not base._unexpected.get(comm.cid):
+            comm.state.progress.progress()
+        comm.Barrier()
+        want = base.cr_capture_lenient()
+        base._unexpected[comm.cid].clear()
+        base._replay_want = {tuple(w) for w in want}
+        comm.Barrier()  # sender replays now
+        got = np.empty(N)
+        comm.Recv(got, source=0, tag=9)
+        assert got[0] == 0.0 and got[-1] == N - 1 and \
+            got[N // 2] == N // 2, "reassembly corrupted payload"
+        assert not base._mseg, "leaked partial reassembly"
+        comm.Barrier()
+        return True
+
+    assert all(run_ranks(2, fn))
+
+
+def test_replay_larger_than_shm_ring():
+    """mpirun process ranks over the shm btl: replay of a payload
+    larger than the ring must segment instead of raising (the
+    ADVICE r4 crash scenario, end-to-end)."""
+    prog = os.path.join(REPO, "tests", "_vproto_big_prog.py")
+    r = mpirun_run(2, prog, mca=(("pml_vprotocol", "pessimist"),),
+                   timeout=200, job_timeout=150)
+    assert b"vproto big ok" in r.stdout, \
+        r.stdout.decode()[-1000:] + r.stderr.decode()[-2000:]
+
+
 def test_coordinated_checkpoint_gc_clears_log(pessimist, tmp_path):
     from ompi_tpu import cr
     from ompi_tpu.pml.vprotocol import find
