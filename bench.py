@@ -37,6 +37,11 @@ NRANKS = 8
 HEADLINE_BYTES = 8 * MIB  # keep the r1 headline metric comparable
 
 
+def busbw_gbs(nbytes: int, us: float) -> float:
+    """OSU allreduce bus bandwidth: 2(P-1)/P * n / t."""
+    return 2 * (NRANKS - 1) / NRANKS * nbytes / (us * 1e-6) / 1e9
+
+
 def run_software_sweep(caps: dict, budget_s: float,
                        mca: tuple = (("btl", "self,shm,tcp"),),
                        start: int = 4) -> dict:
@@ -89,9 +94,7 @@ def fmt_table(dev: dict, sw: dict) -> str:
             su = s.get(k)
             ratio = f"{su / du:8.2f}x" if du and su else "        -"
             if du and dkey == "allreduce":
-                busbw = 2 * (NRANKS - 1) / NRANKS * nbytes / (
-                    du * 1e-6) / 1e9
-                bb = f"{busbw:9.2f} GB/s"
+                bb = f"{busbw_gbs(nbytes, du):9.2f} GB/s"
             else:
                 bb = "          -"
             lines.append(
@@ -168,9 +171,7 @@ def main() -> None:
     dev_ar = dev.get("allreduce", {})
     sw_ar = sw.get("allreduce", {})
     if dev_ar.get(hk) is not None:
-        du = dev_ar[hk] * 1e-6
-        result["value"] = round(
-            2 * (NRANKS - 1) / NRANKS * HEADLINE_BYTES / du / 1e9, 3)
+        result["value"] = round(busbw_gbs(HEADLINE_BYTES, dev_ar[hk]), 3)
         if sw_ar.get(hk) is not None:
             result["vs_baseline"] = round(sw_ar[hk] / dev_ar[hk], 3)
     elif opts.quick and dev_ar:
@@ -181,11 +182,9 @@ def main() -> None:
         if big is None:
             print(json.dumps(result))
             return
-        du = dev_ar[big] * 1e-6
         result["metric"] = (f"osu_allreduce busbw {NRANKS} ranks x "
                             f"{big} B float32 (quick)")
-        result["value"] = round(
-            2 * (NRANKS - 1) / NRANKS * int(big) / du / 1e9, 3)
+        result["value"] = round(busbw_gbs(int(big), dev_ar[big]), 3)
         if big in sw_ar:
             result["vs_baseline"] = round(sw_ar[big] / dev_ar[big], 3)
 
@@ -198,6 +197,16 @@ def main() -> None:
     result["northstar_beats_tuned_tcp_ge_4KiB"] = \
         tcp_beats if tcp_per_size else None
     result["read_const_us"] = dev.get("read_const_us")
+    # busbw-vs-size curve at a fixed size ladder: round-over-round
+    # comparisons survive single-point jitter (VERDICT r4 #10)
+    curve = {}
+    for k in ("4096", "65536", "1048576", "8388608", "67108864",
+              "268435456"):
+        du = dev_ar.get(k)
+        if du:
+            curve[k] = round(busbw_gbs(int(k), du), 2)
+    if curve:
+        result["busbw_curve_GBs"] = curve
     trunc = []
     for side, d in (("device", dev), ("software", sw),
                     ("software_tuned_tcp", sw_tcp)):
@@ -248,7 +257,8 @@ def main() -> None:
     # the driver tail-captures stdout: keep the line small by
     # shedding optional fields rather than ever not printing it
     line = json.dumps(result)
-    for drop in ("truncated", "sw_error", "error", "detail_error"):
+    for drop in ("busbw_curve_GBs", "truncated", "sw_error", "error",
+                 "detail_error"):
         if len(line) <= 1024:
             break
         result.pop(drop, None)

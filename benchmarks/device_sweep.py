@@ -5,7 +5,8 @@ allreduce/bcast/alltoall/reduce_scatter on device-resident arrays
 through the XLA collective path.  Used by bench.py; also runnable
 directly:  python benchmarks/device_sweep.py --max-ar 1048576
 
-Timing methodology (forced completion + chained dependency — r4):
+Timing methodology (forced completion + chained dependency — r4;
+quiet-gated reads + dual-mode allreduce — r5):
 on the tunneled TPU backend ``jax.Array.block_until_ready()`` returns
 WITHOUT awaiting execution (measured: 10 dispatched 8-MiB 8-way sums
 "complete" in 0.37 ms), so any timing that relies on it reports the
@@ -17,32 +18,64 @@ Every timed point here instead:
   1. warms up the op AND a tiny per-shape probe read (first read
      compiles; ~1 s on the tunnel), verifying the numeric result;
   2. measures the tunnel-RPC read constant (min of several 4-byte
-     d2h reads, ~100 ms on the tunnel);
-  3. runs N CHAINED iterations  x -> op(x) -> chain(x) -> op -> ...
-     where ``chain`` is a jitted materializing step (multiply/add by
-     a RUNTIME device scalar, so XLA cannot constant-fold it away)
-     that feeds each op's output into the next op's input: the device
-     must fully execute op k before op k+1 can start, and no op can
-     be aliased out.  chain also keeps values in steady state
-     (allreduce rescales by 1/P) so long runs never overflow.
-     N is chosen so N*op >= max(0.3 s, 4x read constant), never < 30;
-     completion is forced with ONE 4-byte d2h read of the LAST result
+     d2h reads);
+  3. runs N CHAINED iterations where each op's input depends on the
+     previous op's output (the device must fully execute op k before
+     op k+1 can start, and no op can be aliased out), then forces
+     completion with ONE 4-byte d2h read of the LAST result
      (in-order device execution awaits the whole chain);
-  4. reports (elapsed - read_const) / N, rank 0 as the timekeeper
-     (concurrent per-rank reads would serialize on the tunnel).
+  4. reports (elapsed - read_const) / N, rank 0 as the timekeeper.
+
+Quiet gate (r5): every d2h read — the read-constant probes, warmup
+verification, and each timed round's completion read — runs while
+the other rank-threads SLEEP on a threading.Event instead of polling
+inside a software collective.  The r4 sweep's reads ran against 7
+polling peers and cost ~20x the idle read constant; the excess was
+charged to the ops, putting a false ~1 ms floor on every device
+point.  The subtraction in (4) is only honest when the measured
+constant and the in-loop read share a context — now both are quiet.
+
+Allreduce runs in two modes (single-chip):
+
+  * latency mode (< 1 MiB): every rank deposits the SHARED previous
+    output; the op->op feedback is the data dependency.  No per-rank
+    chain step — the r4 chain cost 8 extra cross-thread dispatches
+    per iteration, which the tunneled backend serializes at ~0.5-1 ms
+    (cross-thread dependency chains are pathological; see
+    coll/device._DeviceDispatcher).  Values stay finite via an EXACT
+    power-of-two rescale (one extra dispatch per rank every 32 ops:
+    x * 2^-96 after 32 sums of 8 == x, bit-exact in f32).  Inputs
+    alias at these sizes, so the HBM-gate traffic factor drops to 2
+    (read n + write n) — immaterial: these points are latency-bound
+    by ~300 us of tunnel dispatch, three orders of magnitude above
+    the HBM time of the payload.
+  * bandwidth mode (>= 1 MiB, and always on real meshes): the r4
+    methodology — each rank's own chain step (multiply by a runtime
+    device scalar) produces P DISTINCT input buffers per iteration,
+    so the op must move the full P*n bytes and the reported busbw is
+    honest at sizes where traffic, not dispatch, dominates.
 
 A physical sanity gate then checks each point's implied bandwidth
 against the chip's HBM peak, using a PER-COLLECTIVE minimal-traffic
-model (a bcast must move ~n bytes, not P*n — r3's model overcharged
-it).  A violating point is recorded as null with the violation in
-``gated`` — one bad point never discards the sweep (r3 raised away
-every measurement).
+model (a LOWER bound, so the gate can only catch physically-
+impossible timings).  A violating point is recorded as null with the
+violation in ``gated``.
+
+Budget (r5): the wall-clock budget is SPLIT per collective up front
+(allreduce 45% — it carries the north-star verdict and sweeps every
+power of two >= 4 KiB; bcast/alltoall 15% each and reduce_scatter 25%
+on SPARSE size sets — 4/8/16 B tell one story, so non-gating
+collectives keep a handful of representative sizes and always reach
+their caps).  Leftover budget rolls forward.  The r4 failure mode —
+27 allreduce sizes starving reduce_scatter to a 2 KiB toy table —
+cannot recur: each collective owns its window.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import threading
 import time
 
 import numpy as np
@@ -59,6 +92,55 @@ _HBM_PEAK = {
     "TPU v6e": 1.64e12,
 }
 _HBM_PEAK_DEFAULT = 3.5e12
+
+# latency-mode/bandwidth-mode crossover (single-chip allreduce)
+_LAT_MAX = 1 * MIB
+_RESCALE_EVERY = 32  # 8^32 = 2^96: rescale by 2^-96 is bit-exact f32
+
+# sparse size sets for the non-north-star collectives (each reaches
+# its BASELINE cap; intermediate powers of two tell the same story
+# as their neighbors and starved the r4 sweep)
+_BCAST_SIZES = (4, 4096, 65536, MIB, 8 * MIB, 64 * MIB)
+_A2A_SIZES = (4, 4096, 65536, MIB, 4 * MIB)
+_RSB_SIZES = (64, 4096, 65536, MIB, 16 * MIB)
+# allreduce: three latency points below the verdict cut, then EVERY
+# power of two >= 4 KiB (the north star is per-size there)
+_AR_SMALL = (4, 256, 2048)
+
+
+class _QuietGate:
+    """Sleep-parked meeting for the measurement harness itself: the
+    reading rank works while every other rank waits on an Event (a
+    real futex sleep — no progress sweeps, no GIL churn against the
+    tunnel RPC).  Two cyclic-barrier phases bound each round."""
+
+    def __init__(self, n: int) -> None:
+        self.barrier = threading.Barrier(n)
+        self.ev = threading.Event()
+        self.box: dict = {}
+
+    def run(self, rank: int, who: int, fn):
+        """All ranks call; ``fn`` runs on rank ``who`` alone while the
+        rest sleep.  Returns fn()'s value on every rank."""
+        self.barrier.wait()
+        if rank == who:
+            try:
+                self.box["out"] = ("ok", fn())
+            except BaseException as e:  # noqa: BLE001
+                self.box["out"] = ("err", e)
+            self.ev.set()
+        else:
+            self.ev.wait()
+        self.barrier.wait()
+        kind, val = self.box["out"]
+        self.barrier.wait()
+        if rank == who:
+            self.ev = threading.Event()  # fresh before the next round
+        self.barrier.wait()
+        if kind == "err":
+            raise RuntimeError(f"quiet-gated read failed: {val}") \
+                from (val if rank == who else None)
+        return val
 
 
 def _rank_devices(nranks: int):
@@ -77,15 +159,12 @@ def sizes_upto(max_bytes: int, start: int = 4):
         s *= 2
 
 
-def should_continue(comm, deadline: float) -> bool:
-    """Collectively-agreed deadline check: rank 0 decides, everyone
-    follows — ranks must never diverge on whether the next size's
-    collectives run."""
-    flag = np.array(
-        [1 if (deadline <= 0 or time.perf_counter() < deadline) else 0],
-        dtype=np.int32)
-    comm.Bcast(flag, root=0)
-    return bool(flag[0])
+def _ar_sizes(max_ar: int):
+    for s in _AR_SMALL:
+        if s <= max_ar:
+            yield s
+    for s in sizes_upto(max_ar, start=4096):
+        yield s
 
 
 def _measure_read_const(probe) -> float:
@@ -98,27 +177,27 @@ def _measure_read_const(probe) -> float:
     return best
 
 
-def _forced_time(comm, x0, make_op, chain, read_token,
-                 read_const: float, deadline: float) -> float:
+def _forced_time(comm, gate: _QuietGate, x0, make_op, chain,
+                 read_token, read_const: float, deadline: float) -> float:
     """One timed point: N chained op+chain iterations + ONE forced read.
 
     All ranks iterate (the collective requires it); rank 0 is the
-    timekeeper and performs the single completion-forcing read, then
-    broadcasts the per-op seconds.  The chain step's data dependency
-    makes elision impossible; its cost (one elementwise op over the
-    rank's buffer) is included in the reported time — an honest upper
-    bound on the collective alone.
+    timekeeper.  Its completion-forcing read runs under the quiet
+    gate — peers sleep, so the read costs the same constant the
+    harness measured and subtracts.
     """
     target = max(0.3, 4.0 * read_const)
     max_iters = 1_000_000
     iters = 64 if read_const > 1e-3 else 30  # fast local backends: small N
+    me = comm.rank
     while True:
-        comm.Barrier()
+        gate.barrier.wait()
         t0 = time.perf_counter()
         x = x0
         for _ in range(iters):
             x = chain(make_op(x))
-        if comm.rank == 0:
+
+        def finish():
             read_token(x)
             work = time.perf_counter() - t0 - read_const
             over_deadline = (deadline > 0
@@ -129,36 +208,39 @@ def _forced_time(comm, x0, make_op, chain, read_token,
                 per_op = (work / iters
                           if work > max(0.0, 0.2 * read_const)
                           else -1.0)
-                ctl = np.array([1.0, per_op])
-            else:
-                # project N from the measured round (clamped growth)
-                grow = target / max(work, 0.01)
-                iters = int(min(max_iters, max(iters * 2, iters * grow)))
-                ctl = np.array([0.0, float(iters)])
-        else:
-            ctl = np.empty(2)
-        comm.Bcast(ctl, root=0)
-        if ctl[0] == 1.0:
-            comm.Barrier()
-            return float(ctl[1])
-        iters = int(ctl[1])
+                return (1.0, per_op)
+            # project N from the measured round (clamped growth)
+            grow = target / max(work, 0.01)
+            return (0.0, float(int(min(max_iters,
+                                       max(iters * 2, iters * grow)))))
+
+        done, val = gate.run(me, 0, finish)
+        if done == 1.0:
+            return float(val)
+        iters = int(val)
 
 
-def _min_traffic_factor(kind: str, nranks: int, single_chip: bool) -> float:
+def _min_traffic_factor(kind: str, nranks: int, single_chip: bool,
+                        latency_mode: bool = False) -> float:
     """Bytes the device MUST move per iteration, as a multiple of the
     point's size key — a LOWER bound per collective, so the gate can
     only catch physically-impossible timings, never flag honest ones.
 
     Single chip (stacked coll/hbm; every rank's shard lives in the
-    one HBM): an allreduce/reduce_scatter must READ all P distinct
-    input shards (they are distinct buffers — each rank's chain step
-    produced its own).  A bcast's outputs may legally alias the root
-    shard (zero-copy is a correct win of the shared-HBM model), but
-    each of the P ranks' mandatory chain step still reads+writes its
-    n bytes, so >= P*n moves.  An alltoall's size key is the per-pair
-    block; each rank holds P blocks, so the chain alone moves
-    >= P*(P*b).  On a real mesh the OSU busbw factors apply."""
+    one HBM), bandwidth mode: an allreduce/reduce_scatter must READ
+    all P distinct input shards (they are distinct buffers — each
+    rank's chain step produced its own).  A bcast's outputs may
+    legally alias the root shard (zero-copy is a correct win of the
+    shared-HBM model), but each of the P ranks' mandatory chain step
+    still reads+writes its n bytes, so >= P*n moves.  An alltoall's
+    size key is the per-pair block; each rank holds P blocks, so the
+    chain alone moves >= P*(P*b).  Latency-mode allreduce deposits
+    alias (see module docstring): the op still must read its input
+    and write a fresh output — >= 2n.  On a real mesh the OSU busbw
+    factors apply."""
     if single_chip:
+        if kind == "allreduce" and latency_mode:
+            return 2.0
         return {"allreduce": float(nranks),
                 "bcast": float(nranks),
                 "alltoall": float(nranks * nranks),
@@ -179,8 +261,15 @@ def run_device_sweep(nranks: int, max_ar: int, max_bcast: int,
     from ompi_tpu.testing import run_ranks
 
     device_map, devices = _rank_devices(nranks)
-    deadline = time.perf_counter() + budget_s if budget_s else 0.0
     single_chip = not devices
+    gate = _QuietGate(nranks)
+    t_start = time.perf_counter()
+
+    # per-collective budget windows (fractions of the total); unused
+    # time rolls forward because each deadline is computed when its
+    # collective STARTS from the time actually remaining
+    shares = {"allreduce": 0.45, "bcast": 0.15, "alltoall": 0.15,
+              "reduce_scatter": 0.25}
 
     if jax.default_backend() == "tpu":
         kind0 = jax.devices()[0].device_kind
@@ -191,7 +280,8 @@ def run_device_sweep(nranks: int, max_ar: int, max_bcast: int,
     def fn(comm):
         out = {"allreduce": {}, "bcast": {}, "alltoall": {},
                "reduce_scatter": {}, "truncated": False,
-               "read_const_us": None, "gated": []}
+               "read_const_us": None, "gated": [],
+               "latency_mode_below": _LAT_MAX if single_chip else 0}
 
         # per-shape probe reads (compiled at warmup); the token is the
         # first element of the flattened result
@@ -206,75 +296,94 @@ def run_device_sweep(nranks: int, max_ar: int, max_bcast: int,
             return float(np.asarray(f(arr))[0])
 
         # tunnel-RPC read constant, measured on a warmed tiny read
-        read_const = 0.0
-        if comm.rank == 0:
-            tiny = jnp.zeros((1,), jnp.float32)
-            read_token(tiny)  # compile the probe
-            read_const = _measure_read_const(lambda: read_token(tiny))
-            out["read_const_us"] = round(read_const * 1e6, 1)
-        rc = np.array([read_const])
-        comm.Bcast(rc, root=0)
-        read_const = float(rc[0])
+        # UNDER THE QUIET GATE — the same context as every in-loop
+        # completion read it will be subtracted from
+        tiny = jnp.zeros((1,), jnp.float32)
 
-        def one(kind, size_key, x0, make_op, chain, expect0):
+        def warm_and_measure():
+            read_token(tiny)  # compile the probe
+            return _measure_read_const(lambda: read_token(tiny))
+
+        read_const = gate.run(comm.rank, 0, warm_and_measure)
+        if comm.rank == 0:
+            out["read_const_us"] = round(read_const * 1e6, 1)
+
+        def budget_deadline(kind: str) -> float:
+            if not budget_s:
+                return 0.0
+            remaining = budget_s - (time.perf_counter() - t_start)
+            later = {"allreduce": ("bcast", "alltoall",
+                                   "reduce_scatter"),
+                     "bcast": ("alltoall", "reduce_scatter"),
+                     "alltoall": ("reduce_scatter",),
+                     "reduce_scatter": ()}[kind]
+            frac = shares[kind] / (shares[kind]
+                                   + sum(shares[k] for k in later))
+            return time.perf_counter() + max(5.0, remaining * frac)
+
+        def should_continue(deadline: float,
+                            projected_s: float = 0.0) -> bool:
+            # rank 0 decides; the gate distributes — ranks must never
+            # diverge on whether the next size's collectives run.
+            # ``projected_s`` gates ENTRY into a size whose warmup +
+            # rounds alone would blow the window (the r2 starvation
+            # pattern: an unbudgeted 128 MiB probe ate the budget)
+            return gate.run(
+                comm.rank, 0,
+                lambda: deadline <= 0
+                or time.perf_counter() + projected_s < deadline)
+
+        def trace(msg: str) -> None:
+            if comm.rank == 0:
+                import sys as _sys
+                print(f"[sweep +{time.perf_counter() - t_start:6.1f}s] "
+                      f"{msg}", file=_sys.stderr, flush=True)
+
+        def one(kind, size_key, x0, make_op, chain, expect0,
+                deadline, latency_mode=False, min_of=2):
             # warmup: compile op + chain + probe, verify the numeric
             # result on BOTH the first and the last rank (a collective
             # broken only on its final ring/tree step passes a
-            # rank-0-only check); reads staggered so the tunnel RPCs
-            # serialize
+            # rank-0-only check); all reads quiet-gated
             r = make_op(x0)
+            got = gate.run(comm.rank, 0, lambda: read_token(r))
             if comm.rank == 0:
-                got = read_token(r)
                 assert abs(got - expect0) < 1e-3, \
                     (kind, size_key, got, expect0)
-            comm.Barrier()
+            got = gate.run(comm.rank, nranks - 1,
+                           lambda: read_token(r))
             if comm.rank == nranks - 1:
-                got = read_token(r)
                 assert abs(got - expect0) < 1e-3, \
                     (kind, size_key, got, expect0)
-            comm.Barrier()
             c = chain(r)  # compile the chain step outside the timed loop
-            if comm.rank == 0:
-                # also compile the probe for the CHAIN output's shape:
-                # the timed loop's completion read is on a chain
-                # result, which for reduce_scatter has a different
-                # shape than the op result — an unwarmed probe would
-                # put its ~1 s compile inside the measured window
-                read_token(c)
-            comm.Barrier()
-            t = _forced_time(comm, x0, make_op, chain, read_token,
-                             read_const, deadline)
-            # min-of-2: tunnel RPC jitter on a shared bench host can
-            # inflate a single measurement 2-3x (observed: 9.6 ms vs
-            # a 2.2 ms repeat at 4 B); every point gets one repeat and
-            # keeps the minimum — both runs are full forced-completion
-            # measurements, so the min is still an honest upper bound
-            # on the op time.  A >5x-the-neighbor outlier earns a
-            # third attempt.
-            if t > 0 and should_continue(comm, deadline):
-                t2 = _forced_time(comm, x0, make_op, chain, read_token,
-                                  read_const, deadline)
-                if t2 > 0:
-                    t = min(t, t2)
-            prev = out[kind].get(getattr(one, "_prev_key", None))
-            if (t > 0 and prev and t * 1e6 > 5 * prev
-                    and should_continue(comm, deadline)):
-                t3 = _forced_time(comm, x0, make_op, chain, read_token,
-                                  read_const, deadline)
-                if t3 > 0:
-                    t = min(t, t3)
-            one._prev_key = size_key
-            # -1 = deadline hit before the point could be amortized
-            # past the read-constant jitter: unmeasurable, not a number
-            if t <= 0:
+            # also compile the probe for the CHAIN output's shape: the
+            # timed loop's completion read is on a chain result, which
+            # for reduce_scatter has a different shape than the op
+            # result — an unwarmed probe would put its ~1 s compile
+            # inside the measured window
+            gate.run(comm.rank, 0, lambda: read_token(c))
+            ts = []
+            for _ in range(min_of):
+                t = _forced_time(comm, gate, x0, make_op, chain,
+                                 read_token, read_const, deadline)
+                if t > 0:
+                    ts.append(t)
+                if not should_continue(deadline):
+                    break
+            if not ts:
+                # deadline hit before the point could be amortized
+                # past the read-constant jitter: unmeasurable, not a
+                # number
                 out[kind][size_key] = None
                 return
+            t = min(ts)
             # physical sanity gate, PER POINT: a time implying more
             # HBM traffic than the chip can move is a measurement
             # artifact — null THIS point with the violation recorded,
             # keep the rest of the sweep (r3 raised away everything)
             if hbm_peak is not None:
-                factor = _min_traffic_factor(kind, nranks, single_chip)
+                factor = _min_traffic_factor(kind, nranks, single_chip,
+                                             latency_mode)
                 implied = factor * int(size_key) / t
                 if implied > 1.05 * hbm_peak:
                     out["gated"].append({
@@ -295,46 +404,90 @@ def run_device_sweep(nranks: int, max_ar: int, max_bcast: int,
                                comm.device)
         eps32 = jax.device_put(jnp.asarray(0.0, jnp.float32),
                                comm.device)
+        # 8 ranks x 32 feedback sums multiply values by 8^32 = 2^96
+        # exactly; the rescale restores them bit-for-bit (powers of
+        # two are exact in f32 and 36*2^96 ~ 2.9e30 < f32 max)
+        descale = jax.device_put(
+            jnp.asarray(float(nranks) ** -_RESCALE_EVERY, jnp.float32),
+            comm.device)
         scale_f = jax.jit(lambda a, s: a * s)
         shift_f = jax.jit(lambda a, e: a + e)
 
         expect_sum = float(sum(range(1, nranks + 1)))
-        for nbytes in sizes_upto(max_ar):
-            if not should_continue(comm, deadline):
-                out["truncated"] = True
+        ar_deadline = budget_deadline("allreduce")
+        last_cost = [0.0]
+        for nbytes in _ar_sizes(max_ar):
+            # a size costs ~2x its predecessor (warmup + rounds scale
+            # with the payload); entry is gated on that projection
+            if not should_continue(ar_deadline, 2.0 * last_cost[0]):
+                out["allreduce"]["truncated"] = True
                 break
+            t_size = time.perf_counter()
+            trace(f"allreduce {nbytes}B start")
             n = max(1, nbytes // 4)
             x = jax.device_put(
                 jnp.full((n,), comm.rank + 1.0, jnp.float32), comm.device)
-            # steady state: sum(1..P) -> *1/P -> mean -> sum = P*mean
-            one("allreduce", str(n * 4), x,
-                lambda v: comm.allreduce_arr(v, mpi_op.SUM),
-                lambda r: scale_f(r, inv_p), expect_sum)
-        if not out["truncated"]:
-            for nbytes in sizes_upto(max_bcast):
-                if not should_continue(comm, deadline):
-                    out["truncated"] = True
-                    break
-                n = max(1, nbytes // 4)
-                x = jax.device_put(
-                    jnp.full((n,), 7.0 if comm.rank == 0 else 0.0,
-                             jnp.float32), comm.device)
-                one("bcast", str(n * 4), x,
-                    lambda v: comm.bcast_arr(v, root=0),
-                    lambda r: shift_f(r, eps32), 7.0)
-        if not out["truncated"]:
-            for nbytes in sizes_upto(max_a2a):
-                if not should_continue(comm, deadline):
-                    out["truncated"] = True
-                    break
-                per = max(1, nbytes // 4)
-                x = jax.device_put(
-                    jnp.full((per * nranks,), comm.rank + 1.0,
-                             jnp.float32), comm.device)
-                one("alltoall", str(per * 4), x,
-                    lambda v: comm.alltoall_arr(v),
-                    lambda r: shift_f(r, eps32), 1.0)
-        if not out["truncated"]:
+            latency_mode = single_chip and nbytes < _LAT_MAX
+            if latency_mode:
+                # feedback chain: the op's own output is the next
+                # input (shared across ranks); exact rescale every
+                # _RESCALE_EVERY ops keeps values finite.  The chain
+                # closure carries the op counter — per-rank state,
+                # advanced identically on every rank.
+                ctr = [0]
+
+                def chain_lat(r):
+                    ctr[0] += 1
+                    if ctr[0] % _RESCALE_EVERY == 0:
+                        return scale_f(r, descale)
+                    return r
+
+                one("allreduce", str(n * 4), x,
+                    lambda v: comm.allreduce_arr(v, mpi_op.SUM),
+                    chain_lat, expect_sum, ar_deadline,
+                    latency_mode=True, min_of=2)
+            else:
+                # steady state: sum(1..P) -> *1/P -> mean -> sum
+                one("allreduce", str(n * 4), x,
+                    lambda v: comm.allreduce_arr(v, mpi_op.SUM),
+                    lambda r: scale_f(r, inv_p), expect_sum,
+                    ar_deadline)
+            last_cost[0] = time.perf_counter() - t_size
+            trace(f"allreduce {nbytes}B done in {last_cost[0]:.1f}s -> "
+                  f"{out['allreduce'].get(str(max(1, nbytes // 4) * 4))}")
+        bc_deadline = budget_deadline("bcast")
+        for nbytes in _BCAST_SIZES:
+            if nbytes > max_bcast:
+                break
+            if not should_continue(bc_deadline):
+                out["bcast"]["truncated"] = True
+                break
+            n = max(1, nbytes // 4)
+            x = jax.device_put(
+                jnp.full((n,), 7.0 if comm.rank == 0 else 0.0,
+                         jnp.float32), comm.device)
+            one("bcast", str(n * 4), x,
+                lambda v: comm.bcast_arr(v, root=0),
+                lambda r: shift_f(r, eps32), 7.0, bc_deadline)
+            trace(f"bcast {nbytes}B -> "
+                  f"{out['bcast'].get(str(max(1, nbytes // 4) * 4))}")
+        a2a_deadline = budget_deadline("alltoall")
+        for nbytes in _A2A_SIZES:
+            if nbytes > max_a2a:
+                break
+            if not should_continue(a2a_deadline):
+                out["alltoall"]["truncated"] = True
+                break
+            per = max(1, nbytes // 4)
+            x = jax.device_put(
+                jnp.full((per * nranks,), comm.rank + 1.0,
+                         jnp.float32), comm.device)
+            one("alltoall", str(per * 4), x,
+                lambda v: comm.alltoall_arr(v),
+                lambda r: shift_f(r, eps32), 1.0, a2a_deadline)
+            trace(f"alltoall {nbytes}B -> "
+                  f"{out['alltoall'].get(str(max(1, nbytes // 4) * 4))}")
+        if max_rsb:
             # BASELINE config 5 as specified: MPI_MAX on MPI_DOUBLE
             # sourced through a derived VECTOR datatype, with the
             # datatype pack running ON DEVICE (datatype/device.py: the
@@ -365,9 +518,12 @@ def run_device_sweep(nranks: int, max_ar: int, max_bcast: int,
             base_dt = dtmod.from_numpy_dtype(np.dtype(rs_dtype))
             neg1 = jax.device_put(jnp.asarray(-1.0, rs_dtype),
                                   comm.device)
-            for nbytes in sizes_upto(max_rsb, start=64):
-                if not should_continue(comm, deadline):
-                    out["truncated"] = True
+            rsb_deadline = budget_deadline("reduce_scatter")
+            for nbytes in _RSB_SIZES:
+                if nbytes > max_rsb:
+                    break
+                if not should_continue(rsb_deadline):
+                    out["reduce_scatter"]["truncated"] = True
                     break
                 per = max(1, nbytes // itemsize // nranks)
                 n = per * nranks
@@ -399,10 +555,14 @@ def run_device_sweep(nranks: int, max_ar: int, max_bcast: int,
                     lambda v: comm.reduce_scatter_arr(
                         packed_fn(v), mpi_op.MAX),
                     lambda r: chain_fn(r, neg1),
-                    float(nranks))
-
-        if "config5_dtype" in out:
-            jax.config.update("jax_enable_x64", x64_before)
+                    float(nranks), rsb_deadline)
+                trace(f"reduce_scatter {nbytes}B -> "
+                      f"{out['reduce_scatter'].get(str(n * itemsize))}")
+            if "config5_dtype" in out:
+                jax.config.update("jax_enable_x64", x64_before)
+        out["truncated"] = any(
+            isinstance(v, dict) and v.get("truncated")
+            for v in out.values())
         comm.Barrier()
         return out
 

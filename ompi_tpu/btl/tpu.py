@@ -76,7 +76,7 @@ _chunk_var = _mca.register(
          "streamed in chunks of this size (bounded host staging); "
          "smaller ones ride one eager object frag")
 _restore_grace_var = _mca.register(
-    "btl", "tpu", "restore_grace_s", 60.0, float,
+    "btl", "tpu", "restore_grace_s", 300.0, float,
     help="Seconds a snapshot-restored parked transfer waits for its "
          "receiver's first pull before being garbage-collected (the "
          "receiver may have completed the pull before the snapshot "
@@ -135,6 +135,7 @@ class TpuRndvEngine:
         self.pending: Dict[int, tuple] = {}   # id -> (flat, sent, total)
         self._inflight: list = []             # (req, nbytes)
         self._restored: Dict[int, float] = {}  # xid -> restore stamp
+        self._gc_tombstones: set = set()       # grace-GC'd xids
         self.staged_bytes = 0
         self.max_staged_bytes = 0
         state.progress.register(self.progress, low_priority=True)
@@ -213,6 +214,7 @@ class TpuRndvEngine:
                 #                              already completed its
                 #                              pull before the snapshot
                 #                              was restored
+                self._gc_tombstones.add(xid)
         while True:
             msg = pml.poll_obj_any(T_PULL)
             if msg is None:
@@ -222,6 +224,19 @@ class TpuRndvEngine:
             entry = self.pending.get(pull.xfer_id)
             self._restored.pop(pull.xfer_id, None)  # claimed: live
             if entry is None:
+                if pull.xfer_id in self._gc_tombstones:
+                    # the restore-grace GC dropped this transfer as
+                    # unclaimed, but the receiver's re-pull was just
+                    # slow: the data is gone — say so loudly so the
+                    # receiver's hang is diagnosable (raise
+                    # btl_tpu_restore_grace_s)
+                    from ompi_tpu.util import output
+                    output.get_stream("btl_tpu").output(
+                        f"pull for restored transfer "
+                        f"{pull.xfer_id} arrived after the "
+                        f"restore-grace GC discarded it; the "
+                        f"receiver's recv_arr cannot complete "
+                        f"(raise btl_tpu_restore_grace_s)")
                 continue  # duplicate/late pull
             flat, _, nchunks, per = entry
             comm = self.state.comms.get(pull.cid)

@@ -57,6 +57,15 @@ _rv_timeout_var = registry.register(
     "coll", "device", "rendezvous_timeout", 300.0, float,
     help="Seconds a device-collective rendezvous may stall before "
          "raising (dead/diverged peer diagnosis)")
+_dispatcher_var = registry.register(
+    "coll", "device", "dispatcher", False, bool,
+    help="Run every device-collective computation on one dedicated "
+         "thread instead of the rendezvous's last arriver.  The "
+         "tunneled single-chip backend serializes cross-thread op "
+         "chains expensively in microbenchmarks, but in the full "
+         "meeting harness the dedicated thread measured WORSE "
+         "(r5 A/B) — off by default; kept as a tuning knob for real "
+         "multi-core hosts.")
 _reduce_as_allreduce_var = registry.register(
     "coll", "device", "reduce_as_allreduce", True, bool,
     help="Lower reduce_arr as an on-device allreduce (SPMD computes "
@@ -106,6 +115,54 @@ def _fold_fn(opname: str):
         "MPI_LXOR": lambda s: ((s != 0).sum(axis=0) % 2).astype(s.dtype),
         "MPI_BXOR": lambda s: functools.reduce(jnp.bitwise_xor, s),
     }[opname]
+
+
+class _DeviceDispatcher:
+    """One thread per process runs EVERY device-collective
+    computation.
+
+    The tunneled PJRT backend serializes dependency chains whose ops
+    were dispatched from different host threads at a heavy fixed
+    cost (measured on the v5e tunnel: ~219 us/op for a chained
+    8-input stacked sum dispatched from one thread, ~750 us/op when
+    8 threads take turns, ~1184 us/op from a fresh thread per op).
+    The rendezvous's natural "last arriver computes" rotation is
+    exactly the worst case — so the last arriver now hands the
+    computation to this dispatcher and parks with everyone else.
+    One extra thread activation per collective buys the fixed-thread
+    fast path for the whole chain of collectives a program issues."""
+
+    def __init__(self) -> None:
+        import queue
+        self.q: "queue.SimpleQueue" = queue.SimpleQueue()
+        self.thread = threading.Thread(
+            target=self._loop, daemon=True, name="coll-device-dispatch")
+        self.thread.start()
+
+    def _loop(self) -> None:
+        while True:
+            work = self.q.get()
+            if work is None:
+                return
+            work()  # never raises: work wraps its own error capture
+
+    def submit(self, work: Callable[[], None]) -> None:
+        self.q.put(work)
+
+
+_dispatcher_singleton: Optional[_DeviceDispatcher] = None
+_dispatcher_lock = threading.Lock()
+
+
+def _dispatcher() -> _DeviceDispatcher:
+    global _dispatcher_singleton
+    d = _dispatcher_singleton
+    if d is None:
+        with _dispatcher_lock:
+            d = _dispatcher_singleton
+            if d is None:
+                d = _dispatcher_singleton = _DeviceDispatcher()
+    return d
 
 
 class Rendezvous:
@@ -171,7 +228,20 @@ class Rendezvous:
                         tick(t0, what)
                 return
             park = min(poll, 0.05)
+            first = True
             while not cond():
+                if first:
+                    # fast path: park straight on the condvar — in the
+                    # common meeting (all peers arrive within a couple
+                    # ms) the last arriver's notify wakes us with ZERO
+                    # progress sweeps.  A sweep costs 10-50x a condvar
+                    # wake and used to run once per waiter per op,
+                    # dominating the small-collective floor; background
+                    # service (passive-target RMA at this rank) keeps
+                    # its <=2 ms latency via the timeout below.
+                    first = False
+                    if self.cv.wait(timeout=0.002):
+                        continue
                 # progress outside the cv: handlers may send replies
                 # (osc acks) and must never run under the meeting lock
                 self.cv.release()
@@ -199,23 +269,53 @@ class Rendezvous:
             self.slots[rank] = value
             self.count += 1
             if self.count == self.size:
-                try:
-                    self.results[gen] = fn(list(self.slots))
-                except BaseException as e:  # noqa: BLE001
-                    self.errors[gen] = e
-                    self.results[gen] = [None] * self.size
-                self.readers[gen] = self.size
+                shards = list(self.slots)
                 self.count = 0
                 self.slots = [self._SENTINEL] * self.size
                 self.gen += 1
-                self.cv.notify_all()
-                # wake members parked on their progress idle selector
-                for r, prog in self._progs.items():
-                    if r != rank:
-                        prog.wakeup()
-            else:
-                wait_for(lambda: gen in self.results,
-                         f"waiting for {self.size - self.count} peers")
+                if _dispatcher_var.value:
+                    # optional: hand the computation to the process-
+                    # wide dispatcher thread and park with everyone
+                    # else.  Slots are recycled above either way, so
+                    # generation g+1 deposits may land while g still
+                    # computes.
+                    rv = self
+
+                    def work() -> None:
+                        try:
+                            res = fn(shards)
+                            err = None
+                        except BaseException as e:  # noqa: BLE001
+                            res = [None] * rv.size
+                            err = e
+                        with rv.cv:
+                            if err is not None:
+                                rv.errors[gen] = err
+                            rv.results[gen] = res
+                            rv.readers[gen] = rv.size
+                            rv.cv.notify_all()
+                            progs = list(rv._progs.items())
+                        # wake members parked on their progress idle
+                        # selector (outside the meeting lock)
+                        for _r, prog in progs:
+                            prog.wakeup()
+
+                    _dispatcher().submit(work)
+                else:
+                    # last arriver computes inline (under the cv, as
+                    # before the r5 dispatcher experiment)
+                    try:
+                        self.results[gen] = fn(shards)
+                    except BaseException as e:  # noqa: BLE001
+                        self.errors[gen] = e
+                        self.results[gen] = [None] * self.size
+                    self.readers[gen] = self.size
+                    self.cv.notify_all()
+                    for r, prog in self._progs.items():
+                        if r != rank:
+                            prog.wakeup()
+            wait_for(lambda: gen in self.results,
+                     f"waiting for {self.size - self.count} peers")
             err = self.errors.get(gen)
             out = self.results[gen][rank]
             self.readers[gen] -= 1
@@ -295,7 +395,26 @@ def _mesh_collective(kind: str, mesh, shape, dtype, extra=None) -> Callable:
                 lax.all_gather(x, "r", tiled=False))
         in_specs, out_specs = P("r"), P(None)
     elif kind == "reduce_scatter":
-        body = lambda x: lax.psum_scatter(x, "r", tiled=True)  # noqa: E731
+        opname = extra or "MPI_SUM"
+        if opname == "MPI_SUM":
+            body = lambda x: lax.psum_scatter(x, "r", tiled=True)  # noqa: E731
+        else:
+            # non-SUM ops have no XLA ReduceScatter lowering: gather
+            # the shards, fold on-device, keep this rank's stripe
+            if opname == "MPI_MAX":
+                fold = lambda g: jnp.max(g, axis=0)  # noqa: E731
+            elif opname == "MPI_MIN":
+                fold = lambda g: jnp.min(g, axis=0)  # noqa: E731
+            else:
+                fold = _fold_fn(opname)
+
+            def body(x):
+                g = lax.all_gather(x, "r", tiled=False)
+                r = fold(g)
+                i = lax.axis_index("r")
+                m = r.shape[0] // size
+                return lax.dynamic_slice_in_dim(r, i * m, m, axis=0)
+
         in_specs, out_specs = P("r"), P("r")
     elif kind == "allgather":
         body = lambda x: lax.all_gather(x, "r", tiled=True)  # noqa: E731
@@ -430,15 +549,19 @@ class TpuCollModule(CollModule):
         return out.reshape(()) if was_scalar else out
 
     def reduce_scatter_block_arr(self, comm, x, op: Op):
-        if not self._eligible(comm, x) or op.name != "MPI_SUM" \
+        if not self._eligible(comm, x) or (
+                op.name not in _XLA_REDUCERS
+                and op.name not in _GATHER_FOLD) \
                 or _ndim_of(x) == 0 \
                 or x.shape[0] % comm.size != 0:
             return self.fallback.reduce_scatter_block_arr(comm, x, op)
         mesh = comm.mesh()
+        opname = op.name
 
         def fn(shards):
             g = _assemble(mesh, shards)
-            jfn = _mesh_collective("reduce_scatter", mesh, g.shape, g.dtype)
+            jfn = _mesh_collective("reduce_scatter", mesh, g.shape,
+                                   g.dtype, opname)
             return _scatter_out(jfn(g), mesh, comm.size)
 
         return self._run(comm, x, fn)
@@ -588,8 +711,17 @@ class HbmCollModule(CollModule):
                 body = lambda *s: fold(jnp.stack(s))  # noqa: E731
             out = lambda r, n: [r] * n  # noqa: E731
         elif kind == "reduce_scatter":
+            if opname == "MPI_SUM":
+                red = lambda stk: jnp.sum(stk, axis=0)  # noqa: E731
+            elif opname == "MPI_MAX":
+                red = lambda stk: jnp.max(stk, axis=0)  # noqa: E731
+            elif opname == "MPI_MIN":
+                red = lambda stk: jnp.min(stk, axis=0)  # noqa: E731
+            else:
+                red = _fold_fn(opname)
+
             def body(*s):
-                r = jnp.sum(jnp.stack(s), axis=0)
+                r = red(jnp.stack(s))
                 m = r.shape[0] // len(s)
                 return tuple(
                     jax.lax.dynamic_slice_in_dim(r, i * m, m, axis=0)
@@ -650,7 +782,12 @@ class HbmCollModule(CollModule):
         return out.reshape(()) if was_scalar else out
 
     def reduce_scatter_block_arr(self, comm, x, op: Op):
-        if not self._eligible(comm, x) or op.name != "MPI_SUM" \
+        # every stacked-foldable op, not just SUM: BASELINE config 5
+        # is MPI_MAX — a SUM-only guard silently host-staged it at
+        # ~100 ms/op through the d2h fallback (r5 finding)
+        if not self._eligible(comm, x) or (
+                op.name not in _XLA_REDUCERS
+                and op.name not in _GATHER_FOLD) \
                 or _ndim_of(x) == 0 \
                 or x.shape[0] % comm.size != 0:
             return self.fallback.reduce_scatter_block_arr(comm, x, op)
