@@ -141,14 +141,15 @@ def main() -> None:
     }
     dev = {}
     sw = {}
-    try:
-        from benchmarks.device_sweep import run_device_sweep
-
-        dev = run_device_sweep(NRANKS, caps["ar"], caps["bcast"],
-                               caps["a2a"], caps["rsb"],
-                               budget_s=opts.dev_budget)
-    except Exception as e:  # noqa: BLE001
-        result["error"] = f"device sweep: {str(e)[:200]}"
+    # ORDER MATTERS on the 1-core bench box: the software sweeps are
+    # subprocess jobs and run FIRST, before the device sweep imports
+    # jax into this process — r4 ran them after, and the resident
+    # tunnel client's threads stole enough CPU to inflate software
+    # numbers 4-22x (the "tcp large-payload cliff" of VERDICT r4 #4
+    # reproduced at 9.6 s/op under that contamination vs 2.7 s idle,
+    # perfectly linear; the seg path measured 810 ms at 8 MiB vs
+    # 35 ms idle).  Idle-box software numbers are the honest
+    # baseline for both north-star comparisons.
     try:
         sw = run_software_sweep(caps, opts.sw_budget)
     except Exception as e:  # noqa: BLE001
@@ -166,6 +167,14 @@ def main() -> None:
             start=4096)
     except Exception as e:  # noqa: BLE001
         result["sw_tcp_error"] = f"tuned-tcp sweep: {str(e)[:160]}"
+    try:
+        from benchmarks.device_sweep import run_device_sweep
+
+        dev = run_device_sweep(NRANKS, caps["ar"], caps["bcast"],
+                               caps["a2a"], caps["rsb"],
+                               budget_s=opts.dev_budget)
+    except Exception as e:  # noqa: BLE001
+        result["error"] = f"device sweep: {str(e)[:200]}"
 
     hk = str(HEADLINE_BYTES)
     dev_ar = dev.get("allreduce", {})

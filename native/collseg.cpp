@@ -1,8 +1,9 @@
 // Shared-segment collectives: the C hot path of ompi_tpu/coll/seg.py.
 //
 // One reentrant call executes a whole small collective against the
-// per-communicator mmap segment (layout defined by coll/seg.py:
-// [magic u64][done i64*P][seq i64*P*2][data u8*P*2*slot]).  The
+// per-communicator mmap segment (layout v2, defined by coll/seg.py:
+// [magic u64][done i64*P][seq i64*P*2][posted i64*2][left i64*2]
+// [data u8*P*2*slot]).  The
 // Python layer measured ~133 us of CPU per rank per 8-rank op for
 // the same protocol (cache-cold interpreter + numpy dispatch under
 // process rotation on an oversubscribed host); this path touches a
@@ -26,6 +27,18 @@
 //   done[rank] >= gen            -> already complete (idempotent 0)
 //   seq[rank][gen&1] >= gen      -> posted; skip to the wait phase
 //   otherwise                    -> bank-reuse guard, post, wait
+//
+// v2 (r5): waiters park on per-bank COMPLETION WORDS instead of
+// staggered per-rank flag words.  The staggered scheme woke every
+// parked waiter on EVERY post (each recheck re-parks on the next
+// laggard): O(P^2) scheduler slices per op on an oversubscribed
+// host.  Now each poster stores its own seq flag, scans the P flags
+// (cheap loads), and whichever rank's scan first observes them all
+// publishes gen into posted[bank] and issues ONE wake; waiters park
+// once on that word and wake once.  left[bank] mirrors this for the
+// bank-reuse guard over the done flags.  Plain aligned stores of
+// monotonically increasing gens — no atomic RMW, so the no-lib
+// Python protocol can speak the same segment wordings.
 
 #include <atomic>
 #include <cstdint>
@@ -57,12 +70,17 @@ struct Seg {
     int64_t P, slot;
     volatile int64_t* done;     // [P]
     volatile int64_t* seq;      // [P][2]
+    volatile int64_t* posted;   // [2]  all-posted gen per bank
+    volatile int64_t* left;     // [2]  all-done gen per bank
     uint8_t* data;              // [P][2][slot]
 
     Seg(uint8_t* b, int64_t p, int64_t s) : base(b), P(p), slot(s) {
         done = reinterpret_cast<volatile int64_t*>(base + 8);
         seq = reinterpret_cast<volatile int64_t*>(base + 8 + 8 * P);
-        data = base + 8 + 8 * P + 16 * P;
+        posted = reinterpret_cast<volatile int64_t*>(
+            base + 8 + 8 * P + 16 * P);
+        left = posted + 2;
+        data = base + 8 + 8 * P + 16 * P + 32;
     }
     volatile int64_t* seq_at(int64_t p, int64_t b) const {
         return seq + p * 2 + b;
@@ -76,40 +94,54 @@ struct Seg {
     }
 };
 
-// Wait until f(i) >= gen for every i in [0, n); park (futex) on the
-// first laggard after `rank` in cyclic order — if every waiter
-// watched the same word, each flag write would wake the whole herd.
-// Returns true when satisfied, false when park_ns elapsed once
-// without completion (caller re-enters after a progress sweep).
+// Scan the P per-rank flags; when all reached `gen`, publish it into
+// the bank's completion word (idempotent: every publisher stores the
+// same monotonically-increasing value) and wake its waiters.
 template <typename GetWord>
-bool wait_all_ge(GetWord f, int64_t n, int64_t gen, int64_t rank,
-                 long park_ns) {
+inline bool scan_publish(GetWord f, int64_t P, int64_t gen,
+                         volatile int64_t* complete_w) {
+    for (int64_t i = 0; i < P; ++i)
+        if (__atomic_load_n(f(i), __ATOMIC_ACQUIRE) < gen) return false;
+    if (__atomic_load_n(complete_w, __ATOMIC_ACQUIRE) < gen) {
+        __atomic_store_n(complete_w, gen, __ATOMIC_RELEASE);
+        futex_wake(Seg::word(complete_w));
+    }
+    return true;
+}
+
+// Wait until the completion word reaches `gen`; one park per
+// invocation (on timeout the caller sweeps progress and re-enters).
+// `f`/`P` name the underlying flags: the waiter re-scans them before
+// parking so a missed publication (both scanning ranks raced) can
+// never strand the bank — any waiter can become the publisher.
+template <typename GetWord>
+inline bool wait_complete(GetWord f, int64_t P, int64_t gen,
+                          volatile int64_t* complete_w, long park_ns) {
     for (;;) {
-        int64_t lag = -1;
-        for (int64_t k = 1; k <= n; ++k) {
-            int64_t i = (rank + k) % n;
-            if (__atomic_load_n(f(i), __ATOMIC_ACQUIRE) < gen) {
-                lag = i;
-                break;
-            }
-        }
-        if (lag < 0) return true;
-        volatile int32_t* w = Seg::word(f(lag));
-        int32_t cur = __atomic_load_n(w, __ATOMIC_ACQUIRE);
+        if (__atomic_load_n(complete_w, __ATOMIC_ACQUIRE) >= gen)
+            return true;
+        if (scan_publish(f, P, gen, complete_w)) return true;
+        volatile int32_t* w32 = Seg::word(complete_w);
+        int32_t cur = __atomic_load_n(w32, __ATOMIC_ACQUIRE);
         if ((int64_t)cur >= gen) continue;
-        futex_wait(w, cur, park_ns);
-        // one park per invocation: recheck, then hand control back
-        // if still incomplete so the caller can sweep its progress
-        int64_t lag2 = -1;
-        for (int64_t k = 1; k <= n; ++k) {
-            int64_t i = (rank + k) % n;
-            if (__atomic_load_n(f(i), __ATOMIC_ACQUIRE) < gen) {
-                lag2 = i;
-                break;
-            }
-        }
-        if (lag2 < 0) return true;
-        return false;
+        futex_wait(w32, cur, park_ns);
+        if (__atomic_load_n(complete_w, __ATOMIC_ACQUIRE) >= gen)
+            return true;
+        return scan_publish(f, P, gen, complete_w);
+    }
+}
+
+// Single-word generation wait (bcast non-roots watch the root's seq
+// flag; exactly one writer, so no herd to avoid).
+inline bool wait_word_ge(volatile int64_t* w, int64_t gen,
+                         long park_ns) {
+    for (;;) {
+        if (__atomic_load_n(w, __ATOMIC_ACQUIRE) >= gen) return true;
+        volatile int32_t* w32 = Seg::word(w);
+        int32_t cur = __atomic_load_n(w32, __ATOMIC_ACQUIRE);
+        if ((int64_t)cur >= gen) continue;
+        futex_wait(w32, cur, park_ns);
+        return __atomic_load_n(w, __ATOMIC_ACQUIRE) >= gen;
     }
 }
 
@@ -248,31 +280,36 @@ extern "C" int tpumpi_seg_coll(
     if (__atomic_load_n(&seg.done[rank], __ATOMIC_ACQUIRE) >= gen)
         return 0;  // idempotent reentry after completion
 
+    auto sget = [&](int64_t i) { return seg.seq_at(i, b); };
+    auto dget = [&](int64_t i) { return &seg.done[i]; };
+
     // ---- post phase (once) --------------------------------------------
     if (__atomic_load_n(seg.seq_at(rank, b), __ATOMIC_ACQUIRE) < gen) {
         if (gen >= 2) {
             // bank-reuse guard: nobody may still be reading this bank
-            // from op gen-2
-            auto dget = [&](int64_t i) { return &seg.done[i]; };
-            if (!wait_all_ge(dget, P, gen - 2, rank, park_ns)) return 1;
+            // from op gen-2 (their done flags prove they left)
+            if (!wait_complete(dget, P, gen - 2, &seg.left[b], park_ns))
+                return 1;
         }
         bool writes = !(kind == K_BCAST && rank != root) &&
                       !(kind == K_BARRIER);
         if (writes && in && nbytes > 0)
             std::memcpy(seg.slot_at(rank, b), in, nbytes);
         __atomic_store_n(seg.seq_at(rank, b), gen, __ATOMIC_RELEASE);
-        futex_wake(Seg::word(seg.seq_at(rank, b)));
+        if (kind == K_BCAST && rank == root)
+            futex_wake(Seg::word(seg.seq_at(rank, b)));
+        scan_publish(sget, P, gen, &seg.posted[b]);
     }
 
     // ---- wait phase ----------------------------------------------------
-    auto sget = [&](int64_t i) { return seg.seq_at(i, b); };
     if (kind == K_BCAST) {
         if (rank != root) {
-            auto rget = [&](int64_t) { return seg.seq_at(root, b); };
-            if (!wait_all_ge(rget, 1, gen, 0, park_ns)) return 1;
+            if (!wait_word_ge(seg.seq_at(root, b), gen, park_ns))
+                return 1;
         }
     } else {
-        if (!wait_all_ge(sget, P, gen, rank, park_ns)) return 1;
+        if (!wait_complete(sget, P, gen, &seg.posted[b], park_ns))
+            return 1;
     }
 
     // ---- read/fold phase ------------------------------------------------
@@ -309,6 +346,6 @@ extern "C" int tpumpi_seg_coll(
     }
 
     __atomic_store_n(&seg.done[rank], gen, __ATOMIC_RELEASE);
-    futex_wake(Seg::word(&seg.done[rank]));
+    scan_publish(dget, P, gen, &seg.left[b]);
     return 0;
 }

@@ -39,3 +39,15 @@ def test_collseg_python_protocol_fallback():
 
 def test_collseg_two_ranks():
     _run(2)
+
+
+def test_native_path_engages_under_mpirun():
+    """The C segment hot path must actually serve mpirun process
+    ranks — asserted via the coll_seg_native_ops pvar (a silent
+    Python fallback would invalidate every small-message latency
+    claim; ref: ompi/mca/coll/sm/coll_sm_module.c:102)."""
+    prog = os.path.join(REPO, "tests", "_seg_pvar_prog.py")
+    r = mpirun_run(4, prog, timeout=200, job_timeout=150)
+    out = r.stdout.decode()
+    assert out.count("seg pvar ok") == 4, \
+        out[-1000:] + r.stderr.decode()[-1500:]
