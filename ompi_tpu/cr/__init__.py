@@ -360,6 +360,9 @@ def checkpoint_local(comm, payload: Any,
     sub.mark_complete(seq, {"rank": comm.rank, "seq": seq})
     if keep:
         sub.prune(keep)
+    # everything this snapshot covers is now durable HERE: senders
+    # may trim their logs up to these watermarks (receiver-ack GC)
+    v.mark_durable(blob["vlog"]["next_seq"], blob["replay_want"])
     return seq
 
 

@@ -61,6 +61,7 @@ RNDV = "R"
 ACK = "A"
 SYNC_ACK = "SA"
 FRAG = "F"
+VACK = "VA"        # vprotocol consumed-seq receiver ack (log GC)
 MSEG = "MG"        # segmented MATCH: vprotocol replay of payloads
 #                    larger than one transport frame (a raw MATCH
 #                    bigger than the shm ring can never be pushed;
@@ -499,6 +500,14 @@ class PmlOb1:
             self._recv_segment(rreq_id, pos, payload)
         elif kind == MSEG:
             self._handle_mseg(frag)
+        elif kind == VACK:
+            # receiver-ack for the vprotocol sender log (GC); rides
+            # the btl UNSEQUENCED — an ack must never consume a
+            # sequence slot (it would itself need logging).  Ignored
+            # unless a pessimist layer installed its handler.
+            h = getattr(self, "vack_handler", None)
+            if h is not None:
+                h(frag[1])
 
     def _handle_mseg(self, frag: tuple) -> None:
         """Reassemble a segmented replay MATCH.  Segments are

@@ -1,0 +1,256 @@
+"""tpu-dvm: a persistent distributed virtual machine for jobs.
+
+Re-design of orte-dvm (ref: orte/tools/orte-dvm/orte-dvm.c:1 — start
+the runtime once, run many jobs against the warm daemons).  On TPU
+the warm state is worth far more than daemon processes: PJRT device
+bring-up costs seconds, and every compiled collective is an XLA
+executable cached PER PROCESS — so the DVM keeps one resident pool
+process that owns the chips and runs each submitted job as
+rank-threads inside it (the hostrun execution model).  Across jobs
+the pool retains:
+
+  * the jax runtime + device handles (no PJRT re-init),
+  * the coll/device compiled-collective cache (`_compiled`,
+    `HbmCollModule._jit_cache` — keyed by device ids, not world),
+  * imported modules (no interpreter warmup).
+
+Per job everything logically job-scoped is FRESH: HybridWorld, KV
+server, session dir, communicators, pml state.  Jobs are serialized
+(one at a time — the pool owns the chips exclusively, the same
+contract as a reservation).
+
+Usage:
+    python -m ompi_tpu.tools.dvm --np 8 --uri-file /tmp/dvm.uri &
+    python -m ompi_tpu.tools.mpirun --dvm /tmp/dvm.uri -np 8 app.py
+    python -m ompi_tpu.tools.mpirun --dvm /tmp/dvm.uri -np 8 app2.py
+    python -m ompi_tpu.tools.dvm --halt /tmp/dvm.uri
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import json
+import os
+import socket
+import struct
+import sys
+import tempfile
+import threading
+import time
+import traceback
+from typing import List, Optional
+
+
+def _send(sock: socket.socket, obj: dict) -> None:
+    data = json.dumps(obj).encode()
+    sock.sendall(struct.pack(">I", len(data)) + data)
+
+
+def _recv(sock: socket.socket) -> Optional[dict]:
+    hdr = b""
+    while len(hdr) < 4:
+        c = sock.recv(4 - len(hdr))
+        if not c:
+            return None
+        hdr += c
+    (ln,) = struct.unpack(">I", hdr)
+    data = b""
+    while len(data) < ln:
+        c = sock.recv(ln - len(data))
+        if not c:
+            return None
+        data += c
+    return json.loads(data)
+
+
+class _Tee(io.TextIOBase):
+    """Captures a job's stdout/stderr for the submitting client while
+    still echoing to the DVM console."""
+
+    def __init__(self, real) -> None:
+        self.real = real
+        self.buf = io.StringIO()
+        self.lock = threading.Lock()
+
+    def write(self, s: str) -> int:
+        with self.lock:
+            self.buf.write(s)
+        self.real.write(s)
+        return len(s)
+
+    def flush(self) -> None:
+        self.real.flush()
+
+
+def run_job_inproc(np_: int, prog: str, args: List[str],
+                   devices) -> tuple:
+    """One job as rank-threads in THIS process (hostrun model), with
+    a job-private KV server and session dir.  Returns (exit_code,
+    stdout_text, stderr_text)."""
+    import runpy
+
+    from ompi_tpu.runtime.kvstore import KVServer
+    from ompi_tpu.runtime.rte import (HybridRTE, HybridWorld,
+                                      set_thread_rte)
+
+    session = tempfile.mkdtemp(prefix="dvm_job_")
+    server = KVServer(np_)
+    world = HybridWorld(np_, 0, np_)
+    jobid = f"dvm-{os.getpid()}-{int(time.time() * 1000) & 0xFFFFFF}"
+    failure: List[Optional[int]] = [None]
+    flock = threading.Lock()
+
+    def run_rank(rank: int) -> None:
+        rte = None
+        try:
+            rte = HybridRTE(world, rank, server.addr, node_id=0,
+                            jobid=jobid, session_dir=session)
+            if devices:
+                rte.default_device = devices[rank % len(devices)]
+            set_thread_rte(rte)
+            runpy.run_path(prog, run_name="__main__")
+        except SystemExit as e:
+            code = e.code if isinstance(e.code, int) else (
+                0 if e.code is None else 1)
+            if code != 0:
+                with flock:
+                    failure[0] = failure[0] or code
+        except BaseException:  # noqa: BLE001
+            sys.stderr.write(f"[dvm rank {rank}] uncaught:\n"
+                             f"{traceback.format_exc()}")
+            with flock:
+                failure[0] = failure[0] or 1
+            if world.aborted is None:
+                world.aborted = (rank, 1, "uncaught exception")
+
+    out, err = _Tee(sys.__stdout__), _Tee(sys.__stderr__)
+    old_argv = sys.argv
+    sys.argv = [prog] + list(args)
+    sys.stdout, sys.stderr = out, err
+    try:
+        threads = [threading.Thread(target=run_rank, args=(r,),
+                                    daemon=True,
+                                    name=f"dvm-rank-{r}")
+                   for r in range(np_)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        sys.stdout, sys.stderr = sys.__stdout__, sys.__stderr__
+        sys.argv = old_argv
+        server.close()
+        import shutil
+        shutil.rmtree(session, ignore_errors=True)  # the pool is
+        # long-lived: leaked per-job session dirs accumulate forever
+    return (failure[0] or 0, out.buf.getvalue(), err.buf.getvalue())
+
+
+def serve(opts) -> int:
+    devices = None
+    if opts.devices != "none":
+        import jax
+        if os.environ.get("JAX_PLATFORMS"):
+            jax.config.update("jax_platforms",
+                              os.environ["JAX_PLATFORMS"])
+        devices = jax.devices()  # PJRT bring-up happens HERE, once
+    listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    listener.bind(("127.0.0.1", 0))
+    listener.listen(8)
+    port = listener.getsockname()[1]
+    tmp = opts.uri_file + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(f"127.0.0.1:{port}\n")
+    os.replace(tmp, opts.uri_file)  # submitters never see a torn file
+    sys.stderr.write(f"tpu-dvm: ready on 127.0.0.1:{port} "
+                     f"(capacity {opts.np}, devices "
+                     f"{'warm' if devices else 'none'})\n")
+    jobs = 0
+    while True:
+        conn, _ = listener.accept()
+        try:
+            msg = _recv(conn)
+            if msg is None:
+                continue
+            if msg.get("op") == "halt":
+                _send(conn, {"ok": True, "jobs": jobs})
+                sys.stderr.write(f"tpu-dvm: halt after {jobs} jobs\n")
+                return 0
+            if msg.get("op") != "submit":
+                _send(conn, {"error": "bad op"})
+                continue
+            np_ = int(msg.get("np", opts.np))
+            if np_ > opts.np:
+                _send(conn, {"error": f"np {np_} exceeds DVM "
+                                      f"capacity {opts.np}"})
+                continue
+            t0 = time.perf_counter()
+            code, out, err = run_job_inproc(
+                np_, msg["prog"], msg.get("args") or [], devices)
+            jobs += 1
+            _send(conn, {"code": code, "stdout": out, "stderr": err,
+                         "wall_s": round(time.perf_counter() - t0, 3)})
+        except (OSError, ValueError) as e:
+            try:
+                _send(conn, {"error": str(e)[:300]})
+            except OSError:
+                pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+def submit(uri_file: str, np_: int, prog: str,
+           args: List[str]) -> int:
+    """Client side (used by mpirun --dvm)."""
+    with open(uri_file) as f:
+        host, _, port = f.read().strip().partition(":")
+    s = socket.create_connection((host, int(port)), timeout=30)
+    _send(s, {"op": "submit", "np": np_,
+              "prog": os.path.abspath(prog), "args": args})
+    s.settimeout(None)
+    resp = _recv(s)
+    s.close()
+    if resp is None or "error" in (resp or {}):
+        sys.stderr.write(f"mpirun --dvm: "
+                         f"{(resp or {}).get('error', 'no reply')}\n")
+        return 1
+    sys.stdout.write(resp.get("stdout", ""))
+    sys.stderr.write(resp.get("stderr", ""))
+    return int(resp.get("code", 1))
+
+
+def halt(uri_file: str) -> int:
+    with open(uri_file) as f:
+        host, _, port = f.read().strip().partition(":")
+    s = socket.create_connection((host, int(port)), timeout=10)
+    _send(s, {"op": "halt"})
+    resp = _recv(s)
+    s.close()
+    return 0 if resp and resp.get("ok") else 1
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(prog="tpu-dvm")
+    ap.add_argument("--np", type=int, default=8,
+                    help="rank capacity of the pool")
+    ap.add_argument("--uri-file", default=None,
+                    help="where to write the contact address")
+    ap.add_argument("--devices", default="auto",
+                    choices=("auto", "none"))
+    ap.add_argument("--halt", default=None, metavar="URI_FILE",
+                    help="stop a running DVM")
+    opts = ap.parse_args(argv)
+    if opts.halt:
+        return halt(opts.halt)
+    if not opts.uri_file:
+        ap.error("--uri-file is required to serve")
+    return serve(opts)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -635,9 +635,34 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--hnp-ip", default=None,
                     help="IP remote nodes should dial for the HNP "
                          "control + KV servers (default: auto-detect)")
+    ap.add_argument("--dvm", default=None, metavar="URI_FILE",
+                    help="submit the job to a running tpu-dvm pool "
+                         "(ompi_tpu.tools.dvm) instead of launching: "
+                         "the pool's warm jax runtime and compiled-"
+                         "collective caches carry across jobs "
+                         "(orte-dvm analog)")
     ap.add_argument("prog")
     ap.add_argument("args", nargs=argparse.REMAINDER)
     opts = ap.parse_args(argv)
+    if opts.dvm:
+        dropped = [n for n, v in (
+            ("--mca", opts.mca), ("--ckpt-dir", opts.ckpt_dir),
+            ("--restart", opts.restart), ("--hosts", opts.hosts),
+            ("--hostfile", opts.hostfile),
+            ("--simulate-nodes", opts.simulate),
+            ("--preload", opts.preload)) if v]
+        if opts.rpp not in (1, "all"):
+            # the pool always runs every rank as a thread (hostrun
+            # model); any other explicit split cannot be honored
+            dropped.append("--ranks-per-proc")
+        if dropped:
+            sys.stderr.write(
+                f"mpirun: --dvm submits to a warm pool and cannot "
+                f"honor {', '.join(dropped)} (the pool's launch "
+                f"configuration is fixed at dvm start)\n")
+            return 2
+        from ompi_tpu.tools.dvm import submit
+        return submit(opts.dvm, opts.np, opts.prog, opts.args)
     # per-job control-plane secret (sec/basic analog): KV/OOB servers
     # refuse connections without it.  setdefault so a relaunch under
     # an outer job reuses the outer credential.
@@ -663,7 +688,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                     _json.dump({"np": opts.np, "prog": opts.prog,
                                 "args": opts.args, "mca": opts.mca,
                                 "rpp": opts.rpp,
-                                "preload": opts.preload}, jf)
+                                "preload": opts.preload,
+                                # allocation + placement, so restart
+                                # replays it and orte-migrate's analog
+                                # can override per-rank placement
+                                "hosts": opts.hosts,
+                                "hostfile": opts.hostfile,
+                                "simulate": opts.simulate,
+                                "map_by": opts.map_by,
+                                "oversubscribe":
+                                    opts.oversubscribe}, jf)
             except OSError as e:
                 sys.stderr.write(
                     f"mpirun: cannot write job.json: {e}\n")

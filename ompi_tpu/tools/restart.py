@@ -22,11 +22,25 @@ def build_cmd(store_dir: str, extra: List[str]) -> List[str]:
         job = json.load(f)
     cmd = [sys.executable, "-m", "ompi_tpu.tools.mpirun",
            "-np", str(job["np"]), "--restart", store_dir]
+    # replay the recorded allocation + placement (older job.json
+    # files lack these keys: local launch, byslot)
+    if job.get("hosts"):
+        cmd += ["--hosts", job["hosts"]]
+    if job.get("hostfile"):
+        cmd += ["--hostfile", job["hostfile"]]
+    if job.get("simulate"):
+        cmd += ["--simulate-nodes", job["simulate"]]
+    if job.get("map_by") and job["map_by"] != "byslot" \
+            and not any(a == "--map-by" for a in extra):
+        cmd += ["--map-by", job["map_by"]]
+    if job.get("oversubscribe"):
+        cmd += ["--oversubscribe"]
     for k, v in job.get("mca") or []:
         cmd += ["--mca", k, v]
-    rpp = job.get("rpp", 1)
-    if rpp != 1:
-        cmd += ["--ranks-per-proc", str(rpp)]
+    # always explicit: mpirun's default is "all" (hybrid), so an
+    # rpp=1 job silently changing execution model on restart would
+    # break snapshot/rank identity assumptions
+    cmd += ["--ranks-per-proc", str(job.get("rpp", 1))]
     if job.get("preload"):
         cmd += ["--preload"]
     cmd += extra

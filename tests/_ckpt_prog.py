@@ -29,6 +29,8 @@ while state["step"] < STEPS:
     if state["step"] == crash_at and comm.rank == 2:
         os._exit(17)  # hard mid-job death (no finalize, no cleanup)
 
+node = os.environ.get("TPUMPI_NODE_NAME", "local")
+print(f"rank {comm.rank} on node {node}", flush=True)
 if comm.rank == 0:
     print(f"final step={state['step']} resumed={resumed} "
           f"acc={state['acc'].tolist()}", flush=True)

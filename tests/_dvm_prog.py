@@ -1,0 +1,30 @@
+"""DVM warm-pool probe (run via mpirun --dvm by test_launcher.py):
+times program start -> first completed device collective.  In a warm
+pool, imports, the jax runtime, and the compiled collective are all
+cache hits, so the second job's time collapses."""
+import time
+
+t0 = time.perf_counter()
+import numpy as np
+
+import ompi_tpu
+from ompi_tpu.op import op as mpi_op
+
+comm = ompi_tpu.init()
+if comm.state.device is not None:
+    import jax
+    import jax.numpy as jnp
+    x = jax.device_put(jnp.full((1024,), comm.rank + 1.0, jnp.float32),
+                       comm.state.device)
+    r = comm.allreduce_arr(x, mpi_op.SUM)
+    got = float(np.asarray(r)[0])
+else:
+    x = np.full(1024, comm.rank + 1.0, dtype=np.float32)
+    r = np.empty_like(x)
+    comm.Allreduce(x, r, mpi_op.SUM)
+    got = float(r[0])
+expect = sum(range(1, comm.size + 1))
+assert abs(got - expect) < 1e-3, (got, expect)
+if comm.rank == 0:
+    print(f"first_coll_s={time.perf_counter() - t0:.4f}", flush=True)
+ompi_tpu.finalize()

@@ -151,3 +151,54 @@ def test_kv_server_refuses_unauthenticated_connection():
             os.environ.pop("TPUMPI_JOB_SECRET", None)
         else:
             os.environ["TPUMPI_JOB_SECRET"] = old
+
+
+def test_dvm_warm_pool_second_job_faster(tmp_path):
+    """Persistent DVM (orte-dvm analog, VERDICT r4 missing #3): start
+    the pool once, submit the same job twice via mpirun --dvm.  The
+    second job rides the warm jax runtime + compiled-collective cache
+    and its time-to-first-collective must be >=5x faster."""
+    import re
+    import subprocess
+    import time as _time
+
+    uri = str(tmp_path / "dvm.uri")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                        " --xla_force_host_platform_device_count=4")
+    srv = subprocess.Popen(
+        [sys.executable, "-m", "ompi_tpu.tools.dvm", "--np", "4",
+         "--uri-file", uri], env=env,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        deadline = _time.monotonic() + 60
+        while not os.path.exists(uri):
+            assert _time.monotonic() < deadline, "DVM never came up"
+            assert srv.poll() is None, "DVM died during startup"
+            _time.sleep(0.1)
+
+        prog = os.path.join(REPO, "tests", "_dvm_prog.py")
+
+        def submit():
+            r = subprocess.run(
+                [sys.executable, "-m", "ompi_tpu.tools.mpirun",
+                 "--dvm", uri, "-np", "4", prog],
+                capture_output=True, env=env, timeout=180)
+            assert r.returncode == 0, r.stderr.decode()[-1500:]
+            m = re.search(rb"first_coll_s=([0-9.]+)", r.stdout)
+            assert m, r.stdout.decode()[-500:]
+            return float(m.group(1))
+
+        t1 = submit()
+        t2 = submit()
+        assert t2 <= t1 / 5, \
+            f"warm job not faster: cold={t1:.3f}s warm={t2:.3f}s"
+    finally:
+        subprocess.run([sys.executable, "-m", "ompi_tpu.tools.dvm",
+                        "--halt", uri], env=env, timeout=30)
+        try:
+            srv.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            srv.kill()
