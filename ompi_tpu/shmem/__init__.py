@@ -87,6 +87,17 @@ class ShmemCtx:
         self.memheap = memheap_mod.select(self.heap_size)
         self.scoll = scoll_mod.select(self)
         self._finalized = False
+        # shmem_ptr: co-resident thread-rank PEs can address each
+        # other's heaps directly — publish mine where peers look
+        world = getattr(self.comm.state.rte, "world", None)
+        if world is not None and hasattr(world, "shared"):
+            with world.shared_lock:
+                # keyed by (comm cid, global rank): a second ctx over
+                # a sub-communicator must not shadow the world ctx —
+                # a peer resolving offsets against the wrong heap
+                # would read real-looking garbage
+                world.shared[("shmem_ctx", self.comm.cid,
+                              self.comm.state.rank)] = self
 
     # -- memheap allocator (ref: oshmem/mca/memheap) --------------------
     def malloc(self, shape, dtype=np.uint8) -> SymArray:
@@ -136,6 +147,131 @@ class ShmemCtx:
         out = np.empty(1, dtype=src.dtype)
         self.win.get(out, pe, disp=src._disp(index))
         return out[0]
+
+    @staticmethod
+    def _check_strides(tst: int, sst: int) -> None:
+        # the OpenSHMEM precondition: strides are >= 1.  Zero or
+        # negative strides would address BELOW the allocation (and a
+        # negative heap index wraps to the END of the numpy slice) —
+        # silent corruption of neighboring symmetric allocations.
+        if tst < 1 or sst < 1:
+            raise ValueError(
+                f"shmem_iput/iget strides must be >= 1 "
+                f"(got tst={tst}, sst={sst})")
+
+    def iput(self, dest: SymArray, source, tst: int, sst: int,
+             nelems: int, pe: int) -> None:
+        """Strided put (shmem_iput, ref: oshmem/shmem/c/shmem_iput.c:1):
+        element i of the LOCAL ``source`` stream (stride ``sst``)
+        lands at remote index i*``tst`` of ``dest``."""
+        self._check_strides(tst, sst)
+        src = np.asarray(source, dtype=dest.dtype).reshape(-1)
+        if nelems:
+            self._check_fit(dest, dest.dtype.itemsize,
+                            (nelems - 1) * tst)
+        for i in range(nelems):
+            a = np.array([src[i * sst]], dtype=dest.dtype)
+            self.win.put(a, pe, disp=dest._disp(i * tst))
+        self.win.flush_local(pe)
+
+    def iget(self, target, src: SymArray, tst: int, sst: int,
+             nelems: int, pe: int) -> None:
+        """Strided get (shmem_iget): remote index i*``sst`` of ``src``
+        lands at index i*``tst`` of the LOCAL ``target`` array.
+        Issues every fetch, then waits once (nelems serial RTTs would
+        scale wall-clock by latency)."""
+        self._check_strides(tst, sst)
+        if not (isinstance(target, np.ndarray)
+                and target.flags.c_contiguous
+                and target.flags.writeable):
+            # np.asarray would hand the stores to a silently-dropped
+            # COPY for lists / non-contiguous views (same contract as
+            # Window.rget)
+            raise ValueError(
+                "iget target must be a writable contiguous ndarray")
+        if nelems:
+            self._check_fit(src, src.dtype.itemsize,
+                            (nelems - 1) * sst)
+        t = target.reshape(-1)
+        stage = np.empty((nelems, 1), dtype=src.dtype)
+        reqs = [self.win.rget(stage[i], pe, disp=src._disp(i * sst))
+                for i in range(nelems)]
+        for r in reqs:
+            r.wait()
+        for i in range(nelems):
+            t[i * tst] = stage[i, 0]
+
+    # -- distributed locks (ref: oshmem/shmem/c/shmem_lock.c:37+) -------
+    # The lock is ONE symmetric integer cell, interpreted as a ticket
+    # lock packed into 64 bits: low 32 = now-serving, high 32 = next
+    # ticket.  Acquisition queues FIFO (the fairness the reference's
+    # MCS-style server queue provides) through osc fetch ops on the
+    # cell's HOME PE (PE 0 — every PE must agree, and the spec makes
+    # the lock symmetric so any deterministic home works).
+
+    _LOCK_HOME = 0
+
+    def set_lock(self, lock: SymArray, timeout: float = 120.0) -> None:
+        old = self.atomic_fetch_add(lock, 0, np.int64(1) << 32,
+                                    self._LOCK_HOME)
+        my_ticket = int(old) >> 32
+        deadline = time.monotonic() + timeout
+        progress = self.comm.state.progress
+        spins = 0
+        while True:
+            cur = int(self.atomic_fetch(lock, 0, self._LOCK_HOME))
+            if (cur & 0xFFFFFFFF) == my_ticket:
+                return
+            spins += 1
+            if progress.progress() == 0:
+                # back off: the holder needs the core to release
+                time.sleep(min(0.002, 50e-6 * spins))
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"shmem_set_lock: ticket {my_ticket} never served "
+                    f"(holder dead?)")
+
+    def clear_lock(self, lock: SymArray) -> None:
+        # quiet FIRST: every put/atomic issued inside the critical
+        # section must be remotely complete EVERYWHERE before the
+        # next holder can observe the lock free — releasing first
+        # would let it read pre-critical-section values on third
+        # PEs (the reference quiets before release too)
+        self.quiet()
+        # increment now-serving: hands the lock to the next ticket
+        self.atomic_add(lock, 0, 1, self._LOCK_HOME)
+        self.win.flush(self._LOCK_HOME)
+
+    def test_lock(self, lock: SymArray) -> bool:
+        """True = lock acquired (the OpenSHMEM return convention is
+        0 on success; the Python surface speaks bool).  Acquires only
+        when nobody holds or waits — a queued test would block."""
+        cur = int(self.atomic_fetch(lock, 0, self._LOCK_HOME))
+        if (cur >> 32) != (cur & 0xFFFFFFFF):
+            return False  # held or contended
+        got = int(self.atomic_compare_swap(
+            lock, 0, cur, cur + (np.int64(1) << 32), self._LOCK_HOME))
+        return got == cur
+
+    # -- shmem_ptr (ref: oshmem/shmem/c/shmem_ptr.c) --------------------
+    def ptr(self, arr: SymArray, pe: int) -> Optional[np.ndarray]:
+        """Direct load/store access to PE ``pe``'s symmetric memory,
+        or None when the peer's heap is not addressable from here.
+        Thread-rank PEs share one address space, so the peer's heap
+        view is real; process ranks get None (their heaps are private
+        — the reference likewise returns NULL without a mapped
+        sm/xpmem segment)."""
+        if pe == self.comm.rank:
+            return arr.local
+        world = getattr(self.comm.state.rte, "world", None)
+        if world is None:
+            return None
+        peer_ctx = getattr(world, "shared", {}).get(
+            ("shmem_ctx", self.comm.cid, self.comm.group[pe]))
+        if peer_ctx is None:
+            return None
+        raw = peer_ctx.heap[arr.offset: arr.offset + arr.nbytes]
+        return raw.view(arr.dtype).reshape(arr.shape)
 
     # -- ordering (ref: oshmem quiet/fence semantics) -------------------
     def quiet(self) -> None:
@@ -248,6 +384,12 @@ class ShmemCtx:
         if self._finalized:
             return
         self.barrier_all()
+        world = getattr(self.comm.state.rte, "world", None)
+        if world is not None and hasattr(world, "shared"):
+            with world.shared_lock:
+                world.shared.pop(
+                    ("shmem_ctx", self.comm.cid,
+                     self.comm.state.rank), None)
         self.win.unlock_all()
         self.win.free()
         self._finalized = True
@@ -406,3 +548,27 @@ def or_to_all(dest, src):
 
 def xor_to_all(dest, src):
     _ctx().xor_to_all(dest, src)
+
+
+def iput(dest, source, tst, sst, nelems, pe):
+    _ctx().iput(dest, source, tst, sst, nelems, pe)
+
+
+def iget(target, src, tst, sst, nelems, pe):
+    _ctx().iget(target, src, tst, sst, nelems, pe)
+
+
+def set_lock(lock, timeout: float = 120.0):
+    _ctx().set_lock(lock, timeout)
+
+
+def clear_lock(lock):
+    _ctx().clear_lock(lock)
+
+
+def test_lock(lock):
+    return _ctx().test_lock(lock)
+
+
+def ptr(arr, pe):
+    return _ctx().ptr(arr, pe)

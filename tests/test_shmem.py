@@ -289,3 +289,112 @@ def test_scoll_rides_the_comm_coll_stack():
         return True
 
     assert shmem_ranks(3, fn) == [True] * 3
+
+
+# ---- r5 API tail: iput/iget, locks, shmem_ptr -----------------------
+
+def test_iput_iget_strided_roundtrip():
+    """shmem_iput/iget (ref: oshmem/shmem/c/shmem_iput.c): strided
+    local stream -> strided remote placement and back."""
+    def fn(ctx, comm):
+        right = (comm.rank + 1) % comm.size
+        dst = ctx.malloc(8, np.int64)
+        dst.local[:] = -1
+        ctx.barrier_all()
+        # every 2nd source element to every 2nd remote index
+        src = np.arange(8, dtype=np.int64) + 10 * comm.rank
+        ctx.iput(dst, src, tst=2, sst=2, nelems=4, pe=right)
+        ctx.barrier_all()
+        left = (comm.rank - 1) % comm.size
+        exp = np.full(8, -1, dtype=np.int64)
+        exp[::2] = (np.arange(8) + 10 * left)[::2]
+        assert (dst.local == exp).all(), (comm.rank, dst.local, exp)
+        # iget the even indices back from my right neighbor
+        got = np.full(8, -7, dtype=np.int64)
+        ctx.iget(got, dst, tst=2, sst=2, nelems=4, pe=right)
+        exp2 = np.full(8, -7, dtype=np.int64)
+        exp2[::2] = (np.arange(8) + 10 * comm.rank)[::2]
+        assert (got == exp2).all(), (comm.rank, got, exp2)
+        ctx.barrier_all()
+        return True
+
+    assert all(shmem_ranks(4, fn))
+
+
+def test_lock_mutual_exclusion_threads():
+    """Ticket-lock fairness + mutual exclusion, thread ranks: lost
+    updates from a non-atomic read-modify-write are exactly what a
+    broken lock produces."""
+    ITERS = 10
+
+    def fn(ctx, comm):
+        lock = ctx.malloc(1, np.int64)
+        counter = ctx.malloc(1, np.int64)
+        ctx.barrier_all()
+        for _ in range(ITERS):
+            ctx.set_lock(lock)
+            v = int(ctx.g(counter, 0, 0))
+            ctx.p(counter, 0, v + 1, 0)
+            ctx.win.flush(0)
+            ctx.clear_lock(lock)
+        ctx.barrier_all()
+        total = int(ctx.g(counter, 0, 0))
+        assert total == comm.size * ITERS, total
+        return True
+
+    assert all(shmem_ranks(4, fn))
+
+
+def test_test_lock_semantics():
+    def fn(ctx, comm):
+        lock = ctx.malloc(1, np.int64)
+        ctx.barrier_all()
+        if comm.rank == 0:
+            assert ctx.test_lock(lock) is True     # free -> acquired
+        comm.Barrier()
+        if comm.rank == 1:
+            assert ctx.test_lock(lock) is False    # held -> refused
+        comm.Barrier()
+        if comm.rank == 0:
+            ctx.clear_lock(lock)
+        comm.Barrier()
+        if comm.rank == 1:
+            assert ctx.test_lock(lock) is True     # free again
+            ctx.clear_lock(lock)
+        ctx.barrier_all()
+        return True
+
+    assert all(shmem_ranks(2, fn))
+
+
+def test_lock_mutual_exclusion_procs():
+    """The contended-mpirun form VERDICT r4 #6 asks for: process
+    ranks over the osc/pml stack."""
+    from ompi_tpu.testing import mpirun_run
+    prog = os.path.join(REPO, "tests", "_shmem_lock_prog.py")
+    r = mpirun_run(4, prog, timeout=240, job_timeout=200)
+    assert b"shmem lock ok: 32" in r.stdout, \
+        r.stdout.decode()[-800:] + r.stderr.decode()[-2000:]
+
+
+def test_shmem_ptr():
+    """Thread-rank PEs share an address space: ptr() is a REAL view
+    of the peer's heap (stores are visible to the peer); process
+    ranks get None (tested via the lock prog running under mpirun —
+    here the thread side)."""
+    def fn(ctx, comm):
+        x = ctx.malloc(4, np.int64)
+        x.local[:] = comm.rank
+        ctx.barrier_all()
+        peer = (comm.rank + 1) % comm.size
+        view = ctx.ptr(x, peer)
+        assert view is not None and (view == peer).all()
+        # direct store, visible to the owner after a barrier
+        view[comm.rank % 4] = 100 + comm.rank
+        ctx.barrier_all()
+        left = (comm.rank - 1) % comm.size
+        assert x.local[left % 4] == 100 + left, x.local
+        ctx.barrier_all()
+        return True
+
+    assert all(shmem_ranks(4, fn))
