@@ -352,6 +352,26 @@ class ShmModule(BTLModule):
                 events += 1
         return events
 
+    def ft_reset(self, epoch: int) -> bool:
+        """Live-recovery epoch reset: the shm module RETIRES.  Its
+        rings may still hold pre-epoch frames, and draining a stale
+        frame into a reset sequence space would poison matching —
+        post-recovery cross-process traffic rides the tcp btl, whose
+        socket teardown kills stale bytes for free.  Full teardown
+        (finalize clears the parked flag, unhooks the park callbacks
+        and doorbell fd, closes rings — recovery drops the module
+        from state.btls, so MPI_Finalize would never reach it).
+        Returns False: drop this module from service."""
+        try:
+            self.state.progress.unregister(self.progress)
+        except (AttributeError, ValueError):
+            pass
+        try:
+            self.finalize()
+        except (OSError, ValueError):
+            pass
+        return False
+
     def finalize(self) -> None:
         if self._parked is not None:
             # clear OUR parked byte first: a stale parked=1 flag makes

@@ -374,6 +374,60 @@ class TcpModule(BTLModule):
                     events += 1 if self._drain(conn) else 0
         return events
 
+    def ft_reset(self, epoch: int) -> bool:
+        """Live-recovery epoch reset (runtime/ft.py): close every
+        connection (stale pre-epoch bytes die with the sockets), open
+        a fresh listener, and advertise it under the EPOCH modex
+        namespace (the rte suffixes keys; the KV proxies cache
+        write-once modex values, so a changed address needs a new
+        name).  Returns True: the module stays in service."""
+        for conn in list(self._out.values()) + self._in:
+            try:
+                self.sel.unregister(conn.sock)
+            except (KeyError, ValueError):
+                pass
+            try:
+                self.state.progress.unregister_idle_fd(
+                    conn.sock.fileno())
+            except (OSError, ValueError):
+                pass
+            try:
+                conn.sock.close()
+            except OSError:
+                pass
+        self._out.clear()
+        self._in.clear()
+        try:
+            self.sel.unregister(self.listener)
+        except (KeyError, ValueError):
+            pass
+        try:
+            self.state.progress.unregister_idle_fd(
+                self.listener.fileno())
+        except (OSError, ValueError):
+            pass
+        try:
+            self.listener.close()
+        except OSError:
+            pass
+        if_ip = _if_ip_var.value or "127.0.0.1"
+        self.listener = socket.socket(socket.AF_INET,
+                                      socket.SOCK_STREAM)
+        self.listener.setsockopt(socket.SOL_SOCKET,
+                                 socket.SO_REUSEADDR, 1)
+        self.listener.bind((if_ip, 0))
+        self.listener.listen(self.state.size * 2)
+        self.listener.setblocking(False)
+        self.sel.register(self.listener, selectors.EVENT_READ,
+                          ("accept", None))
+        port = self.listener.getsockname()[1]
+        self.state.rte.modex_put(f"btl_tcp_addr{self._sfx}",
+                                 f"{if_ip}:{port}")
+        self.state.rte.modex_put(f"btl_tcp_addrs{self._sfx}",
+                                 [f"{if_ip}:{port}"])
+        self.state.progress.register_idle_fd(self.listener.fileno())
+        return True
+
     def finalize(self) -> None:
         # flush pending sends before closing (teardown traffic)
         for conn in self._out.values():

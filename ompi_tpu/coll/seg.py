@@ -169,6 +169,11 @@ class _Seg:
         self._off_data = self._off_pl + 32
         total = self._off_data + size * 2 * slot
         gid = f"{comm.cid}_{abs(hash(tuple(comm.group))) & 0xFFFFFFFF:08x}"
+        epoch = getattr(comm.state, "ft_epoch", 0)
+        if epoch:
+            # recovery epoch: a pre-failure segment file holds stale
+            # generation counters — attach to a fresh one
+            gid += f"_e{epoch}"
         path = os.path.join(rte.session_dir, f"coll_seg_{gid}.buf")
         creator = comm.rank == 0
         if creator and not os.path.exists(path):

@@ -782,6 +782,26 @@ class PmlOb1:
                                   None, payload)
             self._unexpected.setdefault(cid, []).append(m)
 
+    # -- live recovery (runtime/ft.py) -----------------------------------
+    def ft_reset(self) -> None:
+        """Epoch reset: drop every piece of matching and sequence
+        state.  Both ends of every channel restart at zero — the
+        snapshot all ranks reload has no in-flight traffic by quiesce
+        construction, and stale transport bytes died with their
+        connections in the btl reset that precedes this."""
+        self.inbox.clear()
+        self._send_reqs.clear()
+        self._recv_reqs.clear()
+        self._posted.clear()
+        self._unexpected.clear()
+        self._send_seq.clear()
+        self._next_seq.clear()
+        self._cant_match.clear()
+        self._mseg.clear()
+        self._replay_want.clear()
+        self.cr_sent.clear()
+        self.cr_arrived.clear()
+
     # -- cancel ----------------------------------------------------------
     def cancel_recv(self, req: RecvRequest) -> bool:
         posted = self._posted.get(req.cid, [])

@@ -1,0 +1,208 @@
+"""Live fault recovery: re-route + remap without a full job restart.
+
+Re-design of the reference's resilience pair — routed/radix ft_event
+(ref: orte/mca/routed/radix/routed_radix.c:58 — repair the daemon
+overlay on daemon loss) and rmaps/resilient
+(ref: orte/mca/rmaps/resilient/rmaps_resilient.c:76+ — remap a failed
+node's procs onto survivors) — for this framework's control plane.
+
+When a node daemon dies mid-job (policy ``errmgr_base_policy =
+recover`` with --ckpt-dir), the HNP does NOT tear the job down:
+
+  1. it relaunches the dead node's ranks on a surviving daemon with a
+     bumped RECOVERY EPOCH and TPUMPI_RESTART=1;
+  2. it publishes the epoch in the KV store, where every surviving
+     rank's watcher thread (started by mpi init) sees it and arms a
+     ``JobRecovery`` interrupt on the rank's progress engine;
+  3. each survivor's next blocking wait raises JobRecovery out of
+     whatever collective it was parked in; the application catches it
+     and calls :func:`recover`, which performs an EPOCH RESET — the
+     communication stack is rebuilt exactly the way a restarted
+     rank's init builds it fresh:
+
+       * epoch-scoped jobid (fence keys) and modex namespace (the KV
+         proxies cache write-once modex keys, so changed values get
+         NEW names instead of re-puts),
+       * transports reset (tcp: new listener + dropped connections,
+         so stale pre-epoch bytes die with their sockets; shm
+         quiesced — post-recovery cross-process traffic rides tcp,
+         whose reset story is complete),
+       * pml matching state cleared (both sides restart sequence
+         spaces at zero),
+       * endpoints re-wired from the fresh modex, per-communicator
+         caches dropped;
+
+  4. every rank — restarted and surviving — then loads the latest
+     complete snapshot (cr.restore) and resumes.  The cut line is
+     the snapshot: survivors roll back with the restarted ranks, the
+     global state is consistent, and the job finishes without paying
+     a full relaunch (the r4 recovery story) or losing the warm
+     processes of the surviving nodes.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Optional
+
+
+class JobRecovery(Exception):
+    """Raised out of a blocking wait when the HNP published a new
+    recovery epoch: the application should call :func:`recover` and
+    reload its state from the latest snapshot."""
+
+    def __init__(self, epoch: int, info: dict) -> None:
+        super().__init__(f"job recovery epoch {epoch}: "
+                         f"failed ranks {info.get('failed')}")
+        self.epoch = epoch
+        self.info = info
+
+
+def _epoch_key(epoch: int) -> str:
+    return f"ft:epoch:{epoch}"
+
+
+def start_watcher(state) -> None:
+    """Arm the per-rank epoch watcher (called by mpi init when the
+    launcher exported TPUMPI_FT_RECOVER).  A dedicated KV connection
+    blocks on the next epoch key; on arrival the rank's progress
+    engine gets an interrupt, so the next blocking wait raises
+    JobRecovery no matter what the rank was doing."""
+    from ompi_tpu.runtime.kvstore import KVClient
+
+    addr = os.environ.get("TPUMPI_KV_ADDR")
+    if not addr:
+        return
+
+    def watch() -> None:
+        try:
+            kv = KVClient(addr)
+        except OSError:
+            return
+        epoch = getattr(state, "ft_epoch", 0)
+        while True:
+            try:
+                info = kv.get(_epoch_key(epoch + 1), timeout=3600.0)
+            except (RuntimeError, OSError):
+                if getattr(state, "finalized", False):
+                    return
+                continue
+            epoch += 1
+            state.progress.interrupt = JobRecovery(epoch, info)
+            state.progress.wakeup()
+
+    t = threading.Thread(target=watch, daemon=True,
+                         name=f"ft-watcher-r{state.rank}")
+    t.start()
+    state._ft_watcher = t
+
+
+def pending(state) -> Optional[JobRecovery]:
+    """The armed-but-not-yet-raised recovery interrupt, if any."""
+    exc = state.progress.interrupt
+    return exc if isinstance(exc, JobRecovery) else None
+
+
+def wait_pending(comm, timeout: float = 60.0) -> JobRecovery:
+    """Block until the watcher arms a recovery epoch.  Used by
+    applications that caught a TRANSPORT error (a dead peer's
+    connection can fail a send before the HNP's epoch publication
+    lands) and need the epoch before they can recover."""
+    import time
+
+    deadline = time.monotonic() + timeout
+    while True:
+        exc = pending(comm.state)
+        if exc is not None:
+            return exc
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                "no recovery epoch announced — the failure was not "
+                "a recoverable daemon loss")
+        time.sleep(0.01)
+
+
+def _dbg(state, msg: str) -> None:
+    if os.environ.get("FT_DEBUG"):
+        import sys
+        print(f"[ft r{state.rank}] {msg}", file=sys.stderr, flush=True)
+
+
+def recover(comm, exc: JobRecovery) -> None:
+    """The surviving-rank epoch reset (see module docstring).  After
+    this returns, cr.restore(comm) loads the snapshot every rank —
+    restarted and surviving — resumes from."""
+    state = comm.state
+    epoch = exc.epoch
+    progress = state.progress
+    progress.interrupt = None  # disarm: recovery itself must not raise
+    state.ft_epoch = epoch
+    rte = state.rte
+
+    # 1. epoch-scoped control-plane namespaces: fences and modex keys
+    # match what the restarted ranks' init uses (their launch env
+    # carries TPUMPI_FT_EPOCH / the epoch jobid)
+    base_jobid = getattr(rte, "jobid_base", None) or rte.jobid
+    rte.jobid_base = base_jobid
+    rte.jobid = f"{base_jobid}:e{epoch}"
+    rte._fence_count = 0
+    rte.modex_epoch = epoch
+
+    # 2. transports: tcp rebuilds (new listener, fresh modex addr
+    # under the epoch namespace); shm is quiesced — its rings may
+    # still hold pre-epoch frames, and a drained stale frame with a
+    # reset sequence space would poison matching
+    keep = []
+    for m in state.btls:
+        ft = getattr(m, "ft_reset", None)
+        if ft is not None:
+            if ft(epoch):
+                keep.append(m)
+        else:
+            keep.append(m)
+    state.btls = keep
+
+    # 3. pml: clear matching + sequence state (both ends of every
+    # channel restart at zero; the snapshot line has no in-flight
+    # traffic by quiesce construction)
+    state.pml.ft_reset()
+
+    # 4. re-publish identity modex under the epoch namespace and meet
+    # the restarted ranks at their init fences (sync #1)
+    if state.device is not None:
+        rte.modex_put("device_id", int(state.device.id))
+    rte.modex_put("node_id", getattr(rte, "node_id", 0))
+    rte.modex_put("cores", os.cpu_count() or 1)
+    if getattr(state, "_seg_modex_done", False):
+        # coll/seg eligibility reads every member's (node, session)
+        # under the epoch namespace too
+        rte.modex_put("seg_session", rte.session_dir)
+    _dbg(state, "modex re-published; entering epoch fence 1")
+    rte.fence()
+    _dbg(state, "epoch fence 1 passed")
+
+    # 5. endpoints from the fresh modex; every communicator's cached
+    # transport/eligibility state is stale
+    from ompi_tpu.btl import base as btl_base
+    endpoints = btl_base.wire_endpoints(state, state.btls)
+    state.pml.add_procs(endpoints)
+    for c in state.comms.values():
+        if c is None:
+            continue
+        for k in ("_seg_eligible", "_coll_seg", "_seg_ar_plan",
+                  "_hbm_one_device", "_hbm_plans", "_device_rv",
+                  "_device_abort_check", "_oversub_verdict",
+                  "_mesh_none"):
+            # _oversub_verdict matters most: placement CHANGED (the
+            # remapped ranks oversubscribe a survivor node), and a
+            # survivor keeping the pre-failure verdict while the
+            # restarted rank computes the new one splits the comm
+            # across different collective algorithms — deadlock
+            c.__dict__.pop(k, None)
+
+    # 6. init's sync #2, then let cr.restore see the restart flag
+    _dbg(state, "endpoints rewired; entering epoch fence 2")
+    rte.fence()
+    _dbg(state, "recover complete")
+    os.environ["TPUMPI_RESTART"] = "1"

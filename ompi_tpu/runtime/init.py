@@ -78,9 +78,20 @@ def mpi_init(state: ProcState, device=None) -> ProcState:
     from ompi_tpu.pml import vprotocol as _pml_vprotocol
     state.pml = _pml_vprotocol.maybe_wrap(
         _pml_monitoring.maybe_wrap(pml_cls(state), state), state)
-    # 2. btl modules + endpoint wiring (modex happens inside init)
+    # live recovery: a restarted rank joins at a bumped epoch
+    # (runtime/ft.py); post-recovery cross-process traffic rides tcp
+    # only — the shm rings of a pre-failure epoch cannot be made
+    # stale-byte-safe, so shm stays out of an epoch>0 world
+    state.ft_epoch = int(os.environ.get("TPUMPI_FT_EPOCH", "0"))
+    # 2. btl modules + endpoint wiring (modex happens inside init).
+    # At a recovery epoch the shm COMPONENT is skipped outright — a
+    # constructed-then-dropped module would have created rings,
+    # registered callbacks and forced poll_mode for a transport the
+    # epoch never uses
     modules = []
     for c in btl_base.btl_framework.components():
+        if state.ft_epoch and getattr(c, "name", "") == "shm":
+            continue
         modules += c.init_modules(state)
     state.btls = modules
     # publish our state for inproc peers + our device assignment for
@@ -98,7 +109,15 @@ def mpi_init(state: ProcState, device=None) -> ProcState:
     # e.g. a dpm-spawned singleton vs its 8-rank parent)
     state.rte.modex_put("node_id", getattr(state.rte, "node_id", 0))
     state.rte.modex_put("cores", os.cpu_count() or 1)
+    if state.ft_epoch and os.environ.get("FT_DEBUG"):
+        import sys as _sys
+        print(f"[ft-init r{state.rank}] entering fence 1 "
+              f"(epoch {state.ft_epoch})", file=_sys.stderr, flush=True)
     state.rte.fence()
+    if state.ft_epoch and os.environ.get("FT_DEBUG"):
+        import sys as _sys
+        print(f"[ft-init r{state.rank}] fence 1 passed",
+              file=_sys.stderr, flush=True)
     endpoints = btl_base.wire_endpoints(state, modules)
     state.pml.add_procs(endpoints)
     # 3. predefined communicators: world cid 0, self cid 1.  The world
@@ -118,6 +137,12 @@ def mpi_init(state: ProcState, device=None) -> ProcState:
     # 5. final fence before returning (sync #2, ref: :833-838)
     state.rte.fence()
     state.initialized = True
+    if os.environ.get("TPUMPI_FT_RECOVER"):
+        # the launcher runs the recover errmgr policy: watch for
+        # recovery epochs so a daemon loss interrupts blocking waits
+        # instead of hanging them (runtime/ft.py)
+        from ompi_tpu.runtime import ft as _ft
+        _ft.start_watcher(state)
     return state
 
 

@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Callable, List
+from typing import Callable, List, Optional
 
 from ompi_tpu.mca.params import registry
 
@@ -46,6 +46,11 @@ class Progress:
         self._lp_callbacks: List[Callable[[], int]] = []
         self._counter = 0
         self._lock = threading.Lock()
+        # armed by the ft watcher (runtime/ft.py): the next progress
+        # sweep raises it out of whatever blocking wait the rank is
+        # parked in — the only way to interrupt a collective whose
+        # peers died.  Recovery disarms before rebuilding.
+        self.interrupt: Optional[BaseException] = None
         self.oversubscribed = _OVERSUBSCRIBED
         # Doorbell peers ring when they enqueue work for this rank, so
         # a rank parked in WaitSync wakes immediately instead of
@@ -186,6 +191,10 @@ class Progress:
         WaitSync).  An implicit sched_yield here costs a whole CFS
         quantum (~200 us measured) per call on oversubscribed hosts.
         """
+        if self.interrupt is not None:
+            exc = self.interrupt
+            self.interrupt = None
+            raise exc
         self._counter += 1
         events = 0
         for cb in list(self._callbacks):

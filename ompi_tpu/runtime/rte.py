@@ -158,12 +158,26 @@ class EnvRTE(RTE):
         self.session_dir = os.environ.get("TPUMPI_SESSION_DIR", "/tmp")
         self.kv = KVClient(os.environ["TPUMPI_KV_ADDR"])
         self._fence_count = 0
+        # live recovery (runtime/ft.py): a restarted rank joins the
+        # job at a bumped epoch — its fences and modex keys live in
+        # the epoch namespace so the KV proxies' write-once modex
+        # caches can never serve pre-failure values, and its init
+        # fences meet the survivors' recover() fences, not the
+        # long-gone originals
+        self.modex_epoch = int(os.environ.get("TPUMPI_FT_EPOCH", "0"))
+        if self.modex_epoch:
+            self.jobid_base = self.jobid
+            self.jobid = f"{self.jobid}:e{self.modex_epoch}"
 
     def modex_put(self, key: str, value: Any) -> None:
-        self.kv.put(f"modex:{self.rank}:{key}", value)
+        e = getattr(self, "modex_epoch", 0)
+        sfx = f"@e{e}" if e else ""
+        self.kv.put(f"modex:{self.rank}:{key}{sfx}", value)
 
     def modex_get(self, peer: int, key: str) -> Any:
-        return self.kv.get(f"modex:{peer}:{key}",
+        e = getattr(self, "modex_epoch", 0)
+        sfx = f"@e{e}" if e else ""
+        return self.kv.get(f"modex:{peer}:{key}{sfx}",
                            timeout=_modex_timeout_var.value)
 
     def fence(self) -> None:

@@ -54,9 +54,12 @@ _errmgr_policy_var = _params.register(
     "errmgr", "base", "policy", "abort", str,
     help="What the launcher does when a proc/daemon fails: 'abort' "
          "(first failure kills the job — the errmgr/default_hnp "
-         "policy) or 'restart' (with --ckpt-dir: relaunch the job "
-         "from the latest complete snapshot — the elastic-recovery "
-         "slice of rmaps/resilient + errmgr ft, ref: "
+         "policy), 'restart' (with --ckpt-dir: relaunch the WHOLE "
+         "job from the latest complete snapshot), or 'recover' "
+         "(with --ckpt-dir: on daemon loss, remap the dead node's "
+         "ranks onto a survivor at a bumped recovery epoch while "
+         "the job keeps running — live re-route, runtime/ft.py; "
+         "ref: rmaps_resilient.c:76+, routed_radix.c:58 and "
          "orte/mca/rmaps/resilient/rmaps_resilient.c)")
 _errmgr_max_restarts_var = _params.register(
     "errmgr", "base", "max_restarts", 2, int,
@@ -247,6 +250,9 @@ def run_multinode(opts, nodes, rpp: int, hybrid: bool) -> int:
             "TPUMPI_JOBID": f"job-{os.getpid()}",
             "TPUMPI_JOB_SECRET": os.environ["TPUMPI_JOB_SECRET"],
         }
+        if _errmgr_policy_var.value == "recover" and opts.ckpt_dir:
+            # ranks start the ft epoch watcher (runtime/ft.py)
+            job_env["TPUMPI_FT_RECOVER"] = "1"
         if hybrid:
             job_env["TPUMPI_DEVICES"] = opts.devices
         for key, value in opts.mca:
@@ -286,7 +292,89 @@ def run_multinode(opts, nodes, rpp: int, hybrid: bool) -> int:
         if info["node"] in d["done"] or d.get("drained") \
                 or sm.state in (smx.DRAINING, smx.TERMINATED):
             return  # clean teardown closes daemon channels
+        if sm.state == smx.RUNNING and try_recover(sm, info["node"]):
+            return  # job keeps running on the survivors
         sm.activate(smx.DAEMON_FAILED, node=info["node"])
+
+    def try_recover(sm, node: int) -> bool:
+        """Live fault recovery (errmgr_base_policy=recover +
+        --ckpt-dir): instead of tearing the job down, remap the dead
+        node's ranks onto a survivor at a bumped recovery epoch and
+        tell the surviving ranks to roll back to the latest snapshot
+        (runtime/ft.py; ref: orte/mca/routed/radix/routed_radix.c:58
+        ft_event + orte/mca/rmaps/resilient/rmaps_resilient.c:76+)."""
+        if _errmgr_policy_var.value != "recover" or not opts.ckpt_dir:
+            return False
+        from ompi_tpu import cr as _cr
+        try:
+            seq = _cr.Store(opts.ckpt_dir).latest_complete()
+        except OSError:
+            seq = None
+        if seq is None:
+            sys.stderr.write(
+                "mpirun: recover policy: no complete snapshot yet — "
+                "falling back to job teardown\n")
+            return False
+        hnp = d["hnp"]
+        failed = next((m for m in d["maps"]
+                       if m.node.node_id == node and m.procs), None)
+        if failed is None:
+            return False
+        with hnp.lock:
+            survivors = [nid for nid in hnp.channels if nid != node]
+        if not survivors:
+            return False
+        target = survivors[0]
+        epoch = d["ft_epoch"] = d.get("ft_epoch", 0) + 1
+        failed_ranks = []
+        for p in failed.procs:
+            failed_ranks += list(range(p.rank_base,
+                                       p.rank_base + max(1, p.nlocal)))
+        env = dict(d["job_env"])
+        env["TPUMPI_RESTART"] = "1"
+        env["TPUMPI_FT_EPOCH"] = str(epoch)
+        try:
+            with hnp.lock:
+                ch = hnp.channels[target]
+            ch.send({
+                "op": "launch", "prog": d["launched_prog"],
+                "args": opts.args, "prog_data": d.get("prog_data"),
+                "wdir": opts.wdir, "env": env,
+                "procs": [{"rank_base": p.rank_base,
+                           "nlocal": p.nlocal} for p in failed.procs],
+            })
+        except (KeyError, ConnectionError, OSError) as e:
+            sys.stderr.write(
+                f"mpirun: recover policy: relaunch on node {target} "
+                f"failed ({e}); tearing down\n")
+            return False
+        # the dead node will never report node_done, and its procs
+        # now belong to the target's map — a SECOND failure on the
+        # target must relaunch them too
+        d["done"].add(node)
+        tmap = next((m for m in d["maps"]
+                     if m.node.node_id == target), None)
+        if tmap is not None:
+            tmap.procs.extend(failed.procs)
+        failed.procs = []
+        # announce the epoch: every surviving rank's ft watcher arms
+        # a JobRecovery interrupt and rolls back to snapshot `seq`
+        srv = d["server"]
+        with srv.cv:
+            srv.data[f"ft:epoch:{epoch}"] = {
+                "epoch": epoch, "failed": failed_ranks,
+                "node": node, "target": target, "snapshot": seq}
+            srv.cv.notify_all()
+        sys.stderr.write(
+            f"mpirun: daemon on node {node} lost; recovering in "
+            f"place: re-routing ranks {failed_ranks} to node "
+            f"{target} (epoch {epoch}, snapshot {seq})\n")
+        if "state" in (opts.verbose or ""):
+            sys.stderr.write(
+                f"[mpirun:hnp:state] RUNNING -> RECOVERING "
+                f"(re-route epoch {epoch}: node {node} ranks "
+                f"{failed_ranks} -> node {target}) -> RUNNING\n")
+        return True
 
     def on_daemons_reported(sm, info):
         d["reg_timer"].cancel()
@@ -300,6 +388,15 @@ def run_multinode(opts, nodes, rpp: int, hybrid: bool) -> int:
                         msg=f"--preload: cannot read program "
                             f"{opts.prog!r}")
             return
+        d["launched_prog"] = prog
+        if opts.preload and os.path.isfile(prog) \
+                and _errmgr_policy_var.value == "recover":
+            # only the recover policy ever relaunches from d; the
+            # normal path lets HNP.launch do its own encode
+            import base64 as _b64
+            with open(prog, "rb") as _fh:
+                d["prog_data"] = _b64.b64encode(
+                    _fh.read()).decode("ascii")
         d["hnp"].launch(prog, opts.args, d["job_env"], opts.wdir,
                         preload=opts.preload)
         sm.activate(smx.RUNNING)

@@ -81,6 +81,20 @@ def spawn_node_daemon(entry: dict, hnp: str, agent: str, python: str,
                             stdout=subprocess.DEVNULL, stderr=None)
 
 
+def _die_with_parent() -> None:
+    """prctl(PR_SET_PDEATHSIG, SIGKILL) in the child: a rank must not
+    outlive its daemon (the reference's orted session bookkeeping kills
+    local procs on daemon death; a SIGKILL'd daemon here would
+    otherwise leave orphan ranks running, making daemon-loss recovery
+    ambiguous — the ranks being remapped must actually be dead)."""
+    try:
+        import ctypes
+        libc = ctypes.CDLL(None, use_errno=True)
+        libc.prctl(1, 9, 0, 0, 0)  # PR_SET_PDEATHSIG = 1, SIGKILL = 9
+    except Exception:  # noqa: BLE001
+        pass
+
+
 class _Unit:
     """One launched local unit (process) and its IOF plumbing."""
 
@@ -197,7 +211,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             try:
                 p = subprocess.Popen(cmd, env=env, cwd=msg.get("wdir"),
                                      stdout=subprocess.PIPE,
-                                     stderr=subprocess.PIPE)
+                                     stderr=subprocess.PIPE,
+                                     preexec_fn=_die_with_parent)
             except OSError as e:
                 with units_lock:
                     expected_units[0] -= 1

@@ -1,0 +1,83 @@
+"""Live daemon-loss recovery job (driven by test_failures.py).
+
+Iterates checkpointed allreduce steps.  When the test SIGKILLs a node
+daemon mid-run, the launcher's recover policy re-routes the dead
+node's ranks onto a survivor at a bumped epoch; surviving ranks catch
+JobRecovery out of whatever collective they were parked in, perform
+the epoch reset, and every rank reloads the latest snapshot.  The
+final answer must equal an uninterrupted run's."""
+import os
+import time
+
+import numpy as np
+
+import ompi_tpu
+from ompi_tpu import cr
+from ompi_tpu.op import op as mpi_op
+from ompi_tpu.runtime import ft
+
+comm = ompi_tpu.init()
+STEPS = 10
+PACE = float(os.environ.get("FT_PACE_S", "0.25"))
+
+
+def _dbg(msg):
+    if os.environ.get("FT_DEBUG"):
+        import sys
+        print(f"[prog r{comm.rank}] {msg}", file=sys.stderr,
+              flush=True)
+
+
+def load():
+    _dbg("cr.restore enter")
+    s = cr.restore(comm)
+    _dbg(f"cr.restore done (have={s is not None})")
+    if s is None:
+        return {"step": 0, "acc": np.zeros(4)}
+    return s
+
+
+state = load()
+recoveries = 0
+while state["step"] < STEPS:
+    try:
+        contrib = np.full(4, float(comm.rank + 1) * (state["step"] + 1))
+        r = np.empty(4)
+        comm.Allreduce(contrib, r, mpi_op.SUM)
+        state["acc"] = state["acc"] + r
+        state["step"] += 1
+        cr.checkpoint(comm, state, keep=3)
+        if comm.rank == 0:
+            print(f"ft: step {state['step']} done", flush=True)
+        time.sleep(PACE)  # a window for the test to kill a daemon
+    except ft.JobRecovery as e:
+        recoveries += 1
+        print(f"rank {comm.rank}: recovering (epoch {e.epoch})",
+              flush=True)
+        ft.recover(comm, e)
+        _dbg("recover returned; loading")
+        state = load()
+        _dbg(f"resuming at step {state['step']}")
+    except Exception:  # noqa: BLE001 — transport error racing the
+        #                epoch announcement (a dead peer's connection
+        #                can fail a send first)
+        if os.environ.get("FT_DEBUG"):
+            import sys
+            import traceback
+            print(f"rank {comm.rank}: transport-path error:\n"
+                  f"{traceback.format_exc()}",
+                  file=sys.stderr, flush=True)
+        exc = ft.wait_pending(comm, timeout=30.0)
+        recoveries += 1
+        print(f"rank {comm.rank}: recovering after transport error "
+              f"(epoch {exc.epoch})", flush=True)
+        ft.recover(comm, exc)
+        state = load()
+
+node = os.environ.get("TPUMPI_NODE_NAME", "local")
+print(f"rank {comm.rank} on node {node} recoveries={recoveries}",
+      flush=True)
+if comm.rank == 0:
+    print(f"final step={state['step']} acc={state['acc'].tolist()}",
+          flush=True)
+ompi_tpu.finalize()

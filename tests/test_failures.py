@@ -90,3 +90,87 @@ def test_rendezvous_stall_raises():
     finally:
         registry.set("coll_device_rendezvous_poll", 0.25)
         registry.set("coll_device_rendezvous_timeout", 300.0)
+
+
+def _find_daemon_pid(mpirun_pid: int, node_name: str):
+    """The tpud daemon process for ``node_name`` among mpirun's
+    children (simulated nodes are direct subprocesses)."""
+    for pid in os.listdir("/proc"):
+        if not pid.isdigit():
+            continue
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                cmd = f.read().decode("latin-1").split("\0")
+            with open(f"/proc/{pid}/stat") as f:
+                ppid = int(f.read().split()[3])
+        except (OSError, ValueError, IndexError):
+            continue
+        if ppid == mpirun_pid and "ompi_tpu.tools.tpud" in cmd \
+                and node_name in cmd:
+            return int(pid)
+    return None
+
+
+def test_daemon_loss_live_recovery(tmp_path):
+    """VERDICT r4 missing #1 / next #3: SIGKILL a DAEMON (not a rank)
+    mid-job under --simulate-nodes with the recover errmgr policy.
+    The job must finish with correct results WITHOUT a full relaunch:
+    the dead node's ranks are re-routed onto a survivor at a bumped
+    epoch and every rank rolls back to the latest snapshot
+    (ref: orte/mca/routed/radix/routed_radix.c:58,
+    orte/mca/rmaps/resilient/rmaps_resilient.c:76+)."""
+    prog = os.path.join(REPO, "tests", "_ft_prog.py")
+    store = str(tmp_path / "store")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ompi_tpu.tools.mpirun", "-np", "3",
+         "--simulate-nodes", "3x1", "--ranks-per-proc", "1",
+         "--ckpt-dir", store, "--timeout", "240",
+         "--verbose", "state",
+         "--mca", "errmgr_base_policy", "recover", prog],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env,
+        cwd=REPO)
+    try:
+        # wait until a few checkpointed steps exist, then kill sim1's
+        # daemon (which kills its rank via PDEATHSIG)
+        deadline = time.monotonic() + 120
+        seen = b""
+        while b"ft: step 3 done" not in seen:
+            line = proc.stdout.readline()
+            assert line or proc.poll() is None, seen.decode()[-500:]
+            seen += line
+            assert time.monotonic() < deadline, seen.decode()[-800:]
+        dpid = _find_daemon_pid(proc.pid, "sim1")
+        assert dpid is not None, "sim1 daemon not found"
+        os.kill(dpid, signal.SIGKILL)
+
+        out, err = proc.communicate(timeout=200)
+        out = seen + out
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+    text = out.decode()
+    errt = err.decode()
+    assert proc.returncode == 0, text[-1200:] + errt[-2500:]
+    # the re-route happened and was announced; NOT a whole-job restart
+    assert "recovering in place: re-routing ranks [1]" in errt, errt
+    assert "RECOVERING (re-route epoch" in errt, errt
+    assert "relaunching from snapshot" not in errt, errt
+    # a survivor actually went through the epoch reset
+    assert "recovering (epoch 1)" in text or \
+        "recovering after transport error (epoch 1)" in text, text
+    # rank 1 now lives on a surviving node (sim0 or sim2), not sim1
+    import re
+    m = re.search(r"rank 1 on node (\w+)", text)
+    assert m and m.group(1) != "sim1", text
+    # correct final answer: identical to an uninterrupted run
+    ref = mpirun_run(3, prog, timeout=240, job_timeout=200,
+                     extra=("--ckpt-dir", str(tmp_path / "ref")))
+    ref_line = [ln for ln in ref.stdout.decode().splitlines()
+                if ln.startswith("final ")][0]
+    line = [ln for ln in text.splitlines()
+            if ln.startswith("final ")][0]
+    assert line == ref_line, (line, ref_line)
