@@ -1940,12 +1940,104 @@ def _f2c(idx: int):
     return _f_handles[idx]
 
 
-MPI_Comm_c2f = MPI_Group_c2f = MPI_Op_c2f = MPI_Info_c2f = \
-    MPI_Win_c2f = MPI_File_c2f = MPI_Errhandler_c2f = \
-    MPI_Request_c2f = MPI_Message_c2f = MPI_Type_c2f = _c2f
-MPI_Comm_f2c = MPI_Group_f2c = MPI_Op_f2c = MPI_Info_f2c = \
-    MPI_Win_f2c = MPI_File_f2c = MPI_Errhandler_f2c = \
-    MPI_Request_f2c = MPI_Message_f2c = MPI_Type_f2c = _f2c
+def MPI_Comm_c2f(h) -> int:
+    """ref: ompi/mpi/c/comm_c2f.c"""
+    return _c2f(h)
+
+
+def MPI_Comm_f2c(idx: int):
+    """ref: ompi/mpi/c/comm_f2c.c"""
+    return _f2c(idx)
+
+
+def MPI_Group_c2f(h) -> int:
+    """ref: ompi/mpi/c/group_c2f.c"""
+    return _c2f(h)
+
+
+def MPI_Group_f2c(idx: int):
+    """ref: ompi/mpi/c/group_f2c.c"""
+    return _f2c(idx)
+
+
+def MPI_Op_c2f(h) -> int:
+    """ref: ompi/mpi/c/op_c2f.c"""
+    return _c2f(h)
+
+
+def MPI_Op_f2c(idx: int):
+    """ref: ompi/mpi/c/op_f2c.c"""
+    return _f2c(idx)
+
+
+def MPI_Info_c2f(h) -> int:
+    """ref: ompi/mpi/c/info_c2f.c"""
+    return _c2f(h)
+
+
+def MPI_Info_f2c(idx: int):
+    """ref: ompi/mpi/c/info_f2c.c"""
+    return _f2c(idx)
+
+
+def MPI_Win_c2f(h) -> int:
+    """ref: ompi/mpi/c/win_c2f.c"""
+    return _c2f(h)
+
+
+def MPI_Win_f2c(idx: int):
+    """ref: ompi/mpi/c/win_f2c.c"""
+    return _f2c(idx)
+
+
+def MPI_File_c2f(h) -> int:
+    """ref: ompi/mpi/c/file_c2f.c"""
+    return _c2f(h)
+
+
+def MPI_File_f2c(idx: int):
+    """ref: ompi/mpi/c/file_f2c.c"""
+    return _f2c(idx)
+
+
+def MPI_Errhandler_c2f(h) -> int:
+    """ref: ompi/mpi/c/errhandler_c2f.c"""
+    return _c2f(h)
+
+
+def MPI_Errhandler_f2c(idx: int):
+    """ref: ompi/mpi/c/errhandler_f2c.c"""
+    return _f2c(idx)
+
+
+def MPI_Request_c2f(h) -> int:
+    """ref: ompi/mpi/c/request_c2f.c"""
+    return _c2f(h)
+
+
+def MPI_Request_f2c(idx: int):
+    """ref: ompi/mpi/c/request_f2c.c"""
+    return _f2c(idx)
+
+
+def MPI_Message_c2f(h) -> int:
+    """ref: ompi/mpi/c/message_c2f.c"""
+    return _c2f(h)
+
+
+def MPI_Message_f2c(idx: int):
+    """ref: ompi/mpi/c/message_f2c.c"""
+    return _f2c(idx)
+
+
+def MPI_Type_c2f(h) -> int:
+    """ref: ompi/mpi/c/type_c2f.c"""
+    return _c2f(h)
+
+
+def MPI_Type_f2c(idx: int):
+    """ref: ompi/mpi/c/type_f2c.c"""
+    return _f2c(idx)
 
 
 def MPI_Status_c2f(status) -> List[int]:
