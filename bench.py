@@ -235,7 +235,10 @@ def main() -> None:
             json.dump({"device_us": dev, "software_us": sw,
                        "software_tuned_tcp_us": sw_tcp,
                        "northstar_per_size": per_size,
-                       "northstar_tuned_tcp_per_size": tcp_per_size},
+                       "northstar_tuned_tcp_per_size": tcp_per_size,
+                       # also persisted here so shedding it from the
+                       # 1 KiB driver line loses nothing (ADVICE r5 #4)
+                       "busbw_curve_GBs": curve},
                       f, indent=1)
     except OSError as e:
         # never let the detail dump cost us the driver's headline line

@@ -21,9 +21,11 @@ btl_tcp_component.c:304).  Differences from the reference:
 from __future__ import annotations
 
 import errno
+import random
 import selectors
 import socket
 import struct
+import time
 from collections import deque
 from typing import Dict, List, Optional
 
@@ -49,10 +51,44 @@ _advertise_all_var = registry.register(
          "dialing peers pick the best pair by reachable/weighted "
          "scoring.  Off = traffic stays on btl_tcp_if_ip only.")
 
+# -- reliable sublayer (go-back-N over the per-direction streams) -----
+# A kernel-accepted-but-undelivered frame is unrecoverable without
+# btl-level acks (the pml/bfo gap the old _reconnect docstring named):
+# every DATA frame carries a sequence number + header CRC, receivers
+# ACK cumulatively and NACK on gap/corruption, and a sender resends
+# its unacked window on a fresh connection.  Duplicates from resends
+# are absorbed by seq dedup; ordering is preserved (go-back-N never
+# delivers out of order).
+_reliable_var = registry.register(
+    "btl", "tcp", "reliable", True, bool,
+    help="Sequence-numbered idempotent retransmit + header CRC over "
+         "every tcp frame: a severed/lossy connection replays unacked "
+         "frames instead of wedging the pml.  Must match on all ranks")
+_retry_max_var = registry.register(
+    "btl", "tcp", "retry_max", 5, int,
+    help="Reconnect budget per peer connection (resets on ack "
+         "progress); exhausted = endpoint failover/BtlError")
+_retry_delay_var = registry.register(
+    "btl", "tcp", "retry_delay", 0.05, float,
+    help="Base reconnect backoff (exponential, jittered, capped 2 s)")
+_ack_frames_var = registry.register(
+    "btl", "tcp", "ack_frames", 64, int,
+    help="Receiver acks at least every N delivered frames (every "
+         "pump batch is also acked)")
+_rto_var = registry.register(
+    "btl", "tcp", "rto", 1.0, float,
+    help="Sender resends its unacked window when no ack arrives for "
+         "this long (0 disables the timer; NACKs still resend)")
+
+_RHDR = struct.Struct("<BIQ")  # rtype, wire-header crc, seq
+_T_DATA, _T_HELLO, _T_ACK, _T_NACK = 0, 1, 2, 3
+
 
 class _Conn:
     __slots__ = ("sock", "rxbuf", "txq", "txoff", "wr_registered",
-                 "peer", "reconnects", "dead")
+                 "peer", "reconnects", "dead",
+                 "tx_seq", "unacked", "last_ack_t", "rx_peer",
+                 "nacked")
 
     def __init__(self, sock: socket.socket, peer: int = -1) -> None:
         self.sock = sock
@@ -63,6 +99,12 @@ class _Conn:
         self.peer = peer          # >= 0 on outbound conns (reconnect)
         self.reconnects = 0
         self.dead = False
+        # reliable sublayer state
+        self.tx_seq = 0           # next DATA seq on this channel
+        self.unacked: deque = deque()   # (seq, frame) awaiting ack
+        self.last_ack_t = 0.0     # last ack progress (RTO base)
+        self.rx_peer = -1         # inbound: sender rank from HELLO
+        self.nacked = False       # inbound: gap seen, draining dups
 
 
 _rails_var = registry.register(
@@ -123,6 +165,16 @@ class TcpModule(BTLModule):
                             [f"{a}:{port}" for a in addrs])
         self._out: Dict[int, _Conn] = {}
         self._in: List[_Conn] = []
+        self.reliable = _reliable_var.value
+        # per-PEER receive stream state: survives connection severs
+        # (the whole point — a reconnecting sender resends its window
+        # and the expected-seq cursor dedups), dies at ft_reset
+        self._rx_expected: Dict[int, int] = {}
+        self._rx_conn: Dict[int, _Conn] = {}
+        self._rx_since_ack: Dict[int, int] = {}
+        self._delayed: list = []  # (due_t, conn, frame) injector holds
+        from ompi_tpu import ft_inject
+        self._inj = ft_inject.btl_injector(state.rank)
         # inbound sockets double as idle-selector wakeup fds: a rank
         # parked in idle_wait unblocks the moment bytes arrive
         state.progress.register_idle_fd(self.listener.fileno())
@@ -161,22 +213,48 @@ class TcpModule(BTLModule):
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         s.setblocking(False)
         conn = _Conn(s, peer=peer)
+        conn.last_ack_t = time.monotonic()
         self._out[peer] = conn
+        if self.reliable:
+            # hello-first: names our rank so the receiver keys its
+            # expected-seq cursor by PEER, not by connection — the
+            # cursor must survive severs
+            conn.txq.append(self._ctl_frame(_T_HELLO, self.rank))
+            self._sel_register(s, selectors.EVENT_READ, ("out", conn))
         return conn
+
+    @staticmethod
+    def _ctl_frame(rtype: int, seq: int) -> list:
+        return [struct.pack(">I", _RHDR.size)
+                + _RHDR.pack(rtype, 0, int(seq))]
+
+    def _sel_register(self, sock: socket.socket, events, data) -> None:
+        """register() that first purges a stale entry for a reused fd
+        number: reliable mode keeps sockets registered for their whole
+        life, so a socket closed out from under us (injected sever,
+        peer surgery) leaves a dead map entry that collides with the
+        next accept/dial landing on the same fd."""
+        key = self.sel.get_map().get(sock.fileno())
+        if key is not None and key.fileobj is not sock:
+            try:
+                self.sel.unregister(key.fileobj)
+            except (KeyError, ValueError):
+                pass
+        try:
+            self.sel.register(sock, events, data)
+        except KeyError:
+            self.sel.modify(sock, events, data)
 
     def _reconnect(self, conn: _Conn) -> bool:
         """Transport-level recovery (the failover half the endpoint
-        cannot do): dial the peer again and resend every frame not
-        yet FULLY handed to the dead socket (txq holds whole frames,
-        so resends always start on a frame boundary; the receiver's
-        half-read tail of the dead connection is superseded, and a
-        duplicated frame is absorbed by the pml — seq dedup for
-        envelopes, contiguous-coverage accounting for segments).
-        Frames the kernel accepted but never delivered are NOT
-        recoverable here — that window needs btl-level acks (the
-        pml/bfo protocol), so a gap fails stop at the receiver
-        instead of completing with a hole."""
-        if conn.peer < 0 or conn.reconnects >= 3:
+        cannot do): dial the peer again and resend on a clean frame
+        boundary.  Reliable mode resends the whole UNACKED window
+        (hello-first); frames the kernel accepted but the peer never
+        delivered are thereby recovered, and resend duplicates die at
+        the receiver's seq cursor.  Unreliable mode resends only what
+        txq still holds — the legacy best-effort path."""
+        budget = _retry_max_var.value if self.reliable else 3
+        if conn.peer < 0 or conn.reconnects >= budget:
             return False
         conn.reconnects += 1
         try:
@@ -188,6 +266,12 @@ class TcpModule(BTLModule):
             conn.sock.close()
         except OSError:
             pass
+        if self.reliable and conn.reconnects > 1:
+            # exponential backoff with jitter: don't hammer a peer
+            # that is restarting its listener
+            base = max(0.0, _retry_delay_var.value)
+            delay = min(2.0, base * (2 ** (conn.reconnects - 2)))
+            time.sleep(delay * (0.5 + random.random()))
         addr = self.state.rte.modex_get(
             conn.peer, f"btl_tcp_addr{self._sfx}")
         host, port = addr.rsplit(":", 1)
@@ -199,7 +283,25 @@ class TcpModule(BTLModule):
         s.setblocking(False)
         conn.sock = s
         conn.txoff = 0  # resend the partially-written frame whole
+        if self.reliable:
+            conn.txq = deque([self._ctl_frame(_T_HELLO, self.rank)])
+            conn.txq.extend(f for _seq, f in conn.unacked)
+            self._sel_register(s, selectors.EVENT_READ
+                               | selectors.EVENT_WRITE, ("out", conn))
+            conn.wr_registered = True
+            conn.last_ack_t = time.monotonic()
         return True
+
+    def _force_resend(self, conn: _Conn) -> None:
+        """NACK or RTO: the in-flight stream is suspect — replay the
+        unacked window on a fresh connection (clean boundaries; the
+        receiver's cursor absorbs duplicates)."""
+        if conn.dead:
+            return
+        if self._reconnect(conn):
+            self._drain(conn)
+        else:
+            self._kill_conn(conn)
 
     def _kill_conn(self, conn: _Conn) -> None:
         """Reconnects exhausted: tear the connection down fully so no
@@ -208,6 +310,7 @@ class TcpModule(BTLModule):
         failover."""
         conn.dead = True
         conn.txq.clear()
+        conn.unacked.clear()
         conn.txoff = 0
         try:
             self.sel.unregister(conn.sock)
@@ -232,13 +335,73 @@ class TcpModule(BTLModule):
         # and reconnect-resend happen on frame boundaries only, so a
         # resent stream can never start mid-frame.  The payload rides
         # as its own buffer so sendmsg gathers it copy-free.
-        frame = [struct.pack(">I", len(hdr) + plen) + hdr]
+        if self.reliable:
+            seq = conn.tx_seq
+            conn.tx_seq = seq + 1
+            frame = [struct.pack(">I", _RHDR.size + len(hdr) + plen)
+                     + _RHDR.pack(_T_DATA, wire.frame_crc(hdr), seq)
+                     + hdr]
+        else:
+            frame = [struct.pack(">I", len(hdr) + plen) + hdr]
         if plen:
             frame.append(payload
                          if isinstance(payload, (bytes, memoryview))
                          else memoryview(payload))
+        if self.reliable:
+            # the PRISTINE frame enters the retransmit window before
+            # any injection below mangles what goes on the wire —
+            # recovery must always have clean bytes to replay
+            conn.unacked.append((seq, frame))
+            if self._inj is not None \
+                    and self._inject(conn, frame, peer):
+                return
         conn.txq.append(frame)
         self._drain(conn)
+
+    def _inject(self, conn: _Conn, frame: list, peer: int) -> bool:
+        """Fault-injection hook (ompi_tpu/ft_inject): mutate how this
+        frame hits the wire.  Returns True when the frame was fully
+        handled (possibly by not sending it at all)."""
+        act = self._inj.pick(self.rail, peer)
+        if act is None:
+            return False
+        if act == "drop":
+            # never hits the wire; the receiver NACKs the gap (or the
+            # sender RTOs) and the unacked window replays it
+            return True
+        if act == "corrupt":
+            bad = bytearray(frame[0])
+            bad[-1] ^= 0xFF  # flip a bit inside the wire header span
+            conn.txq.append([bytes(bad)] + frame[1:])
+            self._drain(conn)
+            return True
+        if act == "dup":
+            conn.txq.append(frame)
+            conn.txq.append(frame)
+            self._drain(conn)
+            return True
+        if act == "reorder":
+            conn.txq.append(frame)
+            # swap the last two queued frames — never the head while a
+            # partial write is in flight (framing must stay intact)
+            if len(conn.txq) >= 2 and (len(conn.txq) > 2
+                                       or conn.txoff == 0):
+                conn.txq[-1], conn.txq[-2] = conn.txq[-2], conn.txq[-1]
+            self._drain(conn)
+            return True
+        if act == "delay":
+            self._delayed.append(
+                (time.monotonic() + self._inj.delay_s, conn, frame))
+            return True
+        if act == "sever":
+            conn.txq.append(frame)
+            self._drain(conn)
+            try:
+                conn.sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            return True
+        return False
 
     def _set_wr_interest(self, conn: _Conn) -> None:
         """Write interest only while the queue is non-empty: idle
@@ -247,9 +410,23 @@ class TcpModule(BTLModule):
         if conn.dead:
             return
         want = bool(conn.txq)
+        if self.reliable:
+            # reliable conns stay read-registered for acks (outbound)
+            # / data (inbound); only the WRITE bit toggles
+            if want == conn.wr_registered:
+                return
+            kind = "out" if conn.peer >= 0 else "in"
+            ev = selectors.EVENT_READ | (
+                selectors.EVENT_WRITE if want else 0)
+            try:
+                self.sel.modify(conn.sock, ev, (kind, conn))
+            except (KeyError, ValueError, OSError):
+                return
+            conn.wr_registered = want
+            return
         if want and not conn.wr_registered:
-            self.sel.register(conn.sock, selectors.EVENT_WRITE,
-                              ("out", conn))
+            self._sel_register(conn.sock, selectors.EVENT_WRITE,
+                               ("out", conn))
             conn.wr_registered = True
         elif not want and conn.wr_registered:
             try:
@@ -306,6 +483,14 @@ class TcpModule(BTLModule):
         self._set_wr_interest(conn)
         return sent
 
+    def _ctl_send(self, conn: _Conn, rtype: int, seq: int) -> None:
+        """Queue an ACK/NACK on an inbound conn (TCP is full duplex:
+        control rides back on the data stream's own socket)."""
+        if conn.dead:
+            return
+        conn.txq.append(self._ctl_frame(rtype, seq))
+        self._drain(conn)
+
     def _pump_rx(self, conn: _Conn) -> int:
         events = 0
         closed = False
@@ -324,19 +509,91 @@ class TcpModule(BTLModule):
         # the peer's final frags often arrive with the FIN
         buf = conn.rxbuf
         off = 0
+        delivered = 0
+        ack_due = False
+        body = frame = None
         view = memoryview(buf)
         while len(buf) - off >= 4:
             (ln,) = struct.unpack_from(">I", buf, off)
             if len(buf) - off - 4 < ln:
                 break
-            frag = wire.decode(view[off + 4:off + 4 + ln])
-            self.state.pml.inbox.append(frag)
+            body = view[off + 4:off + 4 + ln]
             off += 4 + ln
+            if not self.reliable:
+                self.state.pml.inbox.append(wire.decode(body))
+                events += 1
+                continue
+            rtype, crc, seq = _RHDR.unpack_from(body)
+            if rtype == _T_HELLO:
+                peer = int(seq)
+                conn.rx_peer = peer
+                conn.nacked = False
+                self._rx_conn[peer] = conn
+                self._rx_expected.setdefault(peer, 0)
+                # tell the (re)connecting sender where we are so it
+                # trims acked frames before replaying
+                self._ctl_send(conn, _T_ACK, self._rx_expected[peer])
+                events += 1
+                continue
+            if rtype != _T_DATA:
+                continue  # stray control on a data stream: ignore
+            frame = body[_RHDR.size:]
+            peer = conn.rx_peer
+            if peer < 0:
+                # hello-first contract violated (mixed reliable
+                # settings?): deliver untracked rather than wedge
+                self.state.pml.inbox.append(wire.decode(frame))
+                events += 1
+                continue
+            exp = self._rx_expected[peer]
+            if seq < exp:
+                # duplicate from a window replay: drop, re-ack so the
+                # sender retires it
+                ack_due = True
+                continue
+            if conn.nacked:
+                continue  # draining a known-bad tail; resend incoming
+            if seq > exp:
+                # gap — go-back-N: NACK the cursor once and drop this
+                # conn's tail; the sender replays on a fresh conn
+                self._ctl_send(conn, _T_NACK, exp)
+                conn.nacked = True
+                continue
+            try:
+                wire.check_crc(frame, crc)
+                frag = wire.decode(frame)
+            except Exception:
+                # CRC mismatch, or a decode that blew up on bytes the
+                # narrow header CRC doesn't cover (pickle bodies):
+                # corrupt at the cursor — same recovery as a gap.
+                self._ctl_send(conn, _T_NACK, exp)
+                conn.nacked = True
+                continue
+            self.state.pml.inbox.append(frag)
+            self._rx_expected[peer] = exp + 1
+            delivered += 1
             events += 1
+            n = self._rx_since_ack.get(peer, 0) + 1
+            if n >= max(1, _ack_frames_var.value):
+                self._ctl_send(conn, _T_ACK, exp + 1)
+                n = 0
+            self._rx_since_ack[peer] = n
+        # drop live sub-views before resizing the bytearray (a held
+        # export makes `del buf[:off]` raise BufferError)
+        body = frame = None  # noqa: F841
         view.release()
         if off:
             del buf[:off]
+        if self.reliable and not closed and conn.rx_peer >= 0 \
+                and (ack_due or delivered):
+            # batch-end ack: keeps the sender's window trimmed and its
+            # RTO quiet even for tiny exchanges
+            self._ctl_send(conn, _T_ACK, self._rx_expected[conn.rx_peer])
+            self._rx_since_ack[conn.rx_peer] = 0
         if closed:
+            if conn.rx_peer >= 0 \
+                    and self._rx_conn.get(conn.rx_peer) is conn:
+                del self._rx_conn[conn.rx_peer]
             try:
                 self.state.progress.unregister_idle_fd(conn.sock.fileno())
             except OSError:
@@ -349,11 +606,58 @@ class TcpModule(BTLModule):
                 conn.sock.close()
             except OSError:
                 pass
+            conn.dead = True
+        return events
+
+    def _retire(self, conn: _Conn, upto: int) -> None:
+        while conn.unacked and conn.unacked[0][0] < upto:
+            conn.unacked.popleft()
+
+    def _pump_acks(self, conn: _Conn) -> int:
+        """Sender side of the reliable channel: drain ACK/NACK frames
+        the receiver writes back on our outbound socket."""
+        events = 0
+        closed = False
+        try:
+            while True:
+                data = conn.sock.recv(65536)
+                if not data:
+                    closed = True
+                    break
+                conn.rxbuf += data
+        except (BlockingIOError, InterruptedError):
+            pass
+        except OSError:
+            closed = True
+        buf = conn.rxbuf
+        off = 0
+        now = time.monotonic()
+        resend = False
+        while len(buf) - off >= 4:
+            (ln,) = struct.unpack_from(">I", buf, off)
+            if len(buf) - off - 4 < ln:
+                break
+            rtype, _crc, seq = _RHDR.unpack_from(buf, off + 4)
+            off += 4 + ln
+            if rtype == _T_ACK:
+                self._retire(conn, seq)
+                conn.reconnects = 0  # ack progress refills the budget
+                conn.last_ack_t = now
+                events += 1
+            elif rtype == _T_NACK:
+                self._retire(conn, seq)
+                conn.last_ack_t = now
+                resend = True
+                events += 1
+        if off:
+            del buf[:off]
+        if resend or (closed and not conn.dead):
+            self._force_resend(conn)
         return events
 
     def progress(self) -> int:
         events = 0
-        for key, _mask in self.sel.select(timeout=0):
+        for key, mask in self.sel.select(timeout=0):
             kind, conn = key.data
             if kind == "accept":
                 try:
@@ -364,15 +668,50 @@ class TcpModule(BTLModule):
                 s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 c = _Conn(s)
                 self._in.append(c)
-                self.sel.register(s, selectors.EVENT_READ, ("in", c))
+                self._sel_register(s, selectors.EVENT_READ, ("in", c))
                 self.state.progress.register_idle_fd(s.fileno())
                 events += 1
             elif kind == "in":
-                events += self._pump_rx(conn)
-            elif kind == "out":
-                if conn.txq:
+                if mask & selectors.EVENT_READ:
+                    events += self._pump_rx(conn)
+                if mask & selectors.EVENT_WRITE and conn.txq \
+                        and not conn.dead:
                     events += 1 if self._drain(conn) else 0
+            elif kind == "out":
+                if mask & selectors.EVENT_READ and self.reliable:
+                    events += self._pump_acks(conn)
+                if mask & selectors.EVENT_WRITE and conn.txq \
+                        and not conn.dead:
+                    events += 1 if self._drain(conn) else 0
+        if self.reliable:
+            events += self._tick_reliable()
         return events
+
+    def _tick_reliable(self) -> int:
+        ev = 0
+        now = time.monotonic()
+        if self._delayed:
+            held = self._delayed
+            due = [e for e in held if e[0] <= now]
+            if due:
+                self._delayed = [e for e in held if e[0] > now]
+                for _t, conn, frame in due:
+                    if not conn.dead:
+                        conn.txq.append(frame)
+                        self._drain(conn)
+                        ev += 1
+        rto = _rto_var.value
+        if rto > 0:
+            for conn in list(self._out.values()):
+                if conn.dead or not conn.unacked:
+                    continue
+                if now - conn.last_ack_t > rto:
+                    # no ack progress for a full RTO: suspected loss
+                    # (or a silently severed peer socket) — replay
+                    conn.last_ack_t = now
+                    self._force_resend(conn)
+                    ev += 1
+        return ev
 
     def ft_reset(self, epoch: int) -> bool:
         """Live-recovery epoch reset (runtime/ft.py): close every
@@ -397,6 +736,13 @@ class TcpModule(BTLModule):
                 pass
         self._out.clear()
         self._in.clear()
+        # per-peer stream cursors are SEQUENCE state: the epoch
+        # restarts every channel at zero, so a surviving cursor would
+        # drop the new epoch's frames as duplicates
+        self._rx_expected.clear()
+        self._rx_conn.clear()
+        self._rx_since_ack.clear()
+        self._delayed = []
         try:
             self.sel.unregister(self.listener)
         except (KeyError, ValueError):

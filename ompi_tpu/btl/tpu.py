@@ -151,6 +151,30 @@ class TpuRndvEngine:
         self.pending[xid] = [flat, 0, nchunks, per]
         return xid
 
+    def ft_reset(self) -> None:
+        """Epoch reset (runtime/ft.py recover): every pre-epoch
+        transfer is dead — the pml sequence space restarted, so the
+        _XferHdr naming a pending entry will never be replayed, and a
+        post-recovery xid colliding with a stale entry would hand the
+        new receiver the OLD array (ADVICE r5 #1).  Drop everything
+        and re-seed the id space past every xid this incarnation ever
+        issued."""
+        top = 0
+        for xid in self.pending:
+            top = max(top, xid)
+        for xid in self._gc_tombstones:
+            top = max(top, xid)
+        # the counter itself may be past any surviving table entry
+        # (completed transfers leave no trace): peek without consuming
+        nxt = next(self._xfer_ids)
+        top = max(top, nxt - 1)
+        self.pending.clear()
+        self._restored.clear()
+        self._gc_tombstones.clear()
+        self._inflight = []
+        self.staged_bytes = 0
+        self._xfer_ids = itertools.count(top + 1)
+
     def _reap(self) -> int:
         n = 0
         alive = []

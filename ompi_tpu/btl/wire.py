@@ -35,6 +35,43 @@ _F = struct.Struct("<Bqq")           # FRAG: rreq_id pos
 _I64_MIN = -(1 << 63)
 _I64_MAX = (1 << 63) - 1
 
+# ---------------------------------------------------------------------
+# header integrity (btl/tcp reliable sublayer)
+# ---------------------------------------------------------------------
+# A flipped bit in a header field silently mis-matches a message (wrong
+# cid/tag/seq) — far worse than a payload flip, which at least lands in
+# the right buffer.  The reliable tcp layer CRCs the header span of
+# every frame; a mismatch is NACKed and the frame retransmitted.  The
+# span covers the full fixed struct per kind; pickle/unknown frames are
+# covered over min(64, len) bytes — enough to protect the dispatch
+# code byte and the object header without rescanning megabyte payloads.
+
+_HDR_SIZES = {1: _M.size, 2: _MS.size, 3: _R.size, 4: _A.size,
+              5: _SA.size, 6: _F.size}
+
+
+class CorruptFrame(ValueError):
+    """Header CRC mismatch: the frame must not reach the pml."""
+
+
+def hdr_span(frame) -> int:
+    """Bytes of ``frame`` covered by the header CRC."""
+    n = _HDR_SIZES.get(frame[0])
+    if n is None or n > len(frame):
+        return min(64, len(frame))
+    return n
+
+
+def frame_crc(frame) -> int:
+    import zlib
+    return zlib.crc32(bytes(frame[:hdr_span(frame)])) & 0xFFFFFFFF
+
+
+def check_crc(frame, crc: int) -> None:
+    if frame_crc(frame) != crc:
+        raise CorruptFrame(
+            f"wire header CRC mismatch (code byte {frame[0]})")
+
 
 def _is_buf(x) -> bool:
     """Only real byte buffers ride the binary fast path; opaque

@@ -278,41 +278,46 @@ def checkpoint(comm, payload: Any, store_dir: Optional[str] = None,
     prunes to the newest N complete snapshots (0 = keep all)."""
     store = _store_for(store_dir)
     quiesce(comm)
-    from ompi_tpu.pml.vprotocol import find as _vfind
-    _v = _vfind(comm.state.pml)
-    if _v is not None:
-        # quiesce proved every logged message consumed: the
-        # coordinated checkpoint is the pessimist log's GC point
-        _v.clear_log()
-    msgs = comm.state.pml.cr_capture()
-    blob = {
-        "payload": _encode(payload),
-        "pml_msgs": msgs,
-        "rank": comm.rank,
-    }
-    eng = getattr(comm.state, "_tpu_rndv", None)
-    if eng is not None and eng.pending:
-        # sender halves of in-flight chunked device transfers (the
-        # receiver halves are the xferhdr entries in pml_msgs)
-        blob["tpu_xfers"] = eng.cr_capture()
-    if shmem_ctx is not None:
-        blob["shmem_heap"] = shmem_ctx.heap.copy()
-        blob["shmem_alloc"] = shmem_ctx.memheap.state()
+    # quiesce stays interruptible (a recovery signal there means the
+    # snapshot can't form anyway); the capture+write phases below must
+    # not be torn by an armed ft interrupt — hold it until the
+    # snapshot is durably complete (ADVICE r5 #5)
+    with comm.state.progress.deferred_interrupts():
+        from ompi_tpu.pml.vprotocol import find as _vfind
+        _v = _vfind(comm.state.pml)
+        if _v is not None:
+            # quiesce proved every logged message consumed: the
+            # coordinated checkpoint is the pessimist log's GC point
+            _v.clear_log()
+        msgs = comm.state.pml.cr_capture()
+        blob = {
+            "payload": _encode(payload),
+            "pml_msgs": msgs,
+            "rank": comm.rank,
+        }
+        eng = getattr(comm.state, "_tpu_rndv", None)
+        if eng is not None and eng.pending:
+            # sender halves of in-flight chunked device transfers (the
+            # receiver halves are the xferhdr entries in pml_msgs)
+            blob["tpu_xfers"] = eng.cr_capture()
+        if shmem_ctx is not None:
+            blob["shmem_heap"] = shmem_ctx.heap.copy()
+            blob["shmem_alloc"] = shmem_ctx.memheap.state()
 
-    seq = np.array([store.next_seq() if comm.rank == 0 else 0],
-                   dtype=np.int64)
-    comm.Bcast(seq, root=0)
-    store.write_rank(int(seq[0]), comm.rank, blob)
-    comm.Barrier()  # every rank's file durably in place...
-    if comm.rank == 0:
-        store.mark_complete(int(seq[0]), {
-            "nprocs": comm.size,
-            "seq": int(seq[0]),
-            "jobid": os.environ.get("TPUMPI_JOBID", ""),
-        })
-        if keep:
-            store.prune(keep)
-    comm.Barrier()  # ...before anyone trusts the snapshot exists
+        seq = np.array([store.next_seq() if comm.rank == 0 else 0],
+                       dtype=np.int64)
+        comm.Bcast(seq, root=0)
+        store.write_rank(int(seq[0]), comm.rank, blob)
+        comm.Barrier()  # every rank's file durably in place...
+        if comm.rank == 0:
+            store.mark_complete(int(seq[0]), {
+                "nprocs": comm.size,
+                "seq": int(seq[0]),
+                "jobid": os.environ.get("TPUMPI_JOBID", ""),
+            })
+            if keep:
+                store.prune(keep)
+        comm.Barrier()  # ...before anyone trusts the snapshot exists
     return int(seq[0])
 
 
@@ -340,29 +345,32 @@ def checkpoint_local(comm, payload: Any,
     store = _store_for(store_dir)
     v = _vlayer(comm)
     base = v._base
-    blob = {
-        "payload": _encode(payload),
-        "vlog": v.cr_capture_vlog(),
-        "replay_want": base.cr_capture_lenient(),
-        "rank": comm.rank,
-    }
-    eng = getattr(comm.state, "_tpu_rndv", None)
-    if eng is not None and eng.pending:
-        # parked sender halves of chunked device transfers: without
-        # them a replayed _XferHdr's pulls find nothing and the
-        # receiver blocks forever (ADVICE r4).  lenient: no quiesce
-        # here, so a peer mid-pull is normal — capture the full
-        # array; a restarted receiver re-pulls from chunk 0.
-        blob["tpu_xfers"] = eng.cr_capture(lenient=True)
-    sub = Store(os.path.join(store.root, f"local_r{comm.rank}"))
-    seq = sub.next_seq()
-    sub.write_rank(seq, comm.rank, blob)
-    sub.mark_complete(seq, {"rank": comm.rank, "seq": seq})
-    if keep:
-        sub.prune(keep)
-    # everything this snapshot covers is now durable HERE: senders
-    # may trim their logs up to these watermarks (receiver-ack GC)
-    v.mark_durable(blob["vlog"]["next_seq"], blob["replay_want"])
+    # capture+write must not be torn by an armed ft interrupt
+    # (ADVICE r5 #5); held, not discarded — it fires right after
+    with comm.state.progress.deferred_interrupts():
+        blob = {
+            "payload": _encode(payload),
+            "vlog": v.cr_capture_vlog(),
+            "replay_want": base.cr_capture_lenient(),
+            "rank": comm.rank,
+        }
+        eng = getattr(comm.state, "_tpu_rndv", None)
+        if eng is not None and eng.pending:
+            # parked sender halves of chunked device transfers: without
+            # them a replayed _XferHdr's pulls find nothing and the
+            # receiver blocks forever (ADVICE r4).  lenient: no quiesce
+            # here, so a peer mid-pull is normal — capture the full
+            # array; a restarted receiver re-pulls from chunk 0.
+            blob["tpu_xfers"] = eng.cr_capture(lenient=True)
+        sub = Store(os.path.join(store.root, f"local_r{comm.rank}"))
+        seq = sub.next_seq()
+        sub.write_rank(seq, comm.rank, blob)
+        sub.mark_complete(seq, {"rank": comm.rank, "seq": seq})
+        if keep:
+            sub.prune(keep)
+        # everything this snapshot covers is now durable HERE: senders
+        # may trim their logs up to these watermarks (receiver-ack GC)
+        v.mark_durable(blob["vlog"]["next_seq"], blob["replay_want"])
     return seq
 
 

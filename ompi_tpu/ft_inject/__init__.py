@@ -1,0 +1,164 @@
+"""MCA-selectable fault-injection framework.
+
+The robustness analog of the reference's fault tooling around
+orte/mca/errmgr: a deterministic, seed-driven interposer that mangles
+traffic at well-defined choke points so every recovery path in the
+stack can be exercised on demand — never by hoping production
+misbehaves first.  Everything is driven by MCA params, so a chaos run
+is just ``mpirun --mca ft_inject_plan drop,sever --mca ft_inject_seed
+7 ...`` with zero code changes.
+
+Injection points (the framework stays passive unless a plan names it):
+
+  * btl/tcp ``send()``   — frame-level faults: ``drop``, ``delay``,
+    ``dup``, ``reorder``, ``corrupt`` (header CRC-detectable),
+    ``sever`` (connection shutdown mid-stream).  All absorbed by the
+    reliable sublayer (btl_tcp_reliable).
+  * tools/tpud           — node-level scenarios on the victim node:
+    ``daemon_kill`` (hard exit, exercising heartbeat/errmgr) and
+    ``oob_sever`` (drop the daemon↔HNP channel, exercising OOB
+    reconnect).
+  * runtime/kvstore      — ``kv_partition``: force-close the client
+    socket before ops, exercising the KV retry/backoff path.
+
+Determinism: every injector owns a ``random.Random`` seeded from
+``(ft_inject_seed, scope, rank)``, so a failing chaos run replays
+bit-for-bit from its seed.  ``ft_inject_max`` bounds total injections
+per scope so an injected job always converges to a clean stream.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Dict, List, Optional
+
+from ompi_tpu.mca.params import registry
+
+_seed_var = registry.register(
+    "ft", "inject", "seed", 0, int,
+    help="Deterministic seed for every injector's RNG (replay a "
+         "failing chaos run bit-for-bit)")
+_plan_var = registry.register(
+    "ft", "inject", "plan", "", str,
+    help="Comma list of fault classes to arm, each optionally "
+         "class:rate — e.g. 'drop:0.05,sever:0.01'.  Classes: drop, "
+         "delay, dup, reorder, corrupt, sever, daemon_kill, "
+         "oob_sever, kv_partition.  Empty = framework disabled")
+_rate_var = registry.register(
+    "ft", "inject", "rate", 0.02, float,
+    help="Default per-event injection probability for plan entries "
+         "without an explicit rate")
+_max_var = registry.register(
+    "ft", "inject", "max", 64, int,
+    help="Cap on injections per scope (0 = unlimited); a capped "
+         "injected stream always converges to a clean one")
+_skip_var = registry.register(
+    "ft", "inject", "skip", 8, int,
+    help="Skip the first N eligible events per scope so bring-up "
+         "traffic (modex, fences) establishes the job before chaos")
+_after_var = registry.register(
+    "ft", "inject", "after", 1.0, float,
+    help="Node-level scenarios (daemon_kill/oob_sever) fire this many "
+         "seconds after daemon start")
+_victim_var = registry.register(
+    "ft", "inject", "victim_node", 1, int,
+    help="Node id that hosts the daemon_kill/oob_sever scenarios")
+_delay_ms_var = registry.register(
+    "ft", "inject", "delay_ms", 20, int,
+    help="How long a 'delay'-class frame is held before hitting the "
+         "wire")
+
+BTL_CLASSES = ("drop", "delay", "dup", "reorder", "corrupt", "sever")
+NODE_CLASSES = ("daemon_kill", "oob_sever")
+
+
+def plan() -> Dict[str, float]:
+    """Parse ft_inject_plan into {class: rate}."""
+    out: Dict[str, float] = {}
+    s = _plan_var.value.strip()
+    if not s:
+        return out
+    for item in s.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        if ":" in item:
+            cls, r = item.split(":", 1)
+            out[cls.strip()] = float(r)
+        else:
+            out[item] = _rate_var.value
+    return out
+
+
+def enabled() -> bool:
+    return bool(plan())
+
+
+class _Scoped:
+    """Shared per-scope bookkeeping: deterministic rng, warm-up skip,
+    total-injection cap."""
+
+    def __init__(self, scope: str, rank: int,
+                 classes: Dict[str, float]) -> None:
+        self.classes = classes
+        self._rng = random.Random(f"{_seed_var.value}:{scope}:{rank}")
+        self._count = 0
+        self._injected = 0
+
+    def _roll(self) -> Optional[str]:
+        self._count += 1
+        if self._count <= max(0, _skip_var.value):
+            return None
+        cap = _max_var.value
+        if cap > 0 and self._injected >= cap:
+            return None
+        for cls, rate in self.classes.items():
+            if self._rng.random() < rate:
+                self._injected += 1
+                return cls
+        return None
+
+
+class BtlInjector(_Scoped):
+    @property
+    def delay_s(self) -> float:
+        return max(0, _delay_ms_var.value) / 1000.0
+
+    def pick(self, rail: int, peer: int) -> Optional[str]:
+        """One frame is about to be sent; return a fault class to
+        apply to it, or None to let it through clean."""
+        return self._roll()
+
+
+def btl_injector(rank: int) -> Optional[BtlInjector]:
+    p = {c: r for c, r in plan().items() if c in BTL_CLASSES}
+    if not p:
+        return None
+    return BtlInjector("btl", rank, p)
+
+
+class KvInjector(_Scoped):
+    def sever(self) -> bool:
+        """About to issue a KV op: True = partition first (close the
+        socket under the client's feet)."""
+        return self._roll() == "kv_partition"
+
+
+def kv_injector(rank: int) -> Optional[KvInjector]:
+    p = {c: r for c, r in plan().items() if c == "kv_partition"}
+    if not p:
+        return None
+    return KvInjector("kv", rank, p)
+
+
+def node_faults(node_id: int) -> List[str]:
+    """Node-level scenario classes armed on THIS node (the daemon
+    consults this once at startup and arms timers)."""
+    if node_id != _victim_var.value:
+        return []
+    p = plan()
+    return [c for c in NODE_CLASSES if c in p]
+
+
+def after_s() -> float:
+    return max(0.0, _after_var.value)

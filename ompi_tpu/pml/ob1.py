@@ -480,10 +480,11 @@ class PmlOb1:
                                     sreq_id, payload)
             # the envelope carries the sender's GLOBAL rank so C/R
             # bookkeeping never depends on resolving the cid locally
-            # (the comm may be freed, reserved-None, or not yet built)
-            if tag >= 0:
+            # (the comm may be freed, reserved-None, or not yet built).
+            # Count AFTER the sequence gate: transport-duplicate
+            # envelopes (reconnect resends) must not inflate arrived.
+            if self._dispatch_arrival(msg) and tag >= 0:
                 self.cr_arrived[gsrc] = self.cr_arrived.get(gsrc, 0) + 1
-            self._dispatch_arrival(msg)
         elif kind == ACK:
             _, sreq_id, rreq_id = frag
             self._send_rest(sreq_id, rreq_id)
@@ -551,11 +552,16 @@ class PmlOb1:
             payload = bytes(buf)
             msg = UnexpectedMsg(MATCH, cid, src, tag, seq,
                                 len(payload), None, payload)
-        if tag >= 0:
+        if self._dispatch_arrival(msg) and tag >= 0:
             self.cr_arrived[gsrc] = self.cr_arrived.get(gsrc, 0) + 1
-        self._dispatch_arrival(msg)
 
-    def _dispatch_arrival(self, msg: UnexpectedMsg) -> None:
+    def _dispatch_arrival(self, msg: UnexpectedMsg) -> bool:
+        """Sequence-gate an arrived envelope into matching.  Returns
+        False when the message is a transport-duplicate that will
+        never reach matching (its sequence slot was already consumed,
+        or an identical copy is already parked) — callers must NOT
+        count such arrivals in the C/R bookmark, or a reconnect
+        resend permanently poisons the quiesce sent/arrived balance."""
         key = (msg.cid, msg.src)
         if not self._matchable(msg.cid, msg.src, msg.seq):
             if msg.seq < self._next_seq.get(key, 0):
@@ -567,19 +573,22 @@ class PmlOb1:
                     # re-sequencing (its slot is already burned)
                     self._replay_want.discard(want)
                     self._match_or_buffer(msg)
-                    return
+                    return True
                 # already-consumed sequence: a reconnect-resent
                 # duplicate envelope.  Drop it — parking it in
                 # _cant_match would leak it forever (its seq can
                 # never become next; ADVICE r3 #3)
-                return
-            self._cant_match.setdefault(key, {})[msg.seq] = msg
-            return
+                return False
+            held = self._cant_match.setdefault(key, {})
+            dup = msg.seq in held
+            held[msg.seq] = msg
+            return not dup
         if self._replay_want:
             # normally-sequenced redelivery: the want entry is served
             self._replay_want.discard((msg.cid, msg.src, msg.seq))
         self._advance_seq(msg.cid, msg.src)
         self._match_or_buffer(msg)
+        return True
 
     def _match_or_buffer(self, msg: UnexpectedMsg) -> None:
         if msg.kind == MATCH_OBJ:

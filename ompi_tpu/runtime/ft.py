@@ -167,6 +167,12 @@ def recover(comm, exc: JobRecovery) -> None:
     # channel restart at zero; the snapshot line has no in-flight
     # traffic by quiesce construction)
     state.pml.ft_reset()
+    # the device-rendezvous engine's tables are sequence-space state
+    # too: a stale pending entry keyed by a reusable xid would satisfy
+    # a post-recovery pull with pre-epoch data (ADVICE r5 #1)
+    eng = getattr(state, "_tpu_rndv", None)
+    if eng is not None:
+        eng.ft_reset()
 
     # 4. re-publish identity modex under the epoch namespace and meet
     # the restarted ranks at their init fences (sync #1)

@@ -170,6 +170,12 @@ def extend_universe(state: ProcState, new_size: int) -> None:
 def mpi_finalize(state: ProcState) -> None:
     if state.finalized:
         return
+    # past this point a JobRecovery interrupt has nothing to recover
+    # and must not escape finalize as an unrelated error (ADVICE r5
+    # #5); the watcher may still arm one mid-teardown, so suppression
+    # is a standing flag, not a one-shot disarm
+    state.progress.suppress_interrupts = True
+    state.progress.interrupt = None
     # barrier, then teardown in reverse (ref: ompi_mpi_finalize.c:101)
     state.rte.fence()
     for m in state.btls:

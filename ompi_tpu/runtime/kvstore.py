@@ -22,6 +22,17 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from ompi_tpu.mca.params import registry
+
+_kv_retry_max_var = registry.register(
+    "rte", "base", "kv_retry_max", 3, int,
+    help="Retries per KV op after a transient server failure "
+         "(reconnect + resend; replies are re-awaited only for "
+         "idempotent ops)")
+_kv_retry_delay_var = registry.register(
+    "rte", "base", "kv_retry_delay", 0.05, float,
+    help="Base KV retry backoff (exponential, jittered, capped 2 s)")
+
 
 def job_secret() -> Optional[str]:
     """The per-job control-plane secret (launcher-generated,
@@ -328,13 +339,23 @@ class KVClient:
     """One per rank process.  Single socket, single lock: rank
     processes are single-threaded through the rte, and every op is
     strictly request/reply.  A second thread must NOT share this
-    client (a blocking fence would starve it on the lock)."""
+    client (a blocking fence would starve it on the lock).
+
+    Transient-fault tolerance: ops ride ``_request``, which
+    reconnects and retries with backoff against a restarted or
+    partitioned server.  A failed SEND is always retryable (the
+    server discards a partial frame on its read error); a lost REPLY
+    is retried only for idempotent ops — resending an ``incr`` or a
+    ``fence`` the server already applied would corrupt the job."""
 
     def __init__(self, addr: str) -> None:
         host, port = addr.rsplit(":", 1)
         self.addr = (host, int(port))
         self._lock = threading.Lock()
-        self._sock = self._connect()
+        self._sock: Optional[socket.socket] = self._connect()
+        from ompi_tpu import ft_inject
+        self._inj = ft_inject.kv_injector(
+            int(os.environ.get("TPUMPI_RANK", "0")))
 
     def _connect(self) -> socket.socket:
         s = socket.create_connection(self.addr, timeout=60)
@@ -354,18 +375,70 @@ class KVClient:
                     "(TPUMPI_JOB_SECRET mismatch)")
         return s
 
+    def _drop_sock(self) -> None:
+        try:
+            if self._sock is not None:
+                self._sock.close()
+        except OSError:
+            pass
+        self._sock = None
+
+    def _request(self, msg: dict, idempotent: bool = False) -> dict:
+        """One request/reply with reconnect + jittered-backoff retry
+        (see class docstring for the idempotency contract).
+        PermissionError (an OSError subclass!) is never retried — a
+        refused job secret will not improve with patience."""
+        import random
+        tries = 1 + max(0, _kv_retry_max_var.value)
+        delay = max(0.005, _kv_retry_delay_var.value)
+        last: Optional[Exception] = None
+        for attempt in range(tries):
+            if attempt:
+                time.sleep(min(2.0, delay * (2 ** (attempt - 1)))
+                           * (0.5 + random.random()))
+            with self._lock:
+                if self._inj is not None and self._inj.sever():
+                    # injected partition: close the socket under our
+                    # own feet and let the machinery below recover
+                    try:
+                        self._sock.close()
+                    except OSError:
+                        pass
+                try:
+                    if self._sock is None:
+                        self._sock = self._connect()
+                    _send_msg(self._sock, msg)
+                except PermissionError:
+                    raise
+                except OSError as e:
+                    last = e
+                    self._drop_sock()
+                    continue
+                try:
+                    resp = _recv_msg(self._sock)
+                except OSError:
+                    resp = None
+                if resp is None:
+                    self._drop_sock()
+                    if idempotent:
+                        last = ConnectionError(
+                            "kv server closed mid-reply")
+                        continue
+                    raise ConnectionError("kv server closed")
+                return resp
+        if isinstance(last, Exception):
+            raise ConnectionError(
+                f"kv server unreachable after {tries} attempts: "
+                f"{last}") from last
+        raise ConnectionError("kv server unreachable")
+
     def put(self, key: str, value: Any) -> None:
-        with self._lock:
-            _send_msg(self._sock, {"op": "put", "key": key, "value": value})
-            _recv_msg(self._sock)
+        self._request({"op": "put", "key": key, "value": value},
+                      idempotent=True)
 
     def get(self, key: str, timeout: float = 60.0) -> Any:
-        with self._lock:
-            _send_msg(self._sock, {"op": "get", "key": key,
-                                   "timeout": timeout})
-            resp = _recv_msg(self._sock)
-        if resp is None:
-            raise ConnectionError("kv server closed")
+        resp = self._request({"op": "get", "key": key,
+                              "timeout": timeout}, idempotent=True)
         if "abort" in resp:
             raise RuntimeError(f"job aborted: {resp['abort']}")
         if resp.get("timeout"):
@@ -375,33 +448,21 @@ class KVClient:
     def incr(self, key: str) -> int:
         """Atomic fetch-and-add on a server-side counter (returns the
         pre-increment value)."""
-        with self._lock:
-            _send_msg(self._sock, {"op": "incr", "key": key})
-            resp = _recv_msg(self._sock)
-        if resp is None:
-            raise ConnectionError("kv server closed")
+        resp = self._request({"op": "incr", "key": key})
         return int(resp["value"])
 
     def uncr(self, key: str, expect: int) -> bool:
         """Roll back a ticket taken with incr() (which returned
         ``expect``) — succeeds only if no later ticket was issued."""
-        with self._lock:
-            _send_msg(self._sock, {"op": "uncr", "key": key,
-                                   "expect": expect})
-            resp = _recv_msg(self._sock)
-        if resp is None:
-            raise ConnectionError("kv server closed")
+        resp = self._request({"op": "uncr", "key": key,
+                              "expect": expect})
         return bool(resp["ok"])
 
     def take(self, key: str, timeout: float = 60.0) -> Any:
         """Blocking get that atomically removes the record — one-shot
         rendezvous consumption."""
-        with self._lock:
-            _send_msg(self._sock, {"op": "take", "key": key,
-                                   "timeout": timeout})
-            resp = _recv_msg(self._sock)
-        if resp is None:
-            raise ConnectionError("kv server closed")
+        resp = self._request({"op": "take", "key": key,
+                              "timeout": timeout})
         if "abort" in resp:
             raise RuntimeError(f"job aborted: {resp['abort']}")
         if resp.get("timeout"):
@@ -410,15 +471,16 @@ class KVClient:
 
     def fence(self, fence_id: str, n: Optional[int] = None,
               weight: int = 1) -> None:
-        with self._lock:
-            msg = {"op": "fence", "id": fence_id}
-            if n is not None:
-                msg["n"] = n
-            if weight != 1:
-                msg["weight"] = weight
-            _send_msg(self._sock, msg)
-            resp = _recv_msg(self._sock)
-        if resp is None or "fence_done" not in resp:
+        msg: Dict[str, Any] = {"op": "fence", "id": fence_id}
+        if n is not None:
+            msg["n"] = n
+        if weight != 1:
+            msg["weight"] = weight
+        try:
+            resp = self._request(msg)
+        except ConnectionError as e:
+            raise RuntimeError(f"fence {fence_id} failed: {e}") from e
+        if "fence_done" not in resp:
             raise RuntimeError(f"fence {fence_id} failed: {resp}")
 
     def spawn(self, cmd: str, args: List[str], maxprocs: int,
@@ -432,29 +494,24 @@ class KVClient:
                        parent_root: int) -> int:
         """Spawn one world made of several (cmd, args, n) segments
         (MPI_Comm_spawn_multiple)."""
-        with self._lock:
-            _send_msg(self._sock, {"op": "spawn", "segments": segments,
-                                   "parent_root": parent_root})
-            resp = _recv_msg(self._sock)
-        if resp is None:
-            raise ConnectionError("kv server closed")
+        resp = self._request({"op": "spawn", "segments": segments,
+                              "parent_root": parent_root})
         if "error" in resp:
             raise RuntimeError(f"MPI_Comm_spawn: {resp['error']}")
         return int(resp["base"])
 
     def abort(self, rank: int, code: int, msg: str = "") -> None:
-        with self._lock:
-            _send_msg(self._sock, {"op": "abort", "rank": rank,
-                                   "code": code, "msg": msg})
-            _recv_msg(self._sock)
+        # best-effort by design: the job is going down anyway, and an
+        # unreachable server must not mask the original error
+        try:
+            self._request({"op": "abort", "rank": rank,
+                           "code": code, "msg": msg}, idempotent=True)
+        except (ConnectionError, OSError, RuntimeError):
+            pass
 
     # -- dfs (orte/mca/dfs/app analog: remote read-only file access) ----
     def _dfs_req(self, msg: dict) -> dict:
-        with self._lock:
-            _send_msg(self._sock, msg)
-            resp = _recv_msg(self._sock)
-        if resp is None:
-            raise ConnectionError("kv server closed")
+        resp = self._request(msg)
         if "error" in resp:
             raise OSError(f"dfs: {resp['error']}")
         return resp
@@ -476,10 +533,8 @@ class KVClient:
         self._dfs_req({"op": "dfs_close", "fd": fd})
 
     def close(self) -> None:
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        with self._lock:
+            self._drop_sock()
 
 
 class KVProxy:

@@ -21,7 +21,33 @@ import socket
 import threading
 from typing import Any, Callable, Optional
 
+from ompi_tpu.mca.params import registry
 from .kvstore import _recv_msg, _send_msg
+
+# control-plane hardening knobs (shared by tools/tpud and tools/plm;
+# registered here because both sides import oob)
+retry_max_var = registry.register(
+    "oob", "base", "retry_max", 5, int,
+    help="Daemon-side reconnect attempts after its HNP channel drops "
+         "before it gives up and kills its local procs")
+retry_delay_var = registry.register(
+    "oob", "base", "retry_delay", 0.25, float,
+    help="Base daemon reconnect backoff (exponential, jittered, "
+         "capped 5 s)")
+heartbeat_interval_var = registry.register(
+    "oob", "base", "heartbeat_interval", 2.0, float,
+    help="Seconds between daemon->HNP liveness beats (0 disables "
+         "sending)")
+heartbeat_budget_var = registry.register(
+    "oob", "base", "heartbeat_budget", 0, int,
+    help="HNP declares a daemon lost after this many missed beat "
+         "intervals — liveness by silence, not only by TCP death "
+         "(0 disables monitoring)")
+reconnect_grace_var = registry.register(
+    "oob", "base", "reconnect_grace", 0.0, float,
+    help="HNP holds EV_DAEMON_LOST this long after a channel drop, "
+         "waiting for the daemon to reconnect (0 = fire immediately, "
+         "the legacy behavior)")
 
 
 class Channel:

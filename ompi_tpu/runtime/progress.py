@@ -51,6 +51,16 @@ class Progress:
         # parked in — the only way to interrupt a collective whose
         # peers died.  Recovery disarms before rebuilding.
         self.interrupt: Optional[BaseException] = None
+        # finalize teardown sets this: a JobRecovery armed by the
+        # watcher after the app's last collective must not escape
+        # MPI_Finalize as an unrelated error — there is nothing left
+        # to recover (ADVICE r5 #5).  Once set, armed interrupts are
+        # discarded.
+        self.suppress_interrupts = False
+        # checkpoint writes bump this: the interrupt stays ARMED but
+        # is not raised until the counter drops back to zero, so a
+        # recovery signal can never tear a half-written checkpoint.
+        self.defer_interrupts = 0
         self.oversubscribed = _OVERSUBSCRIBED
         # Doorbell peers ring when they enqueue work for this rank, so
         # a rank parked in WaitSync wakes immediately instead of
@@ -78,6 +88,21 @@ class Progress:
         self._park_set: list = []
         self._park_clear: list = []
 
+    def deferred_interrupts(self):
+        """Context manager: hold any armed ft interrupt until exit.
+        Nestable; the pending exception fires on the first progress
+        sweep after the outermost exit."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _hold():
+            self.defer_interrupts += 1
+            try:
+                yield
+            finally:
+                self.defer_interrupts -= 1
+        return _hold()
+
     def register_park_hooks(self, set_cb, clear_cb) -> None:
         self._park_set.append(set_cb)
         self._park_clear.append(clear_cb)
@@ -97,7 +122,17 @@ class Progress:
             self._idle_sel = selectors.DefaultSelector()
         try:
             self._idle_sel.register(fd, selectors.EVENT_READ)
-        except (KeyError, ValueError, OSError):
+        except KeyError:
+            # stale entry for a reused fd number (a transport socket
+            # closed without unregistering — injected sever): replace
+            # it, and drop the dead owner's drain hook
+            try:
+                self._idle_sel.unregister(fd)
+                self._idle_sel.register(fd, selectors.EVENT_READ)
+            except (KeyError, ValueError, OSError):
+                return
+            self._idle_drains.pop(fd, None)
+        except (ValueError, OSError):
             return
         if drain is not None:
             self._idle_drains[fd] = drain
@@ -192,9 +227,12 @@ class Progress:
         quantum (~200 us measured) per call on oversubscribed hosts.
         """
         if self.interrupt is not None:
-            exc = self.interrupt
-            self.interrupt = None
-            raise exc
+            if self.suppress_interrupts:
+                self.interrupt = None
+            elif not self.defer_interrupts:
+                exc = self.interrupt
+                self.interrupt = None
+                raise exc
         self._counter += 1
         events = 0
         for cb in list(self._callbacks):

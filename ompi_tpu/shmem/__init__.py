@@ -227,9 +227,32 @@ class ShmemCtx:
                 # back off: the holder needs the core to release
                 time.sleep(min(0.002, 50e-6 * spins))
             if time.monotonic() > deadline:
+                self._retire_ticket(lock, my_ticket)
                 raise TimeoutError(
                     f"shmem_set_lock: ticket {my_ticket} never served "
                     f"(holder dead?)")
+
+    def _retire_ticket(self, lock: SymArray, my_ticket: int) -> None:
+        """A timed-out waiter must not leave its ticket in the queue:
+        once now-serving reaches it nobody would ever bump past it and
+        every later PE wedges forever (ADVICE r5 #3).  Two retirement
+        paths: (a) no later ticket was issued — CAS the allocation
+        back so our number is never served; (b) our ticket is already
+        (or just became) the one being served — pass the grant
+        straight to the next waiter, exactly like clear_lock."""
+        cur = int(self.atomic_fetch(lock, 0, self._LOCK_HOME))
+        if (cur >> 32) == my_ticket + 1 \
+                and (cur & 0xFFFFFFFF) <= my_ticket:
+            got = int(self.atomic_compare_swap(
+                lock, 0, cur, cur - (np.int64(1) << 32),
+                self._LOCK_HOME))
+            if got == cur:
+                return  # allocation rolled back; nobody will serve us
+            cur = int(self.atomic_fetch(lock, 0, self._LOCK_HOME))
+        if (cur & 0xFFFFFFFF) == my_ticket:
+            # we were granted while abandoning: release immediately
+            self.atomic_add(lock, 0, 1, self._LOCK_HOME)
+            self.win.flush(self._LOCK_HOME)
 
     def clear_lock(self, lock: SymArray) -> None:
         # quiet FIRST: every put/atomic issued inside the critical

@@ -223,11 +223,19 @@ def run_multinode(opts, nodes, rpp: int, hybrid: bool) -> int:
                  active={m.node.node_id for m in maps if m.procs})
 
         # per-node daemon env: simulator nodes get a fake M-chip mesh
-        # via a forced M-device CPU platform (ras/simulator analog)
+        # via a forced M-device CPU platform (ras/simulator analog).
+        # MCA env reaches the DAEMONS too — heartbeat, oob retry and
+        # ft_inject knobs are read by tpud itself, not only by ranks
+        mca_env = {
+            **{k: v for k, v in os.environ.items()
+               if k.startswith(("TPUMPI_MCA_", "OMPI_MCA_"))},
+            **{f"TPUMPI_MCA_{k}": v for k, v in opts.mca},
+        }
         node_env = {}
         for n in nodes:
             env = {"TPUMPI_JOB_SECRET":
-                   os.environ["TPUMPI_JOB_SECRET"]}
+                   os.environ["TPUMPI_JOB_SECRET"],
+                   **mca_env}
             if n.simulated and opts.devices != "none":
                 env["JAX_PLATFORMS"] = "cpu"
                 flags = os.environ.get("XLA_FLAGS", "")
@@ -334,9 +342,7 @@ def run_multinode(opts, nodes, rpp: int, hybrid: bool) -> int:
         env["TPUMPI_RESTART"] = "1"
         env["TPUMPI_FT_EPOCH"] = str(epoch)
         try:
-            with hnp.lock:
-                ch = hnp.channels[target]
-            ch.send({
+            hnp.send_launch(target, {
                 "op": "launch", "prog": d["launched_prog"],
                 "args": opts.args, "prog_data": d.get("prog_data"),
                 "wdir": opts.wdir, "env": env,

@@ -77,9 +77,23 @@ class HNP:
         self.daemon_procs: List[subprocess.Popen] = []
         self.tag_output = False
         self._stop = False
+        # liveness-by-silence state: any traffic from a registered
+        # daemon refreshes its stamp; the monitor (heartbeat_budget>0)
+        # declares a daemon lost after budget*interval of silence
+        self._last_beat: Dict[int, float] = {}
+        self._beat_dead: set = set()
+        self._grace_timers: Dict[int, threading.Timer] = {}
+        # every launch sent per node, for idempotent replay after a
+        # daemon reconnect (the daemon dedups by lid): a launch lost
+        # in a sever window must not strand the node rankless
+        self._sent_launches: Dict[int, List[dict]] = {}
         self._accept_thread = threading.Thread(target=self._accept_loop,
                                                daemon=True)
         self._accept_thread.start()
+        if oob.heartbeat_budget_var.value > 0 \
+                and oob.heartbeat_interval_var.value > 0:
+            threading.Thread(target=self._beat_monitor,
+                             daemon=True).start()
 
     # ---- daemon spawn + registration -------------------------------
     def addr_for(self, hnp_ip: str) -> str:
@@ -120,9 +134,34 @@ class HNP:
                     # registration fast, but never abort a running
                     # job over it (could be a stray probe)
                     self.events.activate("EV_CONN_LOST")
-                else:
-                    with self.lock:
+                    return
+                with self.lock:
+                    # a reconnected channel may already have replaced
+                    # this one: only the CURRENT channel's death means
+                    # anything
+                    if len(_holder) > 1 \
+                            and self.channels.get(node) is _holder[1]:
                         self.channels.pop(node, None)
+                        self._last_beat.pop(node, None)
+                    elif len(_holder) > 1:
+                        return
+                    if node in self._beat_dead:
+                        return  # beat monitor already declared it
+                grace = oob.reconnect_grace_var.value
+                if grace > 0:
+                    # hold the verdict: a daemon surviving a transient
+                    # channel drop re-registers within the grace and
+                    # the job never notices
+                    t = threading.Timer(grace, self._grace_expire,
+                                        args=(node,))
+                    t.daemon = True
+                    with self.lock:
+                        old = self._grace_timers.pop(node, None)
+                        self._grace_timers[node] = t
+                    if old is not None:
+                        old.cancel()
+                    t.start()
+                else:
                     self.events.activate("EV_DAEMON_LOST", node=node)
 
             ch = oob.Channel(conn, handle, on_close)
@@ -157,7 +196,25 @@ class HNP:
                 # holder[1] is the Channel (appended in _accept_loop)
                 if len(holder) > 1:
                     self.channels[node] = holder[1]
-            self.events.activate("EV_DAEMON_UP", node=node)
+                self._last_beat[node] = time.monotonic()
+                self._beat_dead.discard(node)
+                timer = self._grace_timers.pop(node, None)
+            if timer is not None:
+                timer.cancel()  # reconnected within the grace window
+            if msg.get("reconnect"):
+                # replay every launch this node was ever sent; any it
+                # already acted on is deduplicated daemon-side by lid
+                with self.lock:
+                    replay = list(self._sent_launches.get(node, ()))
+                for m in replay:
+                    try:
+                        holder[1].send(m)
+                    except (IndexError, ConnectionError, OSError):
+                        break
+            else:
+                self.events.activate("EV_DAEMON_UP", node=node)
+        elif op == "beat":
+            pass  # liveness stamped below for every registered op
         elif op == "iof":
             out = sys.stdout.buffer if msg["stream"] == "out" \
                 else sys.stderr.buffer
@@ -174,8 +231,61 @@ class HNP:
                     error=msg.get("error", ""))
         elif op == "node_done":
             self.events.activate("EV_NODE_DONE", node=msg["node"])
+        node = holder[0]
+        if node is not None:
+            # ANY traffic from a registered daemon proves liveness —
+            # beats just guarantee a minimum rate during quiet phases
+            with self.lock:
+                if node in self._last_beat:
+                    self._last_beat[node] = time.monotonic()
+
+    def _grace_expire(self, node: int) -> None:
+        with self.lock:
+            self._grace_timers.pop(node, None)
+            back = node in self.channels
+        if not back and not self._stop:
+            self.events.activate("EV_DAEMON_LOST", node=node)
+
+    def _beat_monitor(self) -> None:
+        iv = oob.heartbeat_interval_var.value
+        budget = oob.heartbeat_budget_var.value
+        while not self._stop:
+            time.sleep(iv / 2)
+            now = time.monotonic()
+            with self.lock:
+                stale = [n for n, t in self._last_beat.items()
+                         if now - t > budget * iv
+                         and n not in self._beat_dead]
+            for node in stale:
+                with self.lock:
+                    if node in self._beat_dead \
+                            or node not in self._last_beat:
+                        continue
+                    self._beat_dead.add(node)
+                    self._last_beat.pop(node, None)
+                    ch = self.channels.pop(node, None)
+                if self._stop:
+                    return
+                sys.stderr.write(
+                    f"mpirun: daemon on node {node} missed {budget} "
+                    f"heartbeats ({budget * iv:.1f}s silent); "
+                    f"declaring it lost\n")
+                if ch is not None:
+                    ch.close()  # marks _closed: on_close won't double-fire
+                self.events.activate("EV_DAEMON_LOST", node=node)
 
     # ---- job launch + supervision ----------------------------------
+    def send_launch(self, node: int, msg: dict) -> None:
+        """Send one launch message to ``node``, recording it for
+        replay should the daemon's channel drop and reconnect.  The
+        lid makes the replay idempotent daemon-side."""
+        with self.lock:
+            sent = self._sent_launches.setdefault(node, [])
+            msg.setdefault("lid", f"launch:{node}:{len(sent)}")
+            sent.append(msg)
+            ch = self.channels[node]  # KeyError if the daemon is gone
+        ch.send(msg)
+
     def launch(self, prog: str, args: List[str],
                env: Dict[str, str], wdir: Optional[str],
                preload: bool = False) -> None:
@@ -195,9 +305,7 @@ class HNP:
                 continue
             nid = m.node.node_id
             try:
-                with self.lock:
-                    ch = self.channels[nid]
-                ch.send({
+                self.send_launch(nid, {
                     "op": "launch", "prog": prog, "args": args,
                     "prog_data": prog_data,
                     "wdir": wdir, "env": env,

@@ -25,6 +25,7 @@ import argparse
 import base64
 import json
 import os
+import queue
 import shlex
 import subprocess
 import sys
@@ -133,20 +134,32 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     proxies: list = []
 
-    def report(msg: dict) -> None:
+    seen_lids: set = set()
+
+    def report(msg: dict) -> bool:
+        """Best-effort send; returns False when the channel is down
+        so state-bearing messages (proc_exit, node_done) can be
+        re-offered after the reconnect instead of silently lost."""
         ch = chan_box[0]
         if ch is None:
-            return
+            return False
         try:
             ch.send(msg)
+            return True
         except (ConnectionError, OSError):
-            pass
+            return False
 
     def forward_iof(stream, tag: str, which: str) -> None:
         try:
             for line in iter(stream.readline, b""):
-                report({"op": "iof", "tag": tag, "stream": which,
-                        "data": line.decode("latin-1")})
+                msg = {"op": "iof", "tag": tag, "stream": which,
+                       "data": line.decode("latin-1")}
+                # a line is user output, not telemetry: hold it across
+                # a channel drop and re-offer after the reconnect
+                while not report(msg):
+                    if done.is_set() or killed.is_set():
+                        return
+                    time.sleep(0.05)
         except (OSError, ValueError):
             pass
 
@@ -241,20 +254,70 @@ def main(argv: Optional[List[str]] = None) -> int:
             if p.poll() is None:
                 p.kill()
 
+    launch_q: "queue.Queue[dict]" = queue.Queue()
+
     def handle(msg: dict) -> None:
         op = msg.get("op")
         if op == "launch":
-            launch(msg)
+            # the HNP replays launches after a reconnect (a launch in
+            # flight during a channel drop is otherwise lost): dedup
+            # by lid so a replayed launch never double-spawns
+            lid = msg.get("lid")
+            if lid is not None:
+                if lid in seen_lids:
+                    return
+                seen_lids.add(lid)
+            # hand off to the MAIN loop: PR_SET_PDEATHSIG fires when
+            # the forking THREAD dies, so ranks must never be forked
+            # from a channel reader thread (a severed channel would
+            # SIGKILL every rank on the node)
+            launch_q.put(msg)
         elif op == "kill":
             kill_local()
             done.set()
         elif op == "exit":
             done.set()
 
-    def on_close(_exc) -> None:
-        # HNP died: orphaned daemons must not leak procs
+    def register_msg(reconnect: bool = False) -> dict:
+        m = {"op": "register", "node": opts.node, "name": opts.name,
+             "if_ip": if_ip,
+             "secret": os.environ.get("TPUMPI_JOB_SECRET", "")}
+        if reconnect:
+            m["reconnect"] = True
+        return m
+
+    def _reconnect_hnp() -> None:
+        """The HNP channel dropped but nobody told us to die: a
+        transient network fault (or injected sever) must not take the
+        node's ranks with it.  Exponential backoff + jitter within a
+        retry budget; only an exhausted budget falls back to the
+        orphan-kill behavior."""
+        import random
+        delay = max(0.01, oob.retry_delay_var.value)
+        for _ in range(max(1, oob.retry_max_var.value)):
+            if done.is_set() or killed.is_set():
+                return
+            time.sleep(delay * (0.5 + random.random()))
+            delay = min(5.0, delay * 2)
+            try:
+                ch = oob.connect(opts.hnp, handle, on_close, timeout=10)
+                ch.send(register_msg(reconnect=True))
+            except (ConnectionError, OSError):
+                continue
+            chan_box[0] = ch
+            return
+        sys.stderr.write(f"tpud[{opts.name}]: HNP unreachable after "
+                         f"{oob.retry_max_var.value} reconnect "
+                         f"attempts; killing local procs\n")
         kill_local()
         done.set()
+
+    def on_close(_exc) -> None:
+        if done.is_set() or killed.is_set():
+            done.set()
+            return
+        # reconnect off the dying reader thread
+        threading.Thread(target=_reconnect_hnp, daemon=True).start()
 
     try:
         chan = oob.connect(opts.hnp, handle, on_close)
@@ -273,16 +336,49 @@ def main(argv: Optional[List[str]] = None) -> int:
         children.append(spawn_node_daemon(
             entry, opts.hnp, opts.agent, opts.python, opts.pythonpath))
 
-    chan.send({"op": "register", "node": opts.node, "name": opts.name,
-               "if_ip": if_ip,
-               "secret": os.environ.get("TPUMPI_JOB_SECRET", "")})
+    chan.send(register_msg())
+
+    # fault injection: node-level chaos scenarios armed by MCA plan
+    # (ompi_tpu/ft_inject) — only on the configured victim node
+    from ompi_tpu import ft_inject
+    for fault in ft_inject.node_faults(opts.node):
+        if fault == "daemon_kill":
+            # hard exit, no cleanup: PDEATHSIG reaps the ranks, the
+            # HNP learns via heartbeat silence / channel death
+            t = threading.Timer(ft_inject.after_s(),
+                                lambda: os._exit(137))
+        else:  # oob_sever: drop the channel WITHOUT marking it
+            # closed, so on_close fires and the reconnect path runs
+            def _sever() -> None:
+                ch = chan_box[0]
+                if ch is not None:
+                    try:
+                        ch.sock.shutdown(2)  # SHUT_RDWR
+                    except OSError:
+                        pass
+            t = threading.Timer(ft_inject.after_s(), _sever)
+        t.daemon = True
+        t.start()
 
     # monitor loop: report unit exits; finish when every unit the
     # launch message promised has been spawned AND exited (guards the
     # race where the first unit dies while later ones are still being
     # spawned on the OOB reader thread)
+    hb_iv = oob.heartbeat_interval_var.value
+    next_beat = time.monotonic() + hb_iv if hb_iv > 0 else None
     while not done.is_set():
         time.sleep(0.02)
+        while True:
+            try:
+                launch(launch_q.get_nowait())
+            except queue.Empty:
+                break
+        if next_beat is not None and time.monotonic() >= next_beat:
+            # liveness beat: lets the HNP detect a wedged/killed
+            # daemon by SILENCE (budget * interval) instead of
+            # waiting for kernel TCP death, which can take minutes
+            report({"op": "beat", "node": opts.node})
+            next_beat = time.monotonic() + hb_iv
         with units_lock:
             snapshot = list(units)
             expected = expected_units[0]
@@ -292,12 +388,15 @@ def main(argv: Optional[List[str]] = None) -> int:
             if code is None:
                 alive += 1
             elif not u.reported:
-                u.reported = True
-                report({"op": "proc_exit", "tag": u.tag, "code": code})
+                # only mark delivered on success: a proc_exit lost in
+                # a channel-drop window is re-offered next tick, after
+                # the reconnect
+                u.reported = report({"op": "proc_exit", "tag": u.tag,
+                                     "code": code})
         if expected > 0 and len(snapshot) == expected and alive == 0 \
                 and not killed.is_set():
-            report({"op": "node_done", "node": opts.node})
-            break
+            if report({"op": "node_done", "node": opts.node}):
+                break
 
     # Tree children have their own direct HNP channels: on clean local
     # completion they exit when the HNP tells them (exit/kill), or via
@@ -310,7 +409,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         time.sleep(0.05)
     import shutil
     shutil.rmtree(session, ignore_errors=True)
-    chan.close()
+    done.set()  # a reconnect attempt racing teardown must stand down
+    ch = chan_box[0]
+    if ch is not None:
+        ch.close()
     return 0
 
 

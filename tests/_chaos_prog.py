@@ -1,0 +1,68 @@
+"""Chaos workload (run under mpirun by test_chaos.py with a fault
+plan armed): deterministic p2p + collectives + checkpoint whose
+result digest must be byte-identical to an uninjected run.  Any
+undetected frame corruption, lost message, or duplicated delivery
+changes the digest; any unabsorbed fault hangs or kills the job."""
+import hashlib
+import os
+
+import numpy as np
+
+import ompi_tpu
+from ompi_tpu import cr
+from ompi_tpu.datatype import engine as dt
+from ompi_tpu.op import op as mpi_op
+
+comm = ompi_tpu.init()
+state = comm.state
+rank, size = comm.rank, comm.size
+
+digest = hashlib.sha256()
+
+# -- p2p ring, rendezvous-sized (past the 64 KiB tcp eager limit) ----
+n = 256 * 1024
+rng = np.random.default_rng(1234 + rank)
+mine = rng.standard_normal(n).astype(np.float32)
+got = np.empty(n, dtype=np.float32)
+right, left = (rank + 1) % size, (rank - 1) % size
+sreq = state.pml.isend(mine, n, dt.FLOAT, right, 11, comm)
+comm.Recv(got, left, tag=11)
+sreq.wait()
+want = np.random.default_rng(1234 + left).standard_normal(n) \
+    .astype(np.float32)
+assert np.array_equal(got, want), "p2p payload corrupted"
+digest.update(got.tobytes())
+
+# -- eager-sized p2p burst (many small frames: drop/dup/reorder food) -
+for i in range(16):
+    small = np.full(64, float(rank * 100 + i), dtype=np.float64)
+    out = np.empty(64, dtype=np.float64)
+    sreq = state.pml.isend(small, 64, dt.DOUBLE, right, 20 + i, comm)
+    comm.Recv(out, left, tag=20 + i)
+    sreq.wait()
+    assert out[0] == float(left * 100 + i), "eager burst corrupted"
+    digest.update(out.tobytes())
+
+# -- collectives ------------------------------------------------------
+contrib = np.arange(1024, dtype=np.float64) * (rank + 1)
+summed = np.empty(1024, dtype=np.float64)
+comm.Allreduce(contrib, summed, mpi_op.SUM)
+expect = np.arange(1024, dtype=np.float64) * (size * (size + 1) / 2)
+assert np.allclose(summed, expect), "allreduce wrong"
+digest.update(summed.tobytes())
+
+blob = np.full(4096, 7.5, dtype=np.float32) if rank == 0 \
+    else np.empty(4096, dtype=np.float32)
+comm.Bcast(blob, 0)
+assert float(blob[0]) == 7.5 and float(blob[-1]) == 7.5, "bcast wrong"
+digest.update(blob.tobytes())
+
+# -- checkpoint under injection (quiesce + stable snapshot) ----------
+if os.environ.get(cr.ENV_DIR):
+    seq = cr.checkpoint(comm, {"digest": digest.hexdigest(),
+                               "rank": rank})
+    digest.update(str(int(seq)).encode())
+
+comm.Barrier()
+print(f"chaos digest {rank} {digest.hexdigest()}", flush=True)
+ompi_tpu.finalize()

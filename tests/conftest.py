@@ -20,3 +20,10 @@ try:
     jax.config.update("jax_platforms", "cpu")
 except Exception:
     pass
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long multi-node / chaos scenarios excluded from the "
+        "tier-1 fast gate (run with -m slow)")

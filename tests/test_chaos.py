@@ -1,0 +1,139 @@
+"""Chaos harness: run the deterministic workload in _chaos_prog.py
+under every armed fault class and require either byte-identical
+results (digest equality against an uninjected reference run) or a
+bounded-time clean abort.  The injector is seed-driven
+(ft_inject_seed), so any failure here replays bit-for-bit."""
+
+import os
+import re
+
+import pytest
+
+from ompi_tpu.testing import mpirun_run
+
+SEED = "7"
+PROG = os.path.join("tests", "_chaos_prog.py")
+
+
+def _digests(out: bytes):
+    """{rank: hexdigest} from the prog's 'chaos digest R H' lines."""
+    return {int(m.group(1)): m.group(2) for m in re.finditer(
+        rb"chaos digest (\d+) ([0-9a-f]{64})", out)}
+
+
+def _chaos_run(plan, tmp_path, np_=2, rate="0.05", extra=(),
+               mca_extra=()):
+    env_dir = str(tmp_path / f"ckpt-{plan or 'ref'}")
+    os.makedirs(env_dir, exist_ok=True)
+    old = os.environ.get("TPUMPI_CKPT_DIR")
+    os.environ["TPUMPI_CKPT_DIR"] = env_dir
+    try:
+        mca = [("btl", "self,tcp")]
+        if plan:
+            mca += [("ft_inject_plan", plan),
+                    ("ft_inject_seed", SEED),
+                    ("ft_inject_rate", rate)]
+        mca += list(mca_extra)
+        r = mpirun_run(np_, PROG, mca=mca, extra=extra,
+                       timeout=240, job_timeout=150)
+    finally:
+        if old is None:
+            os.environ.pop("TPUMPI_CKPT_DIR", None)
+        else:
+            os.environ["TPUMPI_CKPT_DIR"] = old
+    return r
+
+
+@pytest.fixture(scope="module")
+def reference(tmp_path_factory):
+    """Digest of the workload with NO faults armed — ground truth."""
+    r = _chaos_run("", tmp_path_factory.mktemp("ref"))
+    assert r.returncode == 0, r.stderr.decode()[-2000:]
+    d = _digests(r.stdout)
+    assert set(d) == {0, 1}, r.stdout.decode()[-500:]
+    return d
+
+
+@pytest.mark.parametrize("plan", ["drop", "delay", "dup", "reorder",
+                                  "corrupt", "sever"])
+def test_btl_fault_class_byte_identical(plan, reference, tmp_path):
+    """Each frame-level fault class, alone, at the fixed seed: the
+    reliable sublayer must absorb it and the digest must match the
+    clean run exactly."""
+    r = _chaos_run(plan, tmp_path)
+    assert r.returncode == 0, \
+        f"{plan}: rc={r.returncode}\n{r.stderr.decode()[-2000:]}"
+    assert _digests(r.stdout) == reference, \
+        f"{plan}: digest mismatch\n{r.stdout.decode()[-500:]}"
+
+
+def test_btl_fault_cocktail_byte_identical(reference, tmp_path):
+    """All frame-level classes at once — the worst storm the plan
+    syntax can express — still byte-identical."""
+    r = _chaos_run("drop:0.03,delay:0.03,dup:0.03,reorder:0.03,"
+                   "corrupt:0.03,sever:0.01", tmp_path, rate="0.03")
+    assert r.returncode == 0, r.stderr.decode()[-2000:]
+    assert _digests(r.stdout) == reference, r.stdout.decode()[-500:]
+
+
+def test_kv_partition_job_survives(reference, tmp_path):
+    """kv_partition severs the client↔server socket before KV ops;
+    the retry/backoff path must reconnect and the job completes with
+    the reference digest."""
+    r = _chaos_run("kv_partition:0.2", tmp_path)
+    assert r.returncode == 0, r.stderr.decode()[-2000:]
+    assert _digests(r.stdout) == reference, r.stdout.decode()[-500:]
+
+
+@pytest.mark.slow
+def test_oob_sever_daemon_reconnects(tmp_path):
+    """Injected daemon↔HNP channel drop on the victim node: the HNP
+    holds EV_DAEMON_LOST for the reconnect grace, the daemon's
+    backoff reconnect re-registers (reconnect=True, so no duplicate
+    EV_DAEMON_UP) and the job completes normally."""
+    r = _chaos_run("oob_sever", tmp_path, np_=4,
+                   extra=("--simulate-nodes", "2x2"),
+                   mca_extra=(("oob_base_reconnect_grace", "5.0"),))
+    assert r.returncode == 0, r.stderr.decode()[-2000:]
+    d = _digests(r.stdout)
+    assert set(d) == {0, 1, 2, 3}, r.stdout.decode()[-800:]
+
+
+@pytest.mark.slow
+def test_daemon_kill_terminates_job(tmp_path):
+    """daemon_kill hard-exits the victim node's daemon mid-job: the
+    errmgr must declare the node lost and tear the job down in
+    bounded time — never a hang."""
+    r = _chaos_run("daemon_kill", tmp_path, np_=4,
+                   extra=("--simulate-nodes", "2x2"),
+                   mca_extra=(("oob_base_heartbeat_interval", "0.5"),
+                              ("oob_base_heartbeat_budget", "4")))
+    assert r.returncode != 0, "job must not report success"
+    err = r.stderr.decode()
+    assert "lost" in err, err[-2000:]
+
+
+def test_injector_disabled_by_default():
+    """Empty plan = framework fully passive: no injector objects are
+    built, so production paths never pay for chaos plumbing."""
+    from ompi_tpu import ft_inject
+    assert not ft_inject.enabled()
+    assert ft_inject.btl_injector(0) is None
+    assert ft_inject.kv_injector(0) is None
+    assert ft_inject.node_faults(1) == []
+
+
+def test_injector_deterministic_replay():
+    """Same (seed, scope, rank) → identical fault sequence; different
+    rank → (almost surely) a different one."""
+    from ompi_tpu import ft_inject
+    plan = {"drop": 0.2, "dup": 0.2}
+    a = ft_inject.BtlInjector("btl", 0, plan)
+    b = ft_inject.BtlInjector("btl", 0, plan)
+    c = ft_inject.BtlInjector("btl", 1, plan)
+    sa = [a.pick(0, 1) for _ in range(200)]
+    sb = [b.pick(0, 1) for _ in range(200)]
+    sc = [c.pick(0, 1) for _ in range(200)]
+    assert sa == sb
+    assert sa != sc
+    assert any(x is not None for x in sa)  # skip=8 passed, faults fire

@@ -1,0 +1,450 @@
+"""Regression tests for the transient-fault hardening satellites:
+wire CRCs, Progress interrupt suppression/deferral + stale-fd repair,
+KV client retry semantics, shmem lock-ticket retirement on timeout,
+rendezvous-engine epoch reset, vprotocol ack-watermark refresh, and
+HNP heartbeat liveness-by-silence."""
+
+import os
+import socket
+import sys
+import threading
+import time
+import types
+
+import numpy as np
+import pytest
+
+from ompi_tpu.mca.params import registry
+
+
+# ---- wire frame CRCs ------------------------------------------------
+
+def test_wire_crc_header_corruption_detected():
+    from ompi_tpu.btl import wire
+    frame = bytearray(b"\x00" + bytes(range(120)))  # unknown code:
+    crc = wire.frame_crc(frame)                     # span = 64
+    wire.check_crc(frame, crc)  # pristine passes
+    bad = bytearray(frame)
+    bad[10] ^= 0xFF
+    with pytest.raises(wire.CorruptFrame):
+        wire.check_crc(bad, crc)
+
+
+def test_wire_crc_covers_header_span_only():
+    """The CRC protects the parsed header region (hdr_span); payload
+    integrity past it is the datatype engine's concern.  A flip past
+    the span must NOT trip the header check."""
+    from ompi_tpu.btl import wire
+    frame = bytearray(b"\x00" + bytes(range(120)))
+    assert wire.hdr_span(frame) == 64
+    crc = wire.frame_crc(frame)
+    tail = bytearray(frame)
+    tail[100] ^= 0xFF
+    wire.check_crc(tail, crc)
+
+
+def test_wire_hdr_span_short_frame():
+    from ompi_tpu.btl import wire
+    short = bytearray(b"\x00\x01\x02")
+    assert wire.hdr_span(short) == 3
+    wire.check_crc(short, wire.frame_crc(short))
+
+
+# ---- Progress: interrupt suppression / deferral / stale fds ---------
+
+def test_progress_suppressed_interrupt_discarded():
+    from ompi_tpu.runtime.progress import Progress
+    p = Progress()
+    p.interrupt = RuntimeError("late ft interrupt")
+    p.suppress_interrupts = True
+    p.progress()  # must not raise
+    assert p.interrupt is None
+
+
+def test_progress_deferred_interrupt_held_then_raised():
+    from ompi_tpu.runtime.progress import Progress
+    p = Progress()
+    p.interrupt = RuntimeError("recovery wanted")
+    with p.deferred_interrupts():
+        p.progress()  # held: checkpoint write in flight
+        assert p.interrupt is not None
+    with pytest.raises(RuntimeError, match="recovery wanted"):
+        p.progress()
+    assert p.interrupt is None
+
+
+def test_progress_idle_fd_reregister_after_reuse():
+    """A transport socket closed without unregistering (injected
+    sever, test surgery) leaves a stale selector entry; a new socket
+    reusing the fd number must still register cleanly."""
+    from ompi_tpu.runtime.progress import Progress
+    p = Progress()
+    s1 = socket.socket()
+    fd1 = s1.fileno()
+    p.register_idle_fd(fd1, drain=lambda: None)
+    s1.close()  # selector entry for fd1 is now stale
+    s2 = socket.socket()
+    try:
+        if s2.fileno() != fd1:  # Linux reuses the lowest free fd
+            pytest.skip("OS did not reuse the fd number")
+        p.register_idle_fd(s2.fileno())  # must repair, not raise
+        assert fd1 not in p._idle_drains  # stale drain hook dropped
+    finally:
+        s2.close()
+
+
+# ---- KV client retry/backoff ----------------------------------------
+
+class _FlakyKV:
+    """Minimal KV server that kills the first ``fail_replies``
+    connections right after reading a request (send consumed, reply
+    lost) — the exact shape of a mid-op partition."""
+
+    def __init__(self, fail_replies: int) -> None:
+        from ompi_tpu.runtime.kvstore import _recv_msg, _send_msg
+        self._recv, self._send = _recv_msg, _send_msg
+        self.fail_replies = fail_replies
+        self.requests: list = []
+        self.sock = socket.socket()
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(8)
+        self.addr = "127.0.0.1:%d" % self.sock.getsockname()[1]
+        self._stop = False
+        threading.Thread(target=self._serve, daemon=True).start()
+
+    def _serve(self) -> None:
+        while not self._stop:
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            try:
+                while True:
+                    msg = self._recv(conn)
+                    if msg is None:
+                        break
+                    self.requests.append(msg)
+                    if self.fail_replies > 0:
+                        self.fail_replies -= 1
+                        conn.close()  # reply lost
+                        break
+                    self._send(conn, {"ok": True, "value": msg})
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        self._stop = True
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+@pytest.fixture
+def fast_kv_retry():
+    import ompi_tpu.runtime.kvstore  # noqa: F401  (registers the var)
+    old = registry.get("rte_base_kv_retry_delay", 0.05)
+    registry.set("rte_base_kv_retry_delay", "0.01")
+    yield
+    registry.set("rte_base_kv_retry_delay", str(old))
+
+
+def test_kv_idempotent_op_retried_through_lost_reply(fast_kv_retry):
+    from ompi_tpu.runtime.kvstore import KVClient
+    srv = _FlakyKV(fail_replies=1)
+    try:
+        cli = KVClient(srv.addr)
+        resp = cli._request({"op": "probe"}, idempotent=True)
+        assert resp["ok"]
+        # the op was SENT twice (first reply lost, retried)
+        assert len(srv.requests) == 2
+        cli.close()
+    finally:
+        srv.close()
+
+
+def test_kv_nonidempotent_lost_reply_raises(fast_kv_retry):
+    """A lost reply to a non-idempotent op (incr, fence, spawn) must
+    surface, never silently resend: the server may already have
+    applied it."""
+    from ompi_tpu.runtime.kvstore import KVClient
+    srv = _FlakyKV(fail_replies=1)
+    try:
+        cli = KVClient(srv.addr)
+        with pytest.raises(ConnectionError):
+            cli._request({"op": "probe"}, idempotent=False)
+        assert len(srv.requests) == 1  # exactly once on the wire
+        cli.close()
+    finally:
+        srv.close()
+
+
+def test_kv_send_failure_always_retried(fast_kv_retry):
+    """A severed socket discovered at SEND time is retryable for any
+    op: the server never saw (a complete frame of) the request."""
+    from ompi_tpu.runtime.kvstore import KVClient
+    srv = _FlakyKV(fail_replies=0)
+    try:
+        cli = KVClient(srv.addr)
+        cli._sock.close()  # partition before the op
+        resp = cli._request({"op": "probe"}, idempotent=False)
+        assert resp["ok"]
+        cli.close()
+    finally:
+        srv.close()
+
+
+# ---- shmem: set_lock timeout retires its ticket ---------------------
+
+def test_set_lock_timeout_does_not_wedge_lock():
+    from ompi_tpu import shmem
+    from ompi_tpu.testing import run_ranks
+
+    def fn(comm):
+        ctx = shmem.init(comm)
+        try:
+            lock = ctx.malloc(1, np.int64)
+            ctx.barrier_all()
+            if comm.rank == 0:
+                ctx.set_lock(lock)
+            comm.Barrier()
+            if comm.rank == 1:
+                # held by rank 0: time out, and the abandoned ticket
+                # must be retired — else the lock wedges forever
+                with pytest.raises(TimeoutError):
+                    ctx.set_lock(lock, timeout=0.4)
+            comm.Barrier()
+            if comm.rank == 0:
+                ctx.clear_lock(lock)
+            comm.Barrier()
+            # both ranks take and release it again, in rank order:
+            # proves no ghost ticket is holding the queue
+            for turn in range(comm.size):
+                if comm.rank == turn:
+                    ctx.set_lock(lock, timeout=10.0)
+                    ctx.clear_lock(lock)
+                comm.Barrier()
+            return True
+        finally:
+            shmem.finalize()
+
+    assert all(run_ranks(2, fn))
+
+
+# ---- btl/tpu rendezvous engine epoch reset --------------------------
+
+def test_rndv_engine_ft_reset_clears_tables():
+    from ompi_tpu.btl.tpu import TpuRndvEngine
+    state = types.SimpleNamespace(progress=types.SimpleNamespace(
+        register=lambda *a, **k: None))
+    eng = TpuRndvEngine(state)
+    flat = np.arange(32, dtype=np.float32)
+    x1 = eng.begin_send(flat)
+    x2 = eng.begin_send(flat)
+    eng._gc_tombstones.add(99)
+    eng.staged_bytes = 4096
+    eng._inflight.append(("req", 4096))
+    eng.ft_reset()
+    assert eng.pending == {} and eng._gc_tombstones == set()
+    assert eng._inflight == [] and eng.staged_bytes == 0
+    # the id space must stay MONOTONE across the epoch: a recycled
+    # xid would let a stale pull address a new transfer
+    x3 = eng.begin_send(flat)
+    assert x3 > max(x1, x2, 99)
+
+
+# ---- vprotocol: periodic ack-watermark refresh ----------------------
+
+def test_vprotocol_ack_refresh_resends_watermark(tmp_path):
+    """Every Nth ack tick bypasses the already-acked skip, so a
+    watermark whose ack frame died on the wire is re-sent (acks are
+    idempotent)."""
+    from ompi_tpu import cr
+    from ompi_tpu.pml.vprotocol import find
+    from ompi_tpu.testing import run_ranks
+
+    store = str(tmp_path / "store")
+    registry.set("pml_vprotocol", "pessimist")
+    registry.set("vprotocol_pessimist_ack_interval_s", "0.01")
+    registry.set("vprotocol_pessimist_ack_refresh_ticks", "2")
+    try:
+        def fn(comm):
+            v = find(comm.state.pml)
+            assert v is not None
+            if comm.rank == 0:
+                comm.Send(np.arange(4, dtype=np.float64), 1, tag=3)
+                comm.Barrier()
+                comm.Barrier()
+                return True
+            got = np.empty(4)
+            comm.Recv(got, 0, tag=3)
+            # a local snapshot makes the consumed watermark durable —
+            # only durable watermarks are ever acked
+            cr.checkpoint_local(comm, {"ok": 1}, store_dir=store)
+            cid = comm.cid
+            key = next(k for k in v._durable if k[0] == cid)
+            v._acked[key] = v._durable[key]  # pretend ack delivered
+            comm.Barrier()
+            sent = []
+            orig = v._base._ep
+
+            def spying_ep(gsrc):
+                sent.append(gsrc)
+                return orig(gsrc)
+
+            v._base._ep = spying_ep
+            deadline = time.monotonic() + 5.0
+            while not sent and time.monotonic() < deadline:
+                comm.state.progress.progress()
+                time.sleep(0.005)
+            v._base._ep = orig
+            assert sent, "refresh tick never re-sent the watermark"
+            comm.Barrier()
+            return True
+
+        assert all(run_ranks(2, fn))
+    finally:
+        registry.set("pml_vprotocol", "")
+        registry.set("vprotocol_pessimist_ack_interval_s", "0.25")
+        registry.set("vprotocol_pessimist_ack_refresh_ticks", "8")
+
+
+# ---- HNP: liveness by silence (heartbeat budget) --------------------
+
+class _Events:
+    def __init__(self) -> None:
+        self.seen: list = []
+        self.got_lost = threading.Event()
+
+    def activate(self, name, **info):
+        self.seen.append((name, info))
+        if name == "EV_DAEMON_LOST":
+            self.got_lost.set()
+
+
+def test_heartbeat_silence_declares_daemon_lost():
+    """The acceptance gate: a daemon that stops beating is declared
+    lost WITHOUT waiting for TCP death — its socket stays open the
+    whole time."""
+    from ompi_tpu.runtime import oob
+    from ompi_tpu.runtime.kvstore import _send_msg
+    from ompi_tpu.tools.plm import HNP
+
+    old_iv = oob.heartbeat_interval_var.value
+    old_budget = oob.heartbeat_budget_var.value
+    old_secret = os.environ.pop("TPUMPI_JOB_SECRET", None)
+    registry.set("oob_base_heartbeat_interval", "0.1")
+    registry.set("oob_base_heartbeat_budget", "3")
+    ev = _Events()
+    hnp = None
+    s = None
+    try:
+        hnp = HNP(maps=[], agent="ssh", python=sys.executable,
+                  pythonpath="", events=ev)
+        s = socket.create_connection(("127.0.0.1", hnp.port))
+        _send_msg(s, {"op": "register", "node": 5, "name": "wedged",
+                      "if_ip": "127.0.0.1", "secret": ""})
+        # send nothing more; the socket stays OPEN (a wedged daemon,
+        # not a dead one) — only the beat monitor can notice
+        assert ev.got_lost.wait(5.0), ev.seen
+        assert ("EV_DAEMON_LOST", {"node": 5}) in ev.seen
+        assert 5 in hnp._beat_dead
+    finally:
+        if hnp is not None:
+            hnp._stop = True
+            hnp.listener.close()
+        if s is not None:
+            s.close()
+        registry.set("oob_base_heartbeat_interval", str(old_iv))
+        registry.set("oob_base_heartbeat_budget", str(old_budget))
+        if old_secret is not None:
+            os.environ["TPUMPI_JOB_SECRET"] = old_secret
+
+
+def test_reconnect_grace_holds_daemon_lost():
+    """A channel drop with reconnect_grace > 0 arms a timer instead
+    of firing EV_DAEMON_LOST; a re-register inside the grace cancels
+    it and the job never notices."""
+    from ompi_tpu.runtime import oob
+    from ompi_tpu.runtime.kvstore import _send_msg
+    from ompi_tpu.tools.plm import HNP
+
+    old_grace = oob.reconnect_grace_var.value
+    old_secret = os.environ.pop("TPUMPI_JOB_SECRET", None)
+    registry.set("oob_base_reconnect_grace", "1.5")
+    ev = _Events()
+    hnp = None
+    try:
+        hnp = HNP(maps=[], agent="ssh", python=sys.executable,
+                  pythonpath="", events=ev)
+        s1 = socket.create_connection(("127.0.0.1", hnp.port))
+        _send_msg(s1, {"op": "register", "node": 3, "name": "n3",
+                       "if_ip": "127.0.0.1", "secret": ""})
+        deadline = time.monotonic() + 5.0
+        while 3 not in hnp.channels and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert 3 in hnp.channels
+        s1.close()  # transient drop
+        deadline = time.monotonic() + 5.0
+        while not hnp._grace_timers and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert 3 in hnp._grace_timers, "grace timer not armed"
+        # reconnect within the grace
+        s2 = socket.create_connection(("127.0.0.1", hnp.port))
+        _send_msg(s2, {"op": "register", "node": 3, "name": "n3",
+                       "if_ip": "127.0.0.1", "secret": "",
+                       "reconnect": True})
+        deadline = time.monotonic() + 5.0
+        while hnp._grace_timers and time.monotonic() < deadline:
+            time.sleep(0.01)
+        time.sleep(1.8)  # past the original grace deadline
+        assert not ev.got_lost.is_set(), ev.seen
+        # reconnect=True must not double-announce the daemon
+        assert ev.seen.count(("EV_DAEMON_UP", {"node": 3})) == 1
+        s2.close()
+    finally:
+        if hnp is not None:
+            hnp._stop = True
+            hnp.listener.close()
+        registry.set("oob_base_reconnect_grace", str(old_grace))
+        if old_secret is not None:
+            os.environ["TPUMPI_JOB_SECRET"] = old_secret
+
+
+# ---- C/R bookmark vs transport duplicates ---------------------------
+
+def test_cr_arrived_ignores_transport_duplicate_envelopes():
+    """A reconnect-resent duplicate envelope is dropped by the pml
+    sequence gate and must not inflate cr_arrived: quiesce balances
+    sender sent against receiver arrived, so one phantom arrival
+    stalls every later checkpoint (seen live under ft_inject sever —
+    the old conn's buffered copy and the replayed copy both reached
+    the pml)."""
+    from ompi_tpu.pml.ob1 import MATCH
+    from ompi_tpu.testing import run_ranks
+
+    def fn(comm):
+        sub = comm.dup()  # private cid: never pollute WORLD's seq space
+        pml = sub.state.pml
+        cid = sub.cid
+        base = pml._next_seq.get((cid, 0), 0)
+        before = pml.cr_arrived.get(0, 0)
+        first = (MATCH, cid, 0, 5, base, 0, b"first")
+        pml._handle(first)
+        pml._handle(first)  # transport duplicate: dropped, uncounted
+        assert pml.cr_arrived.get(0, 0) == before + 1
+        # out-of-order copy parked, duplicated while parked, then the
+        # gap fills: exactly three real messages counted overall
+        ahead = (MATCH, cid, 0, 5, base + 2, 0, b"third")
+        pml._handle(ahead)
+        pml._handle(ahead)  # duplicate of a parked envelope
+        pml._handle((MATCH, cid, 0, 5, base + 1, 0, b"second"))
+        assert pml.cr_arrived.get(0, 0) == before + 3
+        # exactly-once delivery: three distinct messages, no copies
+        # (buffer order is dispatch order, not seq order — the parked
+        # envelope drains from _advance_seq before the gap-filler)
+        assert sorted(m.payload for m in pml._unexpected.get(cid, [])) \
+            == [b"first", b"second", b"third"]
+        pml._unexpected.get(cid, []).clear()  # consumed: keep finalize quiet
+
+    run_ranks(1, fn)
