@@ -123,7 +123,42 @@ def main() -> None:
                     help="Tiny sizes for development runs")
     ap.add_argument("--dev-budget", type=float, default=480.0)
     ap.add_argument("--sw-budget", type=float, default=300.0)
+    ap.add_argument("--probe-dispatch", action="store_true",
+                    help="Measure the per-op dispatch constant, the "
+                         "device-vs-host crossover per collective, and "
+                         "the fusion amortization ratio; persist under "
+                         "'probe_dispatch' in BENCH_DETAIL.json and "
+                         "refresh the coll/calibrate profile")
     opts = ap.parse_args()
+
+    detail_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAIL.json")
+
+    if opts.probe_dispatch:
+        from benchmarks.probe_dispatch import persist, run_probe
+
+        probe = run_probe()
+        notes = persist(probe, detail_path)
+        fused = probe.get("fused", {})
+        line = {
+            "metric": "probe_dispatch fused batch of "
+                      f"{fused.get('batch_ops', 0)} x "
+                      f"{fused.get('payload_bytes', 0)} B allreduce "
+                      "vs single-op dispatch constant",
+            "value": fused.get("ratio_vs_single"),
+            "unit": "x_single_op",
+            "meets_3x_target": fused.get("meets_3x_target"),
+            "dispatch_us": probe["dispatch_us"],
+            "crossover_bytes": probe["crossover_bytes"],
+        }
+        line.update({k: v for k, v in notes.items() if "error" in k})
+        sys.stderr.write(json.dumps(probe, indent=1) + "\n")
+        out = json.dumps(line)
+        if len(out) > 1024:
+            line.pop("crossover_bytes", None)
+            out = json.dumps(line)
+        print(out)
+        return
 
     if opts.quick:
         caps = {"ar": 64 * 1024, "bcast": 16 * 1024, "a2a": 4 * 1024,
@@ -227,12 +262,20 @@ def main() -> None:
     if trunc:
         result["truncated"] = trunc
 
-    # full sweeps go to a file, never the driver-parsed stdout line
-    detail_path = os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAIL.json")
+    # full sweeps go to a file, never the driver-parsed stdout line.
+    # preserve a prior --probe-dispatch block across full-sweep writes
+    prior = {}
+    try:
+        with open(detail_path) as f:
+            prior = json.load(f)
+    except (OSError, ValueError):
+        prior = {}
     try:
         with open(detail_path, "w") as f:
-            json.dump({"device_us": dev, "software_us": sw,
+            json.dump({**({"probe_dispatch": prior["probe_dispatch"]}
+                          if isinstance(prior, dict)
+                          and "probe_dispatch" in prior else {}),
+                       "device_us": dev, "software_us": sw,
                        "software_tuned_tcp_us": sw_tcp,
                        "northstar_per_size": per_size,
                        "northstar_tuned_tcp_per_size": tcp_per_size,

@@ -33,7 +33,9 @@ from __future__ import annotations
 
 import functools
 import threading
-from typing import Any, Callable, Dict, List, Optional, Tuple
+import time
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
@@ -66,6 +68,14 @@ _dispatcher_var = registry.register(
          "meeting harness the dedicated thread measured WORSE "
          "(r5 A/B) — off by default; kept as a tuning knob for real "
          "multi-core hosts.")
+_cache_max_var = registry.register(
+    "coll", "device", "cache_max", 256, int,
+    help="Bound on the compiled-collective LRU cache (distinct "
+         "(kind, mesh, shape, dtype, fusion-signature) executables "
+         "kept hot).  Shape-churn workloads evict least-recently-used "
+         "entries instead of growing without bound; hit/miss/eviction "
+         "counters are exported as MPI_T pvars "
+         "(coll_device_cache_{hits,misses,evictions,size})")
 _reduce_as_allreduce_var = registry.register(
     "coll", "device", "reduce_as_allreduce", True, bool,
     help="Lower reduce_arr as an on-device allreduce (SPMD computes "
@@ -135,6 +145,8 @@ class _DeviceDispatcher:
     def __init__(self) -> None:
         import queue
         self.q: "queue.SimpleQueue" = queue.SimpleQueue()
+        self.closed = False
+        self._submit_lock = threading.Lock()
         self.thread = threading.Thread(
             target=self._loop, daemon=True, name="coll-device-dispatch")
         self.thread.start()
@@ -147,22 +159,114 @@ class _DeviceDispatcher:
             work()  # never raises: work wraps its own error capture
 
     def submit(self, work: Callable[[], None]) -> None:
-        self.q.put(work)
+        # the lock orders submit against close(): a submit that wins
+        # the race lands BEFORE the close sentinel and is flushed; one
+        # that loses gets the clear error instead of silently dying
+        # with the daemon thread
+        with self._submit_lock:
+            if self.closed:
+                raise RuntimeError(
+                    "device-collective dispatcher is closed (MPI "
+                    "finalized): late collective work rejected — "
+                    "pending work was flushed at finalize")
+            self.q.put(work)
+
+    def close(self, timeout: float = 10.0) -> None:
+        """Drain at finalize: reject new submits, then run everything
+        already queued and join the worker.  Pending submitted work
+        must complete — rendezvous peers are parked on its results."""
+        with self._submit_lock:
+            if self.closed:
+                return
+            self.closed = True
+            self.q.put(None)
+        self.thread.join(timeout)
 
 
 _dispatcher_singleton: Optional[_DeviceDispatcher] = None
 _dispatcher_lock = threading.Lock()
 
+# rank states that have used the device-collective plane this world;
+# the LAST one to finalize drains the dispatcher (thread-rank worlds
+# share one process-wide dispatcher across all ranks)
+_live_states: Set[Any] = set()
+_live_lock = threading.Lock()
+
+
+def _prune_dead_locked() -> bool:
+    """Drop tracked states that can never finalize — their world
+    aborted, or they already finalized without the hook (a replayed
+    hook list) — and report whether any live state remains.  Without
+    the prune a rank killed mid-abort would hold the dispatcher open
+    for the rest of the process.  Caller holds _live_lock."""
+    for s in list(_live_states):
+        w = getattr(s.rte, "world", None)
+        if getattr(s, "finalized", False) or \
+                getattr(w, "aborted", None):
+            _live_states.discard(s)
+    return bool(_live_states)
+
 
 def _dispatcher() -> _DeviceDispatcher:
     global _dispatcher_singleton
     d = _dispatcher_singleton
-    if d is None:
+    if d is not None and not d.closed:
+        return d
+    with _dispatcher_lock:
+        d = _dispatcher_singleton
+        if d is None or d.closed:
+            with _live_lock:
+                live = _prune_dead_locked()
+            if d is not None and d.closed and not live:
+                raise RuntimeError(
+                    "device-collective dispatcher used after MPI "
+                    "finalize (no live ranks): call MPI_Init first")
+            # fresh world in the same process (tests run many): revive
+            d = _dispatcher_singleton = _DeviceDispatcher()
+    return d
+
+
+def track_state(state) -> None:
+    """First device-collective touch by a rank: register its finalize
+    hook so pending fused batches flush and — when the LAST tracked
+    rank finalizes — the dispatcher drains instead of dying with the
+    daemon thread mid-work (finalize racing a last collective)."""
+    if state.__dict__.get("_device_coll_tracked"):
+        return
+    state._device_coll_tracked = True
+    with _live_lock:
+        _live_states.add(state)
+    state.progress.register_finalize_hook(
+        functools.partial(_finalize_state, state))
+
+
+def _finalize_state(state) -> None:
+    # flush pending fused batches first: every member rank's hook runs
+    # before its finalize fence, so the flush rendezvous still meets
+    from ompi_tpu.coll import fusion
+    fusion.flush_state(state)
+    with _live_lock:
+        _live_states.discard(state)
+        state._device_coll_tracked = False
+        last = not _prune_dead_locked()
+    if last:
         with _dispatcher_lock:
             d = _dispatcher_singleton
-            if d is None:
-                d = _dispatcher_singleton = _DeviceDispatcher()
-    return d
+        if d is not None:
+            d.close()
+
+
+def _coll_delay_injector(state):
+    """Deterministic ft_inject 'delay' faults at the rendezvous choke
+    point: seed-driven random stalls before a rank deposits, so chaos
+    runs exercise straggler arrival orders and fusion flush timing
+    (cached per rank-state; False = framework disarmed)."""
+    inj = state.__dict__.get("_coll_delay_inj")
+    if inj is None:
+        from ompi_tpu import ft_inject
+        inj = ft_inject.coll_injector(state.rank) or False
+        state._coll_delay_inj = inj
+    return inj
 
 
 class Rendezvous:
@@ -334,6 +438,12 @@ def meet(comm, value, fn, abort_check) -> Any:
     paths must not blind the observability story), then runs the
     meeting with this rank's progress engine kept turning."""
     rv = _get_rendezvous(comm)
+    track_state(comm.state)
+    inj = _coll_delay_injector(comm.state)
+    if inj:
+        d = inj.maybe_delay()
+        if d:
+            time.sleep(d)
     count_offload(comm, int(getattr(value, "nbytes", 0) or 0))
     return rv.run(comm.rank, value, fn, abort_check,
                   progress=comm.state.progress)
@@ -359,12 +469,71 @@ def _get_rendezvous(comm) -> Rendezvous:
 
 
 # ---------------------------------------------------------------------------
-# compiled-collective cache: (kind, mesh_key, shape, dtype, extra) -> fn
-# (the per-(op, dtype, shape, comm) caching from SURVEY.md §7.6)
+# compiled-collective cache: (kind, mesh_key, shape, dtype, extra) -> fn,
+# fused entries keyed additionally on their fusion signature.  Bounded
+# LRU (the per-(op, dtype, shape, comm) caching from SURVEY.md §7.6 —
+# but shape-churn workloads must evict, not grow without bound).
 # ---------------------------------------------------------------------------
 
-_compiled: Dict[Tuple, Callable] = {}
-_compiled_lock = threading.Lock()
+
+class CompiledLRU:
+    """Bounded compiled-executable cache with MPI_T observability.
+
+    ``builds`` is the compile trace counter tests assert against (a
+    cache hit must skip recompilation — asserted by count, never by
+    timing).  Builders run OUTSIDE the lock: an XLA compile takes
+    seconds on the tunnel and must not stall every other collective's
+    cache hit; two racing builders of one key both compile and the
+    last write wins — identical executables, same as the old dict."""
+
+    def __init__(self) -> None:
+        self._d: "OrderedDict[Tuple, Callable]" = OrderedDict()
+        self._lock = threading.Lock()
+        self.builds = 0
+        self.pv_hits = registry.register_pvar(
+            "coll", "device", "cache_hits",
+            help="Compiled-collective cache hits")
+        self.pv_misses = registry.register_pvar(
+            "coll", "device", "cache_misses",
+            help="Compiled-collective cache misses (each one is a "
+                 "full XLA compile)")
+        self.pv_evictions = registry.register_pvar(
+            "coll", "device", "cache_evictions",
+            help="Compiled-collective LRU evictions "
+                 "(coll_device_cache_max bound enforced)")
+        registry.register_pvar(
+            "coll", "device", "cache_size", var_class="level",
+            getter=lambda: len(self._d),
+            help="Compiled-collective cache entries currently held")
+
+    def __len__(self) -> int:
+        return len(self._d)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._d.clear()
+
+    def get(self, key: Tuple, builder: Callable[[], Callable]) -> Callable:
+        with self._lock:
+            fn = self._d.get(key)
+            if fn is not None:
+                self._d.move_to_end(key)
+                self.pv_hits.add(1)
+                return fn
+        self.pv_misses.add(1)
+        self.builds += 1
+        fn = builder()
+        with self._lock:
+            self._d[key] = fn
+            self._d.move_to_end(key)
+            cap = max(1, _cache_max_var.value)
+            while len(self._d) > cap:
+                self._d.popitem(last=False)
+                self.pv_evictions.add(1)
+        return fn
+
+
+compile_cache = CompiledLRU()
 
 
 def _mesh_collective(kind: str, mesh, shape, dtype, extra=None) -> Callable:
@@ -373,12 +542,33 @@ def _mesh_collective(kind: str, mesh, shape, dtype, extra=None) -> Callable:
     # the same compiled executable (a miss costs a full XLA compile)
     dev_key = tuple(d.id for d in mesh.devices.reshape(-1))
     key = (kind, dev_key, tuple(shape), np.dtype(dtype).str, extra)
-    fn = _compiled.get(key)
-    if fn is not None:
-        return fn
+    return compile_cache.get(
+        key, lambda: _build_mesh_collective(kind, mesh, shape, dtype, extra))
+
+
+def shard_map_compat(body, mesh, in_specs, out_specs) -> Callable:
+    """shard_map across jax versions: new jax exports it at top level
+    with check_vma; 0.4.x has jax.experimental.shard_map with
+    check_rep.  Replica-consistency checking is disabled either way —
+    collective bodies are intentionally rank-divergent."""
+    try:
+        from jax import shard_map as _sm  # jax >= 0.6
+        kw = {"check_vma": False}
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _sm
+        kw = {"check_rep": False}
+    try:
+        return _sm(body, mesh=mesh, in_specs=in_specs,
+                   out_specs=out_specs, **kw)
+    except TypeError:
+        return _sm(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+
+
+def _build_mesh_collective(kind: str, mesh, shape, dtype,
+                           extra=None) -> Callable:
     import jax
     import jax.numpy as jnp
-    from jax import lax, shard_map
+    from jax import lax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     size = mesh.devices.size
@@ -441,11 +631,7 @@ def _mesh_collective(kind: str, mesh, shape, dtype, extra=None) -> Callable:
     else:
         raise KeyError(kind)
 
-    jfn = jax.jit(shard_map(body, mesh=mesh, in_specs=in_specs,
-                            out_specs=out_specs, check_vma=False))
-    with _compiled_lock:
-        _compiled[key] = jfn
-    return jfn
+    return jax.jit(shard_map_compat(body, mesh, in_specs, out_specs))
 
 
 def _assemble(mesh, shards: List):
@@ -477,6 +663,19 @@ def _scatter_out(out, mesh, size: int) -> List:
         return parts
     # replicated output: every rank reads the same array
     return [out] * size
+
+
+def _measured_host_wins(comm, kind: str, nbytes: int) -> bool:
+    """Measured-crossover reroute (--mca coll_tuned_use_measured_rules):
+    below the calibrated device-vs-host crossover the host seg path
+    wins — the size-independent dispatch constant dominates the device
+    path there.  Comm-consistent: the profile is process-wide and
+    nbytes is MPI-matched across ranks, so every member reroutes (or
+    not) together."""
+    from ompi_tpu.coll import calibrate
+    if not calibrate.use_measured_rules():
+        return False
+    return 0 < nbytes < calibrate.crossover_bytes(kind, comm.size)
 
 
 class TpuCollModule(CollModule):
@@ -534,7 +733,10 @@ class TpuCollModule(CollModule):
     # -- device-array collectives (the *_arr vtable surface) -------------
     def allreduce_arr(self, comm, x, op: Op):
         if not self._eligible(comm, x) or (
-                op.name not in _XLA_REDUCERS and op.name not in _GATHER_FOLD):
+                op.name not in _XLA_REDUCERS
+                and op.name not in _GATHER_FOLD) \
+                or _measured_host_wins(comm, "allreduce",
+                                       int(getattr(x, "nbytes", 0) or 0)):
             return self.fallback.allreduce_arr(comm, x, op)
         mesh = comm.mesh()
         x, was_scalar = self._norm(x)
@@ -581,7 +783,9 @@ class TpuCollModule(CollModule):
 
     def alltoall_arr(self, comm, x):
         if not self._eligible(comm, x) or _ndim_of(x) == 0 \
-                or x.shape[0] % comm.size != 0:
+                or x.shape[0] % comm.size != 0 \
+                or _measured_host_wins(comm, "alltoall",
+                                       int(getattr(x, "nbytes", 0) or 0)):
             return self.fallback.alltoall_arr(comm, x)
         mesh = comm.mesh()
 
@@ -593,7 +797,9 @@ class TpuCollModule(CollModule):
         return self._run(comm, x, fn)
 
     def bcast_arr(self, comm, x, root: int):
-        if not self._eligible(comm, x):
+        if not self._eligible(comm, x) \
+                or _measured_host_wins(comm, "bcast",
+                                       int(getattr(x, "nbytes", 0) or 0)):
             return self.fallback.bcast_arr(comm, x, root)
         mesh = comm.mesh()
         x, was_scalar = self._norm(x)
@@ -640,12 +846,6 @@ class HbmCollModule(CollModule):
 
     name = "hbm"
 
-    # process-global compile cache: every rank has its own module
-    # instance, but the last-arriver thread rotates — a per-instance
-    # cache would recompile once per distinct executing thread
-    _jit_cache: Dict[Tuple, Callable] = {}
-    _jit_lock = threading.Lock()
-
     def __init__(self, fallback: "HostArrModule") -> None:
         self.fallback = fallback
 
@@ -684,11 +884,17 @@ class HbmCollModule(CollModule):
 
     def _stacked(self, kind: str, opname: str, nshards: int, shape, dtype,
                  extra=None) -> Callable:
-        key = (kind, opname, nshards, tuple(shape), np.dtype(dtype).str,
-               extra)
-        fn = self._jit_cache.get(key)
-        if fn is not None:
-            return fn
+        # process-global LRU (shared with the mesh path, "hbm"-prefixed
+        # keys): every rank has its own module instance, but the
+        # last-arriver thread rotates — a per-instance cache would
+        # recompile once per distinct executing thread
+        key = ("hbm", kind, opname, nshards, tuple(shape),
+               np.dtype(dtype).str, extra)
+        return compile_cache.get(
+            key, lambda: self._build_stacked(kind, opname))
+
+    @staticmethod
+    def _build_stacked(kind: str, opname: str) -> Callable:
         import jax
         import jax.numpy as jnp
 
@@ -745,11 +951,7 @@ class HbmCollModule(CollModule):
         else:
             raise KeyError(kind)
 
-        jbody = jax.jit(body)
-        fn = (jbody, out)
-        with HbmCollModule._jit_lock:
-            HbmCollModule._jit_cache[key] = fn
-        return fn
+        return (jax.jit(body), out)
 
     def _run(self, comm, kind, opname, x, extra=None):
         x = self._deposit(comm, x)

@@ -1,0 +1,459 @@
+"""Small-message collective fusion/coalescing: the device fast path.
+
+Round-5 measurement (BENCH_NOTES.md) showed every device collective
+pays a ~150-600 us size-independent tunnel-dispatch round-trip, so the
+4-64 KiB band loses to the host seg path even though the op itself is
+nearly free there.  The fix is the reference's message-coalescing idea
+applied at the XLA layer: when a rank has several small collectives
+pending (surfaced through the nonblocking coll surface, coll/nbc),
+pack their payloads into ONE flattened buffer per (reducer, dtype)
+group — offset table from datatype/device.py — and issue a SINGLE
+fused XLA call (one psum over the concatenation, bcasts joining the
+SUM group as masked summands), then slice results back out.  One
+dispatch amortized over N collectives.
+
+Surface: ``comm.iallreduce_arr`` / ``comm.ibcast_arr`` return a
+``FusedRequest``; pending ops coalesce until an explicit
+``comm.flush_arr()``, a ``wait()``/``test()`` on any request of the
+batch, the ``coll_device_fusion_max_ops`` bound, or MPI_Finalize
+(dispatcher-drain hook) flushes them.  Ineligible ops (big payloads,
+host-only comms, exotic ops) execute immediately through the blocking
+vtable and return an already-complete request — callers never branch.
+
+Batch symmetry: the flush is one rendezvous per batch, so every member
+rank must enqueue the SAME sequence of collectives between flushes
+(the usual SPMD discipline MPI already requires for collective
+ordering).  The fused signature is validated at the meeting point —
+a divergent batch raises a clear error on every rank instead of
+deadlocking.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ompi_tpu.mca.params import registry
+from ompi_tpu.op.op import Op
+from ompi_tpu.pml.request import Request
+
+_fusion_var = registry.register(
+    "coll", "device", "fusion", True, bool,
+    help="Coalesce pending small nonblocking device collectives "
+         "(iallreduce_arr/ibcast_arr) into one fused XLA call per "
+         "batch, amortizing the per-op dispatch constant")
+_threshold_var = registry.register(
+    "coll", "device", "fusion_threshold", 65536, int,
+    help="Per-op payload bound (bytes) for fusion eligibility; larger "
+         "payloads are bandwidth-dominated and run unfused "
+         "immediately")
+_max_ops_var = registry.register(
+    "coll", "device", "fusion_max_ops", 32, int,
+    help="Auto-flush a pending fusion batch at this many collectives "
+         "(bounds result latency and fused-executable arity)")
+
+_pv_batches = registry.register_pvar(
+    "coll", "device", "fused_batches",
+    help="Fused device-collective batches dispatched")
+_pv_colls = registry.register_pvar(
+    "coll", "device", "fused_collectives",
+    help="Individual collectives that rode in a fused batch")
+_pv_bytes = registry.register_pvar(
+    "coll", "device", "fused_bytes",
+    help="Payload bytes carried by fused batches")
+
+
+class FusedRequest(Request):
+    """Request handle for a (possibly) coalesced device collective.
+
+    ``result`` is the output array once complete.  Completion requires
+    running the fused batch — a bare progress sweep cannot do that, so
+    ``wait()`` AND ``test()`` both flush the owning engine's pending
+    batch (the batch rendezvous blocks on peers; under the SPMD batch
+    discipline they are flushing too)."""
+
+    def __init__(self, progress, engine) -> None:
+        super().__init__(progress)
+        self._engine = engine
+        self._error = None
+        self.result = None
+
+    def _deliver(self, value) -> None:
+        self.result = value
+        self._complete()
+
+    def _fail(self, exc: BaseException) -> None:
+        self._error = exc
+        self._complete()
+
+    def test(self) -> bool:
+        if not self.complete and self._engine is not None:
+            self._engine.flush()
+        return self.complete
+
+    def wait(self, timeout=None):
+        if not self.complete and self._engine is not None:
+            self._engine.flush()
+        st = super().wait(timeout)
+        if self._error is not None:
+            raise RuntimeError(
+                f"fused device collective failed: {self._error}"
+            ) from self._error
+        return st
+
+
+class _Pending:
+    __slots__ = ("kind", "x", "extra", "was_scalar", "nbytes", "req")
+
+    def __init__(self, kind, x, extra, was_scalar, nbytes, req) -> None:
+        self.kind = kind            # "allreduce" | "bcast"
+        self.x = x                  # normalized payload (ndim >= 1)
+        self.extra = extra          # opname (allreduce) or root (bcast)
+        self.was_scalar = was_scalar
+        self.nbytes = nbytes
+        self.req = req
+
+
+def _nbytes_of(x) -> int:
+    """Payload bytes from shape x itemsize — the ``.nbytes`` property
+    on device arrays walks the aval and costs microseconds; this runs
+    on every nonblocking enqueue."""
+    n = 1
+    for s in getattr(x, "shape", ()):
+        n *= s
+    return n * x.dtype.itemsize
+
+
+_RED_OPS = ("MPI_SUM", "MPI_MAX", "MPI_MIN")
+
+
+def _group_plan(sig):
+    """Static fusion plan, a pure function of the batch signature (so
+    every rank and every cache layer derives the same plan): slots
+    grouped by (reducer opname, dtype) — bcast joins the SUM group of
+    its dtype as a root-masked summand — plus the gather-fold slots
+    that keep per-slot all_gathers inside the same dispatch."""
+    groups = {}
+    folds = []
+    for i, (kind, _shape, dt, extra) in enumerate(sig):
+        if kind == "bcast":
+            groups.setdefault(("MPI_SUM", dt), []).append(i)
+        elif extra in _RED_OPS:
+            groups.setdefault((extra, dt), []).append(i)
+        else:
+            folds.append(i)
+    return (tuple((opname, dt, tuple(slots))
+                  for (opname, dt), slots in groups.items()),
+            tuple(folds))
+
+
+def _build_pack(dev, sig, slots, roots):
+    """Per-rank group pack: flatten + concatenate this rank's pending
+    payloads of one (reducer, dtype) group into ONE buffer (offset
+    table from datatype/device), masking non-root bcast slots to the
+    reducer identity, with the output committed to the rank's own mesh
+    device.  Packing on the owning rank's thread is what keeps the
+    batch meeting point cheap: the last arriver assembles G committed
+    group buffers instead of moving N stray slot arrays."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import SingleDeviceSharding
+
+    from ompi_tpu.datatype.device import pack_segments
+
+    def body(*xs):
+        flats = []
+        for j in range(len(slots)):
+            f = xs[j].reshape(-1)
+            if roots[j] is False:  # non-root bcast: contribute zeros
+                f = jnp.zeros_like(f)
+            flats.append(f)
+        return pack_segments(flats)
+
+    return jax.jit(body, out_shardings=SingleDeviceSharding(dev))
+
+
+def _build_fused_mesh(mesh, sig):
+    """One jitted shard_map running a whole fused batch on the comm
+    mesh.  Inputs are the per-rank packed group buffers (one per
+    (reducer, dtype) group, already masked and concatenated by
+    _build_pack) followed by the raw gather-fold slots; each group is
+    reduced with ONE psum/pmax/pmin over the concatenation and sliced
+    back out at the static offsets."""
+    import jax
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from ompi_tpu.coll import device
+    from ompi_tpu.datatype.device import segment_offsets
+
+    n = len(sig)
+    red_map = {"MPI_SUM": lax.psum, "MPI_MAX": lax.pmax,
+               "MPI_MIN": lax.pmin}
+    groups, folds = _group_plan(sig)
+
+    def body(*xs):
+        outs = [None] * n
+        for gi, (opname, _dt, slots) in enumerate(groups):
+            shapes = [sig[i][1] for i in slots]
+            offs, lens, _total = segment_offsets(shapes)
+            red = red_map[opname](xs[gi], "r")
+            for j, i in enumerate(slots):
+                outs[i] = red[offs[j]:offs[j] + lens[j]].reshape(shapes[j])
+        for fi, i in enumerate(folds):
+            fold = device._fold_fn(sig[i][3])
+            outs[i] = fold(lax.all_gather(xs[len(groups) + fi], "r",
+                                          tiled=False))
+        return tuple(outs)
+
+    nin = len(groups) + len(folds)
+    return jax.jit(device.shard_map_compat(
+        body, mesh, (P("r"),) * nin, (P(None),) * n))
+
+
+def _build_fused_hbm(size, sig):
+    """Fused batch for single-chip comms (coll/hbm): one jit taking
+    slot-major ``n*size`` shards; each slot stacks + reduces (or picks
+    the root shard for bcast).  The win is the single dispatch."""
+    import jax
+    import jax.numpy as jnp
+
+    from ompi_tpu.coll import device
+
+    n = len(sig)
+
+    def body(*xs):
+        outs = []
+        for i, (kind, shape, dt, extra) in enumerate(sig):
+            shards = xs[i * size:(i + 1) * size]
+            if kind == "bcast":
+                outs.append(shards[extra])
+            elif extra == "MPI_SUM":
+                outs.append(jnp.sum(jnp.stack(shards), axis=0))
+            elif extra == "MPI_MAX":
+                outs.append(jnp.max(jnp.stack(shards), axis=0))
+            elif extra == "MPI_MIN":
+                outs.append(jnp.min(jnp.stack(shards), axis=0))
+            else:
+                outs.append(device._fold_fn(extra)(jnp.stack(shards)))
+        return tuple(outs)
+
+    return jax.jit(body)
+
+
+class _FusionEngine:
+    """Per-comm, per-rank staging area for pending fusible collectives.
+    Single-threaded (each rank owns its comm object); flush runs the
+    whole batch through ONE device.meet rendezvous."""
+
+    def __init__(self, comm) -> None:
+        from ompi_tpu.coll import device
+        self.comm = comm
+        prov = getattr(comm.coll, "providers", None) or {}
+        m = prov.get("allreduce_arr")
+        self.mode = m if m in ("tpu", "hbm") else None
+        self.pending = []
+        self._abort_check = device.TpuCollModule._abort_check(None, comm)
+        # finalize hook registration happens HERE, not first meet():
+        # a batch enqueued and never waited on must still flush at
+        # MPI_Finalize, even if no blocking collective ever ran
+        device.track_state(comm.state)
+
+    def enqueue(self, kind, x, extra, nbytes) -> FusedRequest:
+        if getattr(x, "ndim", None) == 0:
+            x, was_scalar = x.reshape(1), True
+        else:
+            was_scalar = False
+        req = FusedRequest(self.comm.state.progress, self)
+        self.pending.append(
+            _Pending(kind, x, extra, was_scalar, nbytes, req))
+        if len(self.pending) >= max(1, _max_ops_var.value):
+            self.flush()
+        return req
+
+    def flush(self) -> None:
+        if not self.pending:
+            return
+        batch, self.pending = self.pending, []
+        try:
+            outs = self._run(batch)
+        except BaseException as e:  # noqa: BLE001
+            for p in batch:
+                p.req._fail(e)
+            raise
+        nbytes = 0
+        for p, out in zip(batch, outs):
+            nbytes += p.nbytes
+            p.req._deliver(out.reshape(()) if p.was_scalar else out)
+        _pv_batches.add(1)
+        _pv_colls.add(len(batch))
+        _pv_bytes.add(nbytes)
+
+    def _pack_groups(self, sig, batch):
+        """Mesh-mode deposit payload: this rank's slots packed into one
+        committed buffer per (reducer, dtype) group (masked for bcast)
+        followed by the raw gather-fold slots.  Runs on the owning
+        rank's thread BEFORE the rendezvous, so the batch meeting point
+        only assembles G pre-placed group buffers — the placement cost
+        that used to serialize on the last arriver."""
+        import jax
+
+        from ompi_tpu.coll import device
+
+        comm = self.comm
+        mesh = comm.mesh()
+        my_dev = mesh.devices.reshape(-1)[comm.rank]
+        groups, folds = _group_plan(sig)
+        deposit = []
+        for gi, (opname, dt, slots) in enumerate(groups):
+            roots = tuple(
+                (sig[i][3] == comm.rank) if sig[i][0] == "bcast"
+                else None for i in slots)
+            packfn = device.compile_cache.get(
+                ("fusedpack", my_dev.id, sig, gi, roots),
+                lambda d=my_dev, s=slots, r=roots:
+                    _build_pack(d, sig, s, r))
+            args = [batch[i].x for i in slots]
+            try:
+                deposit.append(packfn(*args))
+            except ValueError:
+                # inputs committed to clashing devices: canonicalize
+                deposit.append(packfn(*[jax.device_put(a, my_dev)
+                                        for a in args]))
+        deposit.extend(batch[i].x for i in folds)
+        return deposit
+
+    def _run(self, batch):
+        from ompi_tpu.coll import device
+
+        comm = self.comm
+        size = comm.size
+        sig = tuple(
+            (p.kind, tuple(p.x.shape), np.dtype(p.x.dtype).str, p.extra)
+            for p in batch)
+        if self.mode == "hbm":
+            import jax
+            arrays = [p.x if device._is_jax_array(p.x)
+                      else jax.device_put(np.asarray(p.x),
+                                          comm.state.device)
+                      for p in batch]
+        else:
+            arrays = self._pack_groups(sig, batch)
+        mode = self.mode
+
+        def fn(shards):
+            sig0 = shards[0][0]
+            for r, (s, _a) in enumerate(shards):
+                if s != sig0:
+                    raise RuntimeError(
+                        f"fused-collective batch mismatch: rank {r} "
+                        f"enqueued {s} but rank 0 enqueued {sig0}; "
+                        "every member must issue the same nonblocking "
+                        "device collectives between flushes")
+            nslots = len(sig0)
+            if mode == "hbm":
+                args = [shards[r][1][i]
+                        for i in range(nslots) for r in range(size)]
+                jfn = device.compile_cache.get(
+                    ("fused_hbm", size, sig0),
+                    lambda: _build_fused_hbm(size, sig0))
+                outs = jfn(*args)
+            else:
+                mesh = comm.mesh()
+                dev_key = tuple(
+                    d.id for d in mesh.devices.reshape(-1))
+                groups0, folds0 = _group_plan(sig0)
+                nin = len(groups0) + len(folds0)
+                ins = [
+                    device._assemble(
+                        mesh, [shards[r][1][j] for r in range(size)])
+                    for j in range(nin)]
+                jfn = device.compile_cache.get(
+                    ("fused", dev_key, sig0),
+                    lambda: _build_fused_mesh(mesh, sig0))
+                outs = jfn(*ins)
+            # every output is replicated (psum/root-pick): all ranks
+            # read the same arrays
+            return [list(outs)] * size
+
+        return device.meet(comm, (sig, arrays), fn, self._abort_check)
+
+
+def _engine(comm) -> _FusionEngine:
+    eng = comm.__dict__.get("_fusion_engine")
+    if eng is None:
+        eng = comm.__dict__["_fusion_engine"] = _FusionEngine(comm)
+    return eng
+
+
+def _as_arr(x):
+    return x if hasattr(x, "dtype") and hasattr(x, "reshape") \
+        else np.asarray(x)
+
+
+def _eligible(comm, kind: str, x, opname, nbytes: int) -> bool:
+    """Comm-consistent fusion gate: depends only on comm properties,
+    the MCA knobs (process-wide), and dtype/op/nbytes — all of which
+    MPI requires to match across members."""
+    from ompi_tpu.coll import device
+    if not _fusion_var.value or comm.size == 1:
+        return False
+    if _engine(comm).mode is None:
+        return False
+    if device._dtype_of(x).fields is not None:
+        return False
+    if kind == "allreduce" and opname not in device._XLA_REDUCERS \
+            and opname not in device._GATHER_FOLD:
+        return False
+    return 0 < nbytes <= max(0, _threshold_var.value)
+
+
+def _immediate(comm, value) -> FusedRequest:
+    req = FusedRequest(comm.state.progress, None)
+    req._deliver(value)
+    return req
+
+
+def iallreduce_arr(comm, x, op: Op) -> FusedRequest:
+    """Nonblocking device-array allreduce; small payloads coalesce
+    into the comm's pending fusion batch."""
+    x = _as_arr(x)
+    nbytes = _nbytes_of(x)
+    if _eligible(comm, "allreduce", x, op.name, nbytes):
+        return _engine(comm).enqueue("allreduce", x, op.name, nbytes)
+    return _immediate(comm, comm.coll.allreduce_arr(comm, x, op))
+
+
+def ibcast_arr(comm, x, root: int = 0) -> FusedRequest:
+    """Nonblocking device-array broadcast; small payloads coalesce
+    into the comm's pending fusion batch (masked-psum slot of the
+    fused call)."""
+    x = _as_arr(x)
+    nbytes = _nbytes_of(x)
+    if _eligible(comm, "bcast", x, None, nbytes):
+        return _engine(comm).enqueue("bcast", x, int(root), nbytes)
+    return _immediate(comm, comm.coll.bcast_arr(comm, x, root))
+
+
+def flush_comm(comm) -> None:
+    """Run this comm's pending fusion batch now (collective over the
+    comm: all members must flush)."""
+    eng = comm.__dict__.get("_fusion_engine")
+    if eng is not None:
+        eng.flush()
+
+
+def flush_state(state) -> None:
+    """Finalize hook: flush every comm's pending batch for this rank
+    so no enqueued collective dies with the process (runs before the
+    finalize fence — peers are still alive to rendezvous)."""
+    first = None
+    for comm in list(getattr(state, "comms", {}).values()):
+        if comm is None:  # freed comm leaves its cid slot behind
+            continue
+        try:
+            flush_comm(comm)
+        except BaseException as e:  # noqa: BLE001
+            if first is None:
+                first = e
+    if first is not None:
+        raise first

@@ -448,6 +448,21 @@ class NbcModule(CollModule):
                     tb.flush()
         return done
 
+    # -- nonblocking device-array collectives ----------------------------
+    # Surfaced here (the nbc engine is the nonblocking entry point of
+    # the coll stack) but executed by coll/fusion: pending small device
+    # payloads coalesce into one fused XLA dispatch instead of a
+    # round-based p2p schedule.  Lazy import: fusion pulls coll/device,
+    # which this module must not load at import time.
+
+    def iallreduce_arr(self, comm, x, op):
+        from ompi_tpu.coll import fusion
+        return fusion.iallreduce_arr(comm, x, op)
+
+    def ibcast_arr(self, comm, x, root):
+        from ompi_tpu.coll import fusion
+        return fusion.ibcast_arr(comm, x, root)
+
     def ibarrier(self, comm):
         return NBCRequest(comm, sched_barrier(comm, _nbc_tag(comm)))
 

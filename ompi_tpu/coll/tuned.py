@@ -16,6 +16,7 @@ import os
 from typing import Callable, Dict, Optional
 
 from ompi_tpu.coll import base as alg
+from ompi_tpu.coll import calibrate
 from ompi_tpu.coll.basic import P2PCollModule, _is_pow2
 from ompi_tpu.coll.framework import CollComponent, coll_framework
 from ompi_tpu.mca.params import registry
@@ -134,7 +135,11 @@ class TunedModule(P2PCollModule):
             # reduce+bcast moves the same total bytes as ring
             # (2(N-1)*nbytes) in 2(N-1) messages instead of 2(N-1)*N.
             return alg.allreduce_reduce_bcast
-        if nbytes < _small_var.value and _is_pow2(comm.size):
+        # measured crossover (coll_tuned_use_measured_rules) replaces
+        # the static 10 KB cutoff; falls back to it when rules are off
+        small = calibrate.measured_threshold(
+            "allreduce_small", comm.size, _small_var.value)
+        if nbytes < small and _is_pow2(comm.size):
             return alg.allreduce_recursivedoubling
         if nbytes // max(1, comm.size) > 0:
             if nbytes > _seg_var.value * comm.size:
@@ -149,7 +154,9 @@ class TunedModule(P2PCollModule):
         fn = self._rule("bcast", nbytes)
         if fn is not None:
             return fn
-        if nbytes > 256 * 1024 and comm.size > 2:
+        pipe = calibrate.measured_threshold(
+            "bcast_pipeline", comm.size, 256 * 1024)
+        if nbytes > pipe and comm.size > 2:
             return alg.bcast_pipeline
         return alg.bcast_binomial
 
@@ -167,7 +174,9 @@ class TunedModule(P2PCollModule):
         fn = self._rule("alltoall", nbytes)
         if fn is not None:
             return fn
-        if nbytes <= 1024 and comm.size >= 8:
+        bruck = calibrate.measured_threshold(
+            "alltoall_bruck", comm.size, 1024)
+        if nbytes <= bruck and comm.size >= 8:
             return alg.alltoall_bruck
         return alg.alltoall_pairwise
 

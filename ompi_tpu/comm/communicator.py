@@ -807,6 +807,23 @@ class Communicator:
         """perm: [(src_rank, dst_rank), ...] — mesh-neighbor shift."""
         return self.coll.ppermute_arr(self, x, perm)
 
+    # -- nonblocking device-array collectives (the fusion surface) ------
+    # Small payloads coalesce into one fused XLA dispatch (coll/fusion);
+    # the returned request's .result holds the output after .wait().
+
+    def iallreduce_arr(self, x, op):
+        return self.coll.iallreduce_arr(self, x, op)
+
+    def ibcast_arr(self, x, root: int = 0):
+        return self.coll.ibcast_arr(self, x, root)
+
+    def flush_arr(self) -> None:
+        """Dispatch this comm's pending fused collectives now
+        (collective: every member must flush — wait()/finalize also
+        flush implicitly)."""
+        from ompi_tpu.coll import fusion
+        fusion.flush_comm(self)
+
     # -- device point-to-point (btl/tpu shim; see ompi_tpu/btl/tpu) ----
     def send_arr(self, x, dst, tag: int = 0) -> None:
         from ompi_tpu.btl import tpu as _tpu

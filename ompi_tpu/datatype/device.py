@@ -97,3 +97,44 @@ def device_unpack(datatype: Datatype, count: int, packed, out):
 
 def is_device_packable(datatype: Datatype, count: int) -> bool:
     return element_indices(datatype, count) is not None
+
+
+# ---------------------------------------------------------------------------
+# segment packing for fused collectives (coll/fusion): N small payloads
+# ride one flattened buffer; the offset table is host-side static so the
+# pack/unpack slices bake into the fused executable
+# ---------------------------------------------------------------------------
+
+def segment_offsets(shapes):
+    """Offset table for a flat concatenation of arrays with the given
+    shapes: (offsets, lengths, total_elements).  Host-side and static —
+    fused-collective bodies slice with these as compile-time constants
+    (0-d shapes contribute one element)."""
+    offs, lens = [], []
+    total = 0
+    for sh in shapes:
+        n = 1
+        for d in sh:
+            n *= int(d)
+        offs.append(total)
+        lens.append(n)
+        total += n
+    return tuple(offs), tuple(lens), total
+
+
+def pack_segments(arrays):
+    """Flatten + concatenate payloads into one fused buffer.  Must be
+    called INSIDE a jitted body: eager reshapes/concats each cost a
+    device dispatch on the tunneled backend, which is exactly the
+    constant fusion exists to amortize."""
+    import jax.numpy as jnp
+
+    return jnp.concatenate([a.reshape(-1) for a in arrays])
+
+
+def unpack_segments(flat, shapes):
+    """Mirror of pack_segments: slice the fused buffer back into the
+    original shapes (static slices; fuses into the surrounding jit)."""
+    offs, lens, _ = segment_offsets(shapes)
+    return [flat[o:o + n].reshape(sh)
+            for o, n, sh in zip(offs, lens, shapes)]

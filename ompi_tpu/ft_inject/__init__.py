@@ -151,6 +151,25 @@ def kv_injector(rank: int) -> Optional[KvInjector]:
     return KvInjector("kv", rank, p)
 
 
+class CollInjector(_Scoped):
+    """Straggler simulation at the device-collective rendezvous: a
+    'delay' roll holds the depositing rank-thread before it arrives,
+    so fused batches are exercised with arbitrary arrival orders."""
+
+    def maybe_delay(self) -> float:
+        """Returns seconds to sleep before depositing (0 = clean)."""
+        if self._roll() == "delay":
+            return max(0, _delay_ms_var.value) / 1000.0
+        return 0.0
+
+
+def coll_injector(rank: int) -> Optional[CollInjector]:
+    p = {c: r for c, r in plan().items() if c == "delay"}
+    if not p:
+        return None
+    return CollInjector("coll", rank, p)
+
+
 def node_faults(node_id: int) -> List[str]:
     """Node-level scenario classes armed on THIS node (the daemon
     consults this once at startup and arms timers)."""

@@ -176,6 +176,10 @@ def mpi_finalize(state: ProcState) -> None:
     # is a standing flag, not a one-shot disarm
     state.progress.suppress_interrupts = True
     state.progress.interrupt = None
+    # flush deferred work (fused device collectives, dispatcher queue)
+    # BEFORE the fence: a flush may need one last cross-rank
+    # rendezvous, so peers must still be alive and symmetric here
+    state.progress.run_finalize_hooks()
     # barrier, then teardown in reverse (ref: ompi_mpi_finalize.c:101)
     state.rte.fence()
     for m in state.btls:

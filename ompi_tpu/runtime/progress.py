@@ -87,6 +87,11 @@ class Progress:
         # while we're awake and polling anyway (futex-style protocol)
         self._park_set: list = []
         self._park_clear: list = []
+        # finalize hooks: subsystems with pending deferred work (fused
+        # device collectives, the device dispatcher queue) flush here.
+        # mpi_finalize runs them BEFORE the finalize fence so a flush
+        # that needs a cross-rank rendezvous still has live peers.
+        self._finalize_hooks: List[Callable[[], None]] = []
 
     def deferred_interrupts(self):
         """Context manager: hold any armed ft interrupt until exit.
@@ -203,6 +208,27 @@ class Progress:
                 os.write(self._wake_wfd, b"\x01")
             except (BlockingIOError, OSError):
                 pass
+
+    def register_finalize_hook(self, cb: Callable[[], None]) -> None:
+        """Idempotent: re-registering the same callable is a no-op."""
+        with self._lock:
+            if cb not in self._finalize_hooks:
+                self._finalize_hooks.append(cb)
+
+    def run_finalize_hooks(self) -> None:
+        """Run and clear all finalize hooks.  Every hook runs even if
+        an earlier one raises; the first error is re-raised after."""
+        with self._lock:
+            hooks, self._finalize_hooks = self._finalize_hooks, []
+        first: Optional[BaseException] = None
+        for cb in hooks:
+            try:
+                cb()
+            except BaseException as e:  # noqa: BLE001
+                if first is None:
+                    first = e
+        if first is not None:
+            raise first
 
     def register(self, cb: Callable[[], int], low_priority: bool = False) -> None:
         with self._lock:

@@ -350,3 +350,84 @@ def test_ring_attention_example_exact():
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     mod.main()
+
+
+# ---------------------------------------------------------------------------
+# bounded compiled-collective cache (CompiledLRU)
+# ---------------------------------------------------------------------------
+
+def test_compile_cache_hit_skips_recompilation():
+    """Repeating a (kind, mesh, shape, dtype, op) must reuse the
+    compiled executable — asserted via the build trace counter, never
+    timing."""
+    from ompi_tpu.coll.device import compile_cache
+    from ompi_tpu.mca.params import registry
+
+    pv_hits = registry.register_pvar("coll", "device", "cache_hits")
+
+    def fn(comm):
+        x = _put(comm, jnp.arange(128, dtype=jnp.float32) + comm.rank)
+        return np.asarray(comm.allreduce_arr(x, mpi_op.SUM)).sum()
+
+    run_ranks(4, fn, devices=True)  # warm: compiles at most once
+    builds0, hits0 = compile_cache.builds, pv_hits.read()
+    run_ranks(4, fn, devices=True)  # identical world + shape: all hits
+    assert compile_cache.builds == builds0
+    assert pv_hits.read() > hits0
+
+
+def test_compile_cache_lru_bound_under_shape_churn():
+    """coll_device_cache_max is enforced: a churn of distinct shapes
+    evicts LRU entries instead of growing without bound, and the
+    eviction pvar moves."""
+    from ompi_tpu.coll.device import compile_cache
+    from ompi_tpu.mca.params import registry
+
+    pv_evict = registry.register_pvar("coll", "device",
+                                      "cache_evictions")
+    old = registry.get("coll_device_cache_max")
+    registry.set("coll_device_cache_max", 4)
+    try:
+        def fn(comm):
+            tot = 0.0
+            for n in range(1, 11):  # 10 distinct shapes
+                x = _put(comm, jnp.ones((8 * n,), jnp.float32))
+                tot += float(np.asarray(
+                    comm.allreduce_arr(x, mpi_op.SUM))[0])
+            return tot
+
+        e0 = pv_evict.read()
+        res = run_ranks(2, fn, devices=True)
+        assert res == [20.0, 20.0]
+        assert len(compile_cache) <= 4
+        assert pv_evict.read() > e0
+    finally:
+        registry.set("coll_device_cache_max", old)
+
+
+def test_compile_cache_fusion_signature_keys():
+    """Fused batches key the cache on their full fusion signature:
+    two different batch compositions are distinct fused entries (plus
+    the per-rank pack helpers), and replaying the same compositions
+    compiles nothing new."""
+    from ompi_tpu.coll.device import compile_cache
+
+    def fn(comm):
+        q1 = comm.iallreduce_arr(jnp.arange(4, dtype=jnp.int32),
+                                 mpi_op.SUM)
+        comm.flush_arr()
+        q2 = comm.iallreduce_arr(jnp.arange(4, dtype=jnp.int32),
+                                 mpi_op.SUM)
+        q3 = comm.ibcast_arr(jnp.ones((2,), jnp.float32), 0)
+        comm.flush_arr()
+        return q1.complete and q2.complete and q3.complete
+
+    def fused_keys():
+        return {k for k in compile_cache._d if k[0] == "fused"}
+
+    k0 = fused_keys()
+    assert all(run_ranks(2, fn, devices=True))
+    assert len(fused_keys() - k0) == 2  # one fused exe per signature
+    b1 = compile_cache.builds
+    assert all(run_ranks(2, fn, devices=True))
+    assert compile_cache.builds == b1  # warm replay: all cache hits
