@@ -129,6 +129,13 @@ def main() -> None:
                          "the fusion amortization ratio; persist under "
                          "'probe_dispatch' in BENCH_DETAIL.json and "
                          "refresh the coll/calibrate profile")
+    ap.add_argument("--trace-overhead", action="store_true",
+                    help="Measure small-message latency with span "
+                         "tracing off vs on (interleaved reps), "
+                         "snapshot the latency-histogram pvars, "
+                         "persist under 'trace_overhead' in "
+                         "BENCH_DETAIL.json, and FAIL (exit 1) if the "
+                         "traced path costs more than 5%%")
     opts = ap.parse_args()
 
     detail_path = os.path.join(
@@ -158,6 +165,33 @@ def main() -> None:
             line.pop("crossover_bytes", None)
             out = json.dumps(line)
         print(out)
+        return
+
+    if opts.trace_overhead:
+        from benchmarks.trace_overhead import persist, run_probe
+
+        probe = run_probe()
+        notes = persist(probe, detail_path)
+        line = {
+            "metric": f"trace overhead, {probe['nranks']} ranks x "
+                      f"{probe['payload_bytes']} B allreduce "
+                      f"(best-of-{probe['reps']} interleaved)",
+            "value": probe["overhead_pct"],
+            "unit": "pct_vs_untraced",
+            "off_us_per_op": probe["off_us_per_op"],
+            "on_us_per_op": probe["on_us_per_op"],
+            "within_budget": probe["within_budget"],
+        }
+        line.update({k: v for k, v in notes.items() if "error" in k})
+        sys.stderr.write(json.dumps(probe, indent=1) + "\n")
+        print(json.dumps(line))
+        if not probe["within_budget"]:
+            # the acceptance contract: >5% tracing overhead is a
+            # regression, and it fails LOUDLY, never as a footnote
+            sys.stderr.write(
+                f"FAIL: tracing overhead {probe['overhead_pct']}% "
+                f"exceeds the {probe['budget_pct']}% budget\n")
+            sys.exit(1)
         return
 
     if opts.quick:
@@ -272,9 +306,9 @@ def main() -> None:
         prior = {}
     try:
         with open(detail_path, "w") as f:
-            json.dump({**({"probe_dispatch": prior["probe_dispatch"]}
-                          if isinstance(prior, dict)
-                          and "probe_dispatch" in prior else {}),
+            json.dump({**{k: prior[k]
+                          for k in ("probe_dispatch", "trace_overhead")
+                          if isinstance(prior, dict) and k in prior},
                        "device_us": dev, "software_us": sw,
                        "software_tuned_tcp_us": sw_tcp,
                        "northstar_per_size": per_size,

@@ -444,9 +444,23 @@ def meet(comm, value, fn, abort_check) -> Any:
         d = inj.maybe_delay()
         if d:
             time.sleep(d)
-    count_offload(comm, int(getattr(value, "nbytes", 0) or 0))
-    return rv.run(comm.rank, value, fn, abort_check,
-                  progress=comm.state.progress)
+    nbytes = int(getattr(value, "nbytes", 0) or 0)
+    count_offload(comm, nbytes)
+    tr = comm.state.tracer
+    if tr is None:
+        return rv.run(comm.rank, value, fn, abort_check,
+                      progress=comm.state.progress)
+    # dispatch span: entry->rendezvous-release of the device fast path
+    # (cat coll_dispatch feeds the dispatch-latency histogram); the
+    # per-comm sequence number is the straggler correlation key
+    seq = comm.__dict__.get("_dev_seq", 0)
+    comm.__dict__["_dev_seq"] = seq + 1
+    t0 = tr.start()
+    out = rv.run(comm.rank, value, fn, abort_check,
+                 progress=comm.state.progress)
+    tr.end(t0, "meet", "coll_dispatch", cid=comm.cid, seq=seq,
+           nbytes=nbytes)
+    return out
 
 
 def _get_rendezvous(comm) -> Rendezvous:
@@ -522,7 +536,14 @@ class CompiledLRU:
                 return fn
         self.pv_misses.add(1)
         self.builds += 1
-        fn = builder()
+        from ompi_tpu import trace as _trace
+        tr = _trace.current_tracer()
+        if tr is None:
+            fn = builder()
+        else:
+            t0 = tr.start()
+            fn = builder()
+            tr.end(t0, "xla_compile", "compile", key=str(key[0]))
         with self._lock:
             self._d[key] = fn
             self._d.move_to_end(key)

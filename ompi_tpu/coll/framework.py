@@ -77,6 +77,27 @@ class CollComponent(Component):
         return self.comm_query(comm)
 
 
+def _instrumented(fname: str, fn):
+    """Entry shim over a winning blocking collective: the SHARED
+    instrumentation point for span tracing and the extended PERUSE
+    coll events (ompi_tpu/trace coll_begin/coll_end).  When both
+    systems are off, coll_begin returns None after one flag check and
+    the shim is a bare pass-through — nonblocking collectives are not
+    shimmed (their lifecycle is observed by the nbc hooks instead)."""
+    from ompi_tpu import trace
+
+    def shim(comm, *args, **kwargs):
+        tok = trace.coll_begin(comm, fname)
+        if tok is None:
+            return fn(comm, *args, **kwargs)
+        out = fn(comm, *args, **kwargs)
+        trace.coll_end(comm, fname, tok)
+        return out
+
+    shim._coll_inner = fn  # the unwrapped provider, for introspection
+    return shim
+
+
 def comm_select(comm) -> None:
     """Stack modules on a communicator (coll_base_comm_select analog)."""
     if getattr(comm, "is_inter", False):
@@ -95,7 +116,12 @@ def comm_select(comm) -> None:
         for fname in COLL_FUNCS:
             fn = getattr(module, fname, None)
             if fn is not None:
-                setattr(merged, fname, fn)
+                # blocking collectives get the entry-span shim; the
+                # i* surface completes asynchronously and is observed
+                # at its own lifecycle points (nbc/fusion hooks)
+                setattr(merged, fname,
+                        fn if fname.startswith("i")
+                        else _instrumented(fname, fn))
                 merged.providers[fname] = component.name
     comm.coll = merged
     # verify the mandatory blocking set is covered

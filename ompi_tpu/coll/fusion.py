@@ -274,12 +274,17 @@ class _FusionEngine:
         if not self.pending:
             return
         batch, self.pending = self.pending, []
+        tr = self.comm.state.tracer
+        t0 = tr.start() if tr is not None else None
         try:
             outs = self._run(batch)
         except BaseException as e:  # noqa: BLE001
             for p in batch:
                 p.req._fail(e)
             raise
+        if tr is not None:
+            tr.end(t0, "fused_flush", "coll", cid=self.comm.cid,
+                   ops=len(batch))
         nbytes = 0
         for p, out in zip(batch, outs):
             nbytes += p.nbytes
@@ -300,6 +305,8 @@ class _FusionEngine:
         from ompi_tpu.coll import device
 
         comm = self.comm
+        tr = comm.state.tracer
+        t0 = tr.start() if tr is not None else None
         mesh = comm.mesh()
         my_dev = mesh.devices.reshape(-1)[comm.rank]
         groups, folds = _group_plan(sig)
@@ -320,6 +327,9 @@ class _FusionEngine:
                 deposit.append(packfn(*[jax.device_put(a, my_dev)
                                         for a in args]))
         deposit.extend(batch[i].x for i in folds)
+        if tr is not None:
+            tr.end(t0, "fused_pack", "coll", cid=comm.cid,
+                   groups=len(groups), slots=len(sig))
         return deposit
 
     def _run(self, batch):

@@ -100,6 +100,7 @@ class _Scoped:
 
     def __init__(self, scope: str, rank: int,
                  classes: Dict[str, float]) -> None:
+        self.scope = scope
         self.classes = classes
         self._rng = random.Random(f"{_seed_var.value}:{scope}:{rank}")
         self._count = 0
@@ -115,6 +116,14 @@ class _Scoped:
         for cls, rate in self.classes.items():
             if self._rng.random() < rate:
                 self._injected += 1
+                # annotate the span timeline: a fault firing explains
+                # the latency spike around it (trace is a leaf module;
+                # import here keeps injection import-light when off)
+                from ompi_tpu import trace
+                tr = trace.current_tracer()
+                if tr is not None:
+                    tr.instant("ft_inject", "fault", cls=cls,
+                               scope=self.scope)
                 return cls
         return None
 

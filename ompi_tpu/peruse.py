@@ -17,6 +17,17 @@ Events fired by pml/ob1:
     req_match_unex — an incoming message was queued unexpected
     req_complete   — a request completed (kind, bytes)
 
+Events fired by the shared collective hooks (ompi_tpu/trace — the
+span tracer and PERUSE observe the SAME instrumentation points):
+
+    coll_begin     — a blocking collective entered its merged-vtable
+                     shim (cid, coll, seq)
+    coll_end       — that collective returned (cid, coll, seq)
+    nbc_activate   — a nonblocking-collective schedule was activated
+                     (cid, coll, seq)
+    nbc_complete   — that schedule finished its last round
+                     (cid, coll, seq)
+
 Usage:
 
     from ompi_tpu import peruse
@@ -30,7 +41,10 @@ from __future__ import annotations
 from typing import Callable, Dict, List
 
 EVENTS = ("req_activate", "req_match", "req_match_unex",
-          "req_complete")
+          "req_complete",
+          # collective / nonblocking-collective lifecycle (fired by
+          # the shared hooks in ompi_tpu/trace)
+          "coll_begin", "coll_end", "nbc_activate", "nbc_complete")
 
 # the pml checks this single flag before building event payloads
 enabled = False

@@ -27,6 +27,13 @@ enable_var = registry.register(
     help="Interpose the monitoring layer over the selected pml and "
          "count per-peer messages/bytes (user vs internal traffic)")
 
+dump_path_var = registry.register(
+    "pml", "monitoring", "dump_path", "", str,
+    help="Prefix for the finalize-time traffic-matrix dump: each rank "
+         "writes {path}.{rank}.prof ('src dst msgs bytes' lines) and "
+         "rank 0 aggregates them into {path}_msg.mat / _size.mat / "
+         "_avg.mat (profile2mat.pl semantics)")
+
 
 def _internal(tag: int) -> bool:
     """Internal traffic posts exact negative tags; ANY_TAG (-1) is a
@@ -200,3 +207,81 @@ def maybe_wrap(pml, state):
     if registry.lookup("pml", "monitoring", "enable", False):
         return MonitoringPml(pml, state)
     return pml
+
+
+def _find_monitor(state) -> Optional[MonitoringPml]:
+    """Unwrap the pml interposition chain (vprotocol may sit on top of
+    monitoring) down to the MonitoringPml layer, if present."""
+    pml = getattr(state, "pml", None)
+    seen = 0
+    while pml is not None and seen < 8:
+        if isinstance(pml, MonitoringPml):
+            return pml
+        pml = pml.__dict__.get("_pml")
+        seen += 1
+    return None
+
+
+def finalize_dump(state) -> None:
+    """Per-rank finalize-time dump (called from mpi_finalize BEFORE the
+    fence so every rank's .prof exists when rank 0 aggregates)."""
+    path = registry.lookup("pml", "monitoring", "dump_path", "")
+    if not path:
+        return
+    mon = _find_monitor(state)
+    if mon is None:
+        return
+    try:
+        mon.dump(f"{path}.{state.rank}.prof")
+    except OSError:
+        pass  # an unwritable dump path must not break finalize
+
+
+def finalize_aggregate(state) -> None:
+    """Rank 0 merges the per-rank .prof files into the three matrices
+    (called AFTER the fence — all dumps are on disk by then)."""
+    path = registry.lookup("pml", "monitoring", "dump_path", "")
+    if not path or _find_monitor(state) is None:
+        return
+    world = getattr(state, "comm_world", None)
+    if world is None or world.rank != 0:
+        return
+    try:
+        profile2mat(path)
+    except (OSError, ValueError):
+        pass
+
+
+def profile2mat(prefix: str) -> Dict[str, List[List[float]]]:
+    """test/monitoring/profile2mat.pl analog: glob {prefix}.*.prof,
+    parse 'src dst msgs bytes' lines, and write three N x N
+    space-separated matrices — {prefix}_msg.mat (message counts),
+    {prefix}_size.mat (byte totals), {prefix}_avg.mat (bytes/msg).
+    Returns the matrices for tests."""
+    import glob as _glob
+
+    entries: List[tuple] = []
+    nmax = -1
+    for fname in sorted(_glob.glob(f"{prefix}.*.prof")):
+        with open(fname) as fh:
+            for line in fh:
+                parts = line.split()
+                if len(parts) != 4:
+                    continue
+                src, dst, msgs, nbytes = (int(parts[0]), int(parts[1]),
+                                          int(parts[2]), int(parts[3]))
+                entries.append((src, dst, msgs, nbytes))
+                nmax = max(nmax, src, dst)
+    n = nmax + 1
+    msg = [[0] * n for _ in range(n)]
+    size = [[0] * n for _ in range(n)]
+    for src, dst, msgs, nbytes in entries:
+        msg[src][dst] += msgs
+        size[src][dst] += nbytes
+    avg = [[(size[i][j] / msg[i][j] if msg[i][j] else 0.0)
+            for j in range(n)] for i in range(n)]
+    for suffix, mat in (("_msg", msg), ("_size", size), ("_avg", avg)):
+        with open(f"{prefix}{suffix}.mat", "w") as fh:
+            for row in mat:
+                fh.write(" ".join(f"{v:g}" for v in row) + "\n")
+    return {"msg": msg, "size": size, "avg": avg}

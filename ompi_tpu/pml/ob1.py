@@ -70,7 +70,8 @@ MSEG = "MG"        # segmented MATCH: vprotocol replay of payloads
 
 
 class SendRequest(Request):
-    __slots__ = ("conv", "req_id", "total", "dst", "acked", "mc_crc")
+    __slots__ = ("conv", "req_id", "total", "dst", "acked", "mc_crc",
+                 "tr")
 
     def __init__(self, progress, conv, req_id, dst):
         super().__init__(progress)
@@ -78,16 +79,18 @@ class SendRequest(Request):
         self.req_id = req_id
         self.total = conv.packed_size
         self.dst = dst
+        self.tr = None  # (t0, mid) while a span tracer is attached
 
 
 class RecvRequest(Request):
     __slots__ = ("conv", "req_id", "src", "tag", "cid", "matched",
                  "expected", "received", "incoming", "_canceller",
-                 "_held")
+                 "_held", "tr")
 
     def __init__(self, progress, conv, req_id, src, tag, cid):
         super().__init__(progress)
         self._canceller = None
+        self.tr = None  # [t0, mid] while a span tracer is attached
         self.conv = conv
         self.req_id = req_id
         self.src = src
@@ -155,6 +158,10 @@ class PmlOb1:
         # drains until every pair's counts match (see ompi_tpu/cr).
         self.cr_sent: Dict[int, int] = {}
         self.cr_arrived: Dict[int, int] = {}
+        # span tracer cached once (mpi_init attaches it before pml
+        # selection): the p2p hot paths pay one is-None check when
+        # tracing is off — the peruse-flag discipline
+        self._tracer = getattr(state, "tracer", None)
         state.progress.register(self.progress)
 
     # -- wiring ----------------------------------------------------------
@@ -206,6 +213,10 @@ class PmlOb1:
         if peruse.enabled:
             peruse.fire("req_activate", kind="send", cid=cid, peer=dst,
                         tag=tag, bytes=conv.packed_size)
+        if self._tracer is not None:
+            # mid = the match id: identical on the receiver's span, so
+            # traceview can stitch the two ranks' timelines together
+            req.tr = (self._tracer.start(), f"{cid}:{src}:{tag}:{seq}")
 
         gsrc = self.state.rank  # global sender id (C/R bookkeeping)
         if conv.packed_size <= btl.eager_limit and mode != MODE_SYNC:
@@ -217,6 +228,8 @@ class PmlOb1:
             if peruse.enabled:
                 peruse.fire("req_complete", kind="send",
                             bytes=req.total)
+            if req.tr is not None:
+                self._trace_p2p_end(req, "send", req.total)
         elif conv.packed_size <= btl.eager_limit:  # sync eager
             payload = conv.pack_bytes()
             self._send_reqs[req_id] = req
@@ -298,6 +311,9 @@ class PmlOb1:
         if peruse.enabled:
             peruse.fire("req_activate", kind="recv", cid=comm.cid,
                         peer=src, tag=tag, bytes=conv.packed_size)
+        if self._tracer is not None:
+            # mid filled at match time (_bind) once src/seq are known
+            req.tr = [self._tracer.start(), None]
         if memchecker.enabled() and buf is not None:
             memchecker.poison_recv(conv)
         # match against buffered unexpected messages first
@@ -418,6 +434,8 @@ class PmlOb1:
         req.incoming = msg.total
         req.status.source = msg.src
         req.status.tag = msg.tag
+        if req.tr is not None:
+            req.tr[1] = f"{msg.cid}:{msg.src}:{msg.tag}:{msg.seq}"
         capacity = req.conv.packed_size
         req.expected = min(msg.total, capacity)
         if msg.total > capacity:
@@ -440,12 +458,21 @@ class PmlOb1:
             req.status.count = min(msg.total, capacity)
             self._finish_recv(req)
 
+    def _trace_p2p_end(self, req, name: str, nbytes: int) -> None:
+        """Close a p2p span (activate → complete); feeds the
+        p2p_complete latency histogram through the tracer."""
+        t0, mid = req.tr
+        req.tr = None
+        self._tracer.end(t0, name, "p2p", mid=mid, bytes=nbytes)
+
     def _finish_recv(self, req: RecvRequest) -> None:
         self._recv_reqs.pop(req.req_id, None)
         req._complete()
         if peruse.enabled:
             peruse.fire("req_complete", kind="recv",
                         bytes=req.status.count)
+        if req.tr is not None:
+            self._trace_p2p_end(req, "recv", req.status.count)
 
     def state_comm_peer(self, cid: int, comm_rank: int) -> int:
         comm = self.state.comms.get(cid)
@@ -496,6 +523,8 @@ class PmlOb1:
                 if peruse.enabled:
                     peruse.fire("req_complete", kind="send",
                                 bytes=req.total)
+                if req.tr is not None:
+                    self._trace_p2p_end(req, "send", req.total)
         elif kind == FRAG:
             _, rreq_id, pos, payload = frag
             self._recv_segment(rreq_id, pos, payload)
@@ -628,6 +657,8 @@ class PmlOb1:
         req._complete()
         if peruse.enabled:
             peruse.fire("req_complete", kind="send", bytes=req.total)
+        if req.tr is not None:
+            self._trace_p2p_end(req, "send", req.total)
 
     def _recv_segment(self, rreq_id: int, pos: int, payload: bytes) -> None:
         req = self._recv_reqs.get(rreq_id)

@@ -26,6 +26,11 @@ def mpi_init(state: ProcState, device=None) -> ProcState:
 
     set_current(state)
     state.device = device
+    # span tracer attach (ompi_tpu/trace) BEFORE pml/coll selection so
+    # every layer constructed below can cache state.tracer (None when
+    # trace_enable is off — the whole hot-path cost)
+    from ompi_tpu import trace as _trace
+    _trace.attach(state)
     # debugger attach support (MPIR analog, ref: ompi/debuggers):
     # SIGUSR1 dumps every thread's stack to stderr so
     # ompi_tpu.tools.attach --stacks can show where a hung job is
@@ -180,10 +185,19 @@ def mpi_finalize(state: ProcState) -> None:
     # BEFORE the fence: a flush may need one last cross-rank
     # rendezvous, so peers must still be alive and symmetric here
     state.progress.run_finalize_hooks()
+    # pml/monitoring traffic-matrix dump BEFORE the fence: every
+    # rank's .prof file must exist by the time the fence releases
+    # rank 0 to aggregate them (profile2mat semantics)
+    _pml_monitoring.finalize_dump(state)
     # barrier, then teardown in reverse (ref: ompi_mpi_finalize.c:101)
     state.rte.fence()
+    _pml_monitoring.finalize_aggregate(state)
     for m in state.btls:
         m.finalize()
     state.rte.finalize()
+    # trace dump LAST: teardown spans (flush rendezvous, btl close)
+    # are part of the timeline
+    from ompi_tpu import trace as _trace
+    _trace.dump_state(state)
     state.finalized = True
     clear_current(state)

@@ -92,6 +92,10 @@ class Progress:
         # mpi_finalize runs them BEFORE the finalize fence so a flush
         # that needs a cross-rank rendezvous still has live peers.
         self._finalize_hooks: List[Callable[[], None]] = []
+        # span tracer (ompi_tpu/trace): set by mpi_init when
+        # trace_enable; every sweep then feeds the progress-tick
+        # latency histogram.  None = one is-None check per sweep.
+        self.tracer = None
 
     def deferred_interrupts(self):
         """Context manager: hold any armed ft interrupt until exit.
@@ -259,6 +263,15 @@ class Progress:
                 exc = self.interrupt
                 self.interrupt = None
                 raise exc
+        tr = self.tracer
+        if tr is not None:
+            # SAMPLED tick timing (1 in 16): a blocked rank spins this
+            # loop thousands of times a second, and two clock reads
+            # per sweep measurably slow every other rank on a shared
+            # core.  The histogram stays representative; the sweeps it
+            # skips are statistically identical to the ones it keeps.
+            _t0 = time.perf_counter() if (self._counter & 15) == 0 \
+                else 0.0
         self._counter += 1
         events = 0
         for cb in list(self._callbacks):
@@ -266,6 +279,8 @@ class Progress:
         if self._lp_callbacks and self._counter % max(1, _lp_ratio_var.value) == 0:
             for cb in list(self._lp_callbacks):
                 events += cb()
+        if tr is not None and _t0:
+            tr.tick(time.perf_counter() - _t0)
         return events
 
     def idle_tick(self, timeout: float = 0.002) -> None:

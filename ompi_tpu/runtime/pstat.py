@@ -43,11 +43,22 @@ def snapshot(pid: Optional[int] = None) -> Dict[str, float]:
     return out
 
 
+# ranks whose pvars are already registered: mpi_init runs once per
+# WORLD but the registry is process-global, so looped tests creating
+# world after world would otherwise re-register rss_mb_r{rank} and
+# either collide or silently orphan the fresh getters
+_registered: set = set()
+
+
 def register_pvars(rank: int) -> None:
     """Publish live-sampled pvars (rss/threads) for this rank — the
-    MPI_T face of the pstat framework (read-time getters)."""
+    MPI_T face of the pstat framework (read-time getters).
+    Idempotent per rank across repeated world creation."""
     from ompi_tpu.mca.params import registry
 
+    if rank in _registered:
+        return
+    _registered.add(rank)
     registry.register_pvar(
         "opal", "pstat", f"rss_mb_r{rank}", var_class="level",
         help="Resident set size (MiB), sampled at read",

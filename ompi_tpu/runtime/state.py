@@ -28,6 +28,9 @@ class ProcState:
         self.comm_world: Any = None
         self.comm_self: Any = None
         self.device: Any = None  # jax device owned by this rank (may be None)
+        # span tracer (ompi_tpu/trace); None unless trace_enable —
+        # hot paths pay exactly one is-None check when tracing is off
+        self.tracer: Any = None
         self.finalized = False
         self.initialized = False
         self.extra: Dict[str, Any] = {}

@@ -34,6 +34,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from ompi_tpu import trace
 from ompi_tpu.runtime import oob
 
 
@@ -293,8 +294,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         retry budget; only an exhausted budget falls back to the
         orphan-kill behavior."""
         import random
+        tr = trace.global_tracer()
+        t0 = tr.start() if tr is not None else None
         delay = max(0.01, oob.retry_delay_var.value)
-        for _ in range(max(1, oob.retry_max_var.value)):
+        for attempt in range(max(1, oob.retry_max_var.value)):
             if done.is_set() or killed.is_set():
                 return
             time.sleep(delay * (0.5 + random.random()))
@@ -305,7 +308,13 @@ def main(argv: Optional[List[str]] = None) -> int:
             except (ConnectionError, OSError):
                 continue
             chan_box[0] = ch
+            if tr is not None:
+                tr.end(t0, "oob_reconnect", "oob", node=opts.node,
+                       attempts=attempt + 1, ok=1)
             return
+        if tr is not None:
+            tr.end(t0, "oob_reconnect", "oob", node=opts.node,
+                   attempts=oob.retry_max_var.value, ok=0)
         sys.stderr.write(f"tpud[{opts.name}]: HNP unreachable after "
                          f"{oob.retry_max_var.value} reconnect "
                          f"attempts; killing local procs\n")
@@ -378,6 +387,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             # daemon by SILENCE (budget * interval) instead of
             # waiting for kernel TCP death, which can take minutes
             report({"op": "beat", "node": opts.node})
+            _tr = trace.global_tracer()
+            if _tr is not None:
+                _tr.instant("oob_beat", "oob", node=opts.node)
             next_beat = time.monotonic() + hb_iv
         with units_lock:
             snapshot = list(units)
@@ -413,6 +425,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     ch = chan_box[0]
     if ch is not None:
         ch.close()
+    try:
+        trace.dump_global(f"tpud-{opts.name}")
+    except Exception:  # noqa: BLE001 — diagnostics never fail exit
+        pass
     return 0
 
 

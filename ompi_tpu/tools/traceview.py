@@ -1,0 +1,224 @@
+"""traceview: merge per-rank trace dumps into one corrected timeline.
+
+Consumes the per-rank JSON files written at MPI_Finalize when
+``--mca trace_enable 1 --mca trace_dump_path PREFIX`` is set, applies
+the clock offsets measured by ``tools/mpisync.py`` (its JSON summary
+line: ``{"offsets_us": [...], ...}`` where offset = remote_clock -
+rank0_clock at minimum RTT, so rank0_time = t_remote - offset), and
+emits:
+
+  * Chrome trace-event JSON (perfetto / chrome://tracing loadable):
+    one process per rank, one thread per span category, "X" complete
+    events with microsecond ts/dur, "i" instants for annotations
+    (fault injections, OOB heartbeats).
+  * A text summary on stdout: slowest spans per category and the
+    straggler ranks of correlated collectives (who arrives last at
+    the rendezvous, by how much).
+
+Usage:
+
+    python -m ompi_tpu.tools.traceview trace-r*.json \
+        [--sync mpisync.json] [-o merged.json] [--top 5]
+
+Without --sync the raw (uncorrected) clocks are used — fine for
+thread-rank worlds sharing one system clock, wrong across hosts.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import sys
+from typing import Dict, List, Optional
+
+
+def load_dumps(paths: List[str]) -> List[dict]:
+    """Load per-rank dump files (globs expanded for callers that
+    quote them), sorted by rank."""
+    files: List[str] = []
+    for p in paths:
+        hits = sorted(glob.glob(p))
+        files.extend(hits if hits else [p])
+    dumps = []
+    for f in files:
+        with open(f) as fh:
+            d = json.load(fh)
+        if "events" not in d or "rank" not in d:
+            raise ValueError(f"{f}: not a trace dump (missing "
+                             f"rank/events)")
+        dumps.append(d)
+    dumps.sort(key=lambda d: d["rank"])
+    return dumps
+
+
+def load_offsets(path: Optional[str]) -> List[float]:
+    """Per-rank offsets (us) from an mpisync JSON summary — either
+    the bare JSON object or a captured stdout whose LAST json line is
+    the summary (how test_mpisync_reports_offsets consumes it)."""
+    if not path:
+        return []
+    with open(path) as fh:
+        text = fh.read()
+    try:
+        data = json.loads(text)
+    except ValueError:
+        data = None
+        for line in reversed(text.strip().splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                data = json.loads(line)
+                break
+        if data is None:
+            raise ValueError(f"{path}: no JSON object found")
+    if "offsets_us" not in data:
+        raise ValueError(f"{path}: missing offsets_us (not an mpisync "
+                         f"summary?)")
+    return [float(o) for o in data["offsets_us"]]
+
+
+def corrected_events(dumps: List[dict],
+                     offsets_us: List[float]) -> List[dict]:
+    """Flatten dumps into events with clock-corrected microsecond
+    timestamps relative to the earliest event (rank0 timebase):
+    t_rank0 = t_remote - offset.  Ranks beyond the offset table (and
+    daemon dumps, rank -1) pass through uncorrected."""
+    out = []
+    for d in dumps:
+        rank = d["rank"]
+        off_s = (offsets_us[rank] * 1e-6
+                 if 0 <= rank < len(offsets_us) else 0.0)
+        for ev in d["events"]:
+            e = dict(ev)
+            e["rank"] = rank
+            e["ts"] = ev["ts"] - off_s
+            out.append(e)
+    if not out:
+        return out
+    base = min(e["ts"] for e in out)
+    for e in out:
+        e["ts"] = (e["ts"] - base) * 1e6           # us since first event
+        if "dur" in e:
+            e["dur"] = e["dur"] * 1e6
+    out.sort(key=lambda e: e["ts"])
+    return out
+
+
+def chrome_trace(dumps: List[dict], offsets_us: List[float]) -> dict:
+    """The Chrome trace-event document: pid = rank, tid = category."""
+    events = corrected_events(dumps, offsets_us)
+    cats = sorted({e["cat"] for e in events})
+    tid_of = {c: i + 1 for i, c in enumerate(cats)}
+    tev = []
+    for d in dumps:
+        tev.append({"ph": "M", "name": "process_name", "pid": d["rank"],
+                    "tid": 0, "args": {"name": f"rank {d['rank']}"
+                                       if d["rank"] >= 0 else "daemon"}})
+        for c in cats:
+            tev.append({"ph": "M", "name": "thread_name",
+                        "pid": d["rank"], "tid": tid_of[c],
+                        "args": {"name": c}})
+    for e in events:
+        ce = {"name": e["name"], "cat": e["cat"], "ph": e["ph"],
+              "ts": round(e["ts"], 3), "pid": e["rank"],
+              "tid": tid_of[e["cat"]], "args": e.get("args", {})}
+        if e["ph"] == "X":
+            ce["dur"] = round(e.get("dur", 0.0), 3)
+        else:
+            ce["s"] = "p"  # process-scoped instant
+        tev.append(ce)
+    meta = {d["rank"]: {"recorded": d.get("recorded"),
+                        "dropped": d.get("dropped")} for d in dumps}
+    return {"traceEvents": tev, "displayTimeUnit": "ms",
+            "otherData": {"ranks": meta}}
+
+
+def straggler_report(events: List[dict], top: int = 5) -> List[str]:
+    """Correlated collective spans (cat coll/coll_dispatch, keyed by
+    cid+seq): per instance the straggler is the member whose span
+    STARTS last — everyone else was parked at the rendezvous waiting
+    for it.  Aggregated into mean lateness per rank."""
+    groups: Dict[tuple, List[dict]] = {}
+    for e in events:
+        if e["ph"] != "X" or e["cat"] not in ("coll", "coll_dispatch"):
+            continue
+        args = e.get("args", {})
+        if "cid" not in args or "seq" not in args:
+            continue
+        groups.setdefault(
+            (e["cat"], args["cid"], args["seq"]), []).append(e)
+    late: Dict[int, List[float]] = {}
+    for members in groups.values():
+        if len(members) < 2:
+            continue
+        first = min(m["ts"] for m in members)
+        for m in members:
+            late.setdefault(m["rank"], []).append(m["ts"] - first)
+    if not late:
+        return ["  (no correlated multi-rank collective spans)"]
+    rows = sorted(((sum(v) / len(v), max(v), r)
+                   for r, v in late.items()), reverse=True)
+    out = []
+    for mean_us, max_us, r in rows[:top]:
+        out.append(f"  rank {r}: mean lateness {mean_us:9.1f} us  "
+                   f"max {max_us:9.1f} us  "
+                   f"({len(late[r])} collectives)")
+    return out
+
+
+def summary(dumps: List[dict], offsets_us: List[float],
+            top: int = 5) -> str:
+    events = corrected_events(dumps, offsets_us)
+    lines = []
+    total = sum(d.get("recorded", 0) for d in dumps)
+    dropped = sum(d.get("dropped", 0) for d in dumps)
+    lines.append(f"{len(dumps)} rank dump(s), {len(events)} events "
+                 f"merged ({total} recorded, {dropped} dropped)")
+    spans = [e for e in events if e["ph"] == "X"]
+    for cat in sorted({e["cat"] for e in spans}):
+        lines.append(f"slowest {cat}:")
+        worst = sorted((e for e in spans if e["cat"] == cat),
+                       key=lambda e: -e.get("dur", 0.0))[:top]
+        for e in worst:
+            args = e.get("args", {})
+            key = " ".join(f"{k}={v}" for k, v in sorted(args.items())
+                           if k in ("cid", "seq", "mid", "nbytes"))
+            lines.append(f"  r{e['rank']:<3} {e['name']:<20} "
+                         f"{e.get('dur', 0.0):10.1f} us  {key}")
+    lines.append("straggler ranks (latest to arrive at correlated "
+                 "collectives):")
+    lines.extend(straggler_report(events, top))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="traceview",
+        description="Merge per-rank trace dumps into clock-corrected "
+                    "Chrome trace-event JSON + a straggler summary")
+    ap.add_argument("dumps", nargs="+",
+                    help="per-rank trace dump files (globs ok)")
+    ap.add_argument("--sync", default=None,
+                    help="mpisync JSON (offsets_us) for clock "
+                         "correction")
+    ap.add_argument("-o", "--out", default=None,
+                    help="write Chrome trace-event JSON here")
+    ap.add_argument("--top", type=int, default=5,
+                    help="rows per summary section")
+    opts = ap.parse_args(argv)
+
+    dumps = load_dumps(opts.dumps)
+    offsets = load_offsets(opts.sync)
+    if opts.out:
+        doc = chrome_trace(dumps, offsets)
+        with open(opts.out, "w") as fh:
+            json.dump(doc, fh)
+        sys.stderr.write(
+            f"wrote {len(doc['traceEvents'])} trace events to "
+            f"{opts.out}\n")
+    sys.stdout.write(summary(dumps, offsets, top=opts.top) + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

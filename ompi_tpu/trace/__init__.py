@@ -1,0 +1,376 @@
+"""Cross-rank span tracing: the observability spine.
+
+One per-rank, lock-light ring-buffer tracer records *spans* (complete
+operations with a wall-clock start and a perf-counter duration) and
+*instants* (point annotations: fault injections, heartbeats) from the
+layers that matter — pml send/recv activate→complete, collective
+entry→rendezvous→dispatch (including the fused device path's
+pack/compile/execute phases), progress-loop tick latency, OOB
+heartbeat/reconnect.  ``tools/traceview.py`` merges per-rank dumps,
+applies mpisync clock offsets, and emits Chrome trace-event JSON.
+
+The cost contract mirrors ``peruse``: when ``trace_enable`` is off
+(the default) every instrumented hot path pays exactly one
+attribute-is-None check — no payload is ever built, no timestamp is
+ever taken (guarded by ``tests/test_trace.py`` the same way
+``test_peruse_disabled_costs_nothing`` guards the peruse flag).  When
+on, recording a span is a dict build plus a ring-slot store; when the
+ring is full the oldest event is overwritten and ``dropped`` counts
+the loss — tracing never blocks and never grows without bound.
+
+Correlation keys stitch ranks together in the merger:
+
+  * p2p spans carry ``mid`` = ``cid:src:tag:seq`` — identical on the
+    sender's and the matching receiver's span (the ob1 match id).
+  * collective spans carry ``cid`` + a per-comm ``seq`` drawn from one
+    shared counter (``coll_seq``), so rank 0's allreduce #7 lines up
+    with rank 3's allreduce #7.
+
+On top of the same ring, fixed log2-bucket latency histograms
+(progress tick, collective dispatch, p2p completion) are maintained
+per rank and exposed as MPI_T pvars — ``bench.py --trace-overhead``
+snapshots them into BENCH_DETAIL.json.
+
+The collective/nbc hooks here (``coll_begin``/``coll_end``,
+``nbc_begin``/``nbc_end``) also fire the extended PERUSE events, so
+the two observability systems share one set of instrumentation
+points rather than drifting apart.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from ompi_tpu import peruse
+from ompi_tpu.mca.params import registry
+
+enable_var = registry.register(
+    "trace", "", "enable", False, bool,
+    help="Record per-rank span traces (ring buffer) and latency "
+         "histograms; off = a single attribute check on hot paths")
+buffer_var = registry.register(
+    "trace", "", "buffer_events", 8192, int,
+    help="Ring-buffer capacity in events per rank; when full the "
+         "oldest event is overwritten and the dropped counter grows")
+dump_var = registry.register(
+    "trace", "", "dump_path", "", str,
+    help="Per-rank trace dump destination at MPI_Finalize: a "
+         "directory, a prefix, or a template containing %r (replaced "
+         "by the rank).  Empty = no dump")
+
+# Fixed log2 latency buckets in microseconds: bucket i holds durations
+# in [2^(i-1), 2^i) us (bucket 0 = sub-microsecond), plus one overflow
+# bucket.  Fixed bounds keep cross-rank and cross-run histograms
+# directly comparable — no adaptive resizing to explain away.
+N_BUCKETS = 21  # 0..2^19 us (~0.5 s) + overflow
+BUCKET_BOUNDS_US = tuple(1 << i for i in range(N_BUCKETS - 1))
+
+HIST_PROGRESS_TICK = 0
+HIST_COLL_DISPATCH = 1
+HIST_P2P_COMPLETE = 2
+HIST_NAMES = ("progress_tick", "coll_dispatch", "p2p_complete")
+
+# span category -> histogram fed automatically by Tracer.end()
+_CAT_HIST = {"coll_dispatch": HIST_COLL_DISPATCH, "p2p": HIST_P2P_COMPLETE}
+
+
+class Tracer:
+    """One rank's ring buffer + histograms.
+
+    The ring is a ``deque(maxlen=capacity)`` of plain tuples: append
+    is one C-level call that atomically discards the oldest entry when
+    full, so the recording hot path takes NO lock — on the 1-core
+    bench box every GIL-held nanosecond here is multiplied by the rank
+    count, and the --trace-overhead budget is single-digit us.  Drop
+    accounting falls out for free: ``dropped = recorded - len(ring)``.
+    Events are materialized into span dicts only at snapshot/dump
+    time, off the hot path.
+
+    A rank's tracer is written almost exclusively by its own thread;
+    the GIL makes the deque append safe for the rare cross-thread
+    completion path and the process-global daemon tracer (worst case
+    under a true race is an off-by-a-few ``recorded``, never a torn
+    event)."""
+
+    __slots__ = ("rank", "capacity", "events", "recorded", "hists")
+
+    def __init__(self, rank: int, capacity: int = 8192) -> None:
+        self.rank = rank
+        self.capacity = max(1, int(capacity))
+        # tuples: (name, cat, ph, ts, dur_or_None, args)
+        self.events: deque = deque(maxlen=self.capacity)
+        self.recorded = 0      # total record calls (kept + dropped)
+        self.hists = [[0] * N_BUCKETS for _ in HIST_NAMES]
+
+    @property
+    def dropped(self) -> int:
+        """Events lost to ring wraparound."""
+        return self.recorded - len(self.events)
+
+    # -- recording -------------------------------------------------------
+    # The default-arg bindings (_time/_pc) skip the module+attribute
+    # lookups per call on the hot path.
+    def start(self, _time=time.time, _pc=time.perf_counter):
+        """Span-start token: (wall clock for the merger, perf counter
+        for the duration).  time.time() is what mpisync offsets
+        correct; perf_counter() is monotonic for honest durations."""
+        return (_time(), _pc())
+
+    def end(self, t0, name: str, cat: str, _pc=time.perf_counter,
+            **args) -> float:
+        """Close a span opened with start(); returns the duration (s).
+        Categories in _CAT_HIST also feed their latency histogram.
+        This is THE recording hot path: one tuple, one deque append,
+        one counter, one histogram bump."""
+        dur = _pc() - t0[1]
+        self.events.append((name, cat, "X", t0[0], dur, args))
+        self.recorded += 1
+        h = _CAT_HIST.get(cat)
+        if h is not None:
+            us = int(dur * 1e6)
+            b = us.bit_length() if us > 0 else 0
+            self.hists[h][b if b < N_BUCKETS else N_BUCKETS - 1] += 1
+        return dur
+
+    def instant(self, name: str, cat: str, **args) -> None:
+        self.events.append((name, cat, "i", time.time(), None, args))
+        self.recorded += 1
+
+    def tick(self, dur_s: float) -> None:
+        """Progress-sweep latency: histogram only, never a ring event
+        (a sweep runs thousands of times per second and would flood
+        the ring into pure tick noise)."""
+        self.hist_add(HIST_PROGRESS_TICK, dur_s)
+
+    def hist_add(self, which: int, dur_s: float) -> None:
+        us = int(dur_s * 1e6)
+        # log2 bucket: us in [2^(i-1), 2^i) -> bucket i; 0 us -> 0
+        b = us.bit_length() if us > 0 else 0
+        if b >= N_BUCKETS:
+            b = N_BUCKETS - 1
+        self.hists[which][b] += 1
+
+    # -- reading ---------------------------------------------------------
+    def snapshot(self) -> List[dict]:
+        """Events oldest-first, materialized as span dicts (the dump
+        schema — tuple unpacking happens here, off the hot path)."""
+        out = []
+        for name, cat, ph, ts, dur, args in list(self.events):
+            e = {"name": name, "cat": cat, "ph": ph, "ts": ts,
+                 "args": args}
+            if dur is not None:
+                e["dur"] = dur
+            out.append(e)
+        return out
+
+    def span_count(self, cat: str) -> int:
+        return sum(1 for e in list(self.events)
+                   if e[1] == cat and e[2] == "X")
+
+    def hist_total(self, which: int) -> int:
+        return sum(self.hists[which])
+
+    def dump(self, path: str) -> None:
+        """One self-describing per-rank JSON file — the traceview
+        input.  Timestamps are epoch seconds (floats); traceview
+        converts to microseconds after clock correction."""
+        doc = {
+            "rank": self.rank,
+            "recorded": self.recorded,
+            "dropped": self.dropped,
+            "capacity": self.capacity,
+            "buckets_us": list(BUCKET_BOUNDS_US),
+            "hists": {n: list(h) for n, h in zip(HIST_NAMES, self.hists)},
+            "events": self.snapshot(),
+        }
+        with open(path, "w") as fh:
+            json.dump(doc, fh)
+
+
+# -- per-rank attach / dump -------------------------------------------------
+
+def attach(state) -> Optional[Tracer]:
+    """Called by mpi_init before pml selection: when trace_enable is
+    set, hang a Tracer off the ProcState (and the progress engine so
+    the tick histogram needs no state lookup).  When off, the
+    attributes stay None — the whole hot-path contract."""
+    if not enable_var.value:
+        state.tracer = None
+        return None
+    tr = Tracer(state.rank, buffer_var.value)
+    state.tracer = tr
+    state.progress.tracer = tr
+    return tr
+
+
+def _resolve_dump_path(base: str, tag: str) -> str:
+    if "%r" in base:
+        return base.replace("%r", tag)
+    if os.path.isdir(base):
+        return os.path.join(base, f"trace-r{tag}.json")
+    return f"{base}-r{tag}.json"
+
+
+def dump_state(state) -> Optional[str]:
+    """Finalize-time per-rank dump (diagnostics never take a rank
+    down: any OS error is swallowed after best effort)."""
+    tr = getattr(state, "tracer", None)
+    base = dump_var.value
+    if tr is None or not base:
+        return None
+    path = _resolve_dump_path(base, str(state.rank))
+    try:
+        tr.dump(path)
+    except OSError:
+        return None
+    return path
+
+
+# -- process-global tracer (daemons: no ProcState) --------------------------
+
+_global: Optional[Tracer] = None
+_global_lock = threading.Lock()
+
+
+def global_tracer() -> Optional[Tracer]:
+    """The tracer for control-plane processes (tpud daemons, the HNP)
+    that have no per-rank state.  None when tracing is off."""
+    global _global
+    if not enable_var.value:
+        return None
+    if _global is None:
+        with _global_lock:
+            if _global is None:
+                _global = Tracer(-1, buffer_var.value)
+    return _global
+
+
+def dump_global(tag: str) -> Optional[str]:
+    if _global is None or not dump_var.value:
+        return None
+    path = _resolve_dump_path(dump_var.value, tag)
+    try:
+        _global.dump(path)
+    except OSError:
+        return None
+    return path
+
+
+def current_tracer() -> Optional[Tracer]:
+    """The calling thread-rank's tracer (pvar getters and module-
+    global code resolve through here, the pml/monitoring pattern),
+    falling back to the process-global daemon tracer."""
+    from ompi_tpu.runtime import state as statemod
+    st = statemod.maybe_current()
+    tr = getattr(st, "tracer", None) if st is not None else None
+    return tr if tr is not None else _global
+
+
+# -- MPI_T pvars ------------------------------------------------------------
+
+def _tr_attr(attr: str):
+    def getter():
+        tr = current_tracer()
+        return getattr(tr, attr) if tr is not None else 0
+    return getter
+
+
+def _tr_hist(which: int):
+    def getter():
+        tr = current_tracer()
+        return list(tr.hists[which]) if tr is not None else []
+    return getter
+
+
+registry.register_pvar(
+    "trace", "", "events_recorded",
+    help="Trace events recorded by this rank (kept + dropped)",
+    getter=_tr_attr("recorded"))
+registry.register_pvar(
+    "trace", "", "events_dropped",
+    help="Trace events lost to ring-buffer wraparound "
+         "(raise trace_buffer_events)",
+    getter=_tr_attr("dropped"))
+registry.register_pvar(
+    "trace", "", "hist_bucket_bounds_us", var_class="size",
+    help="Upper bounds (us) of the fixed log2 latency buckets shared "
+         "by every trace histogram pvar",
+    getter=lambda: list(BUCKET_BOUNDS_US))
+registry.register_pvar(
+    "trace", "", "hist_progress_tick", var_class="size",
+    help="Progress-sweep latency histogram (log2 us buckets)",
+    getter=_tr_hist(HIST_PROGRESS_TICK))
+registry.register_pvar(
+    "trace", "", "hist_coll_dispatch", var_class="size",
+    help="Device-collective rendezvous+dispatch latency histogram",
+    getter=_tr_hist(HIST_COLL_DISPATCH))
+registry.register_pvar(
+    "trace", "", "hist_p2p_complete", var_class="size",
+    help="Point-to-point activate-to-complete latency histogram",
+    getter=_tr_hist(HIST_P2P_COMPLETE))
+
+
+# -- shared collective/nbc instrumentation points ---------------------------
+# These helpers are the ONE place blocking-collective and nbc
+# lifecycles are observed: they record trace spans AND fire the
+# extended PERUSE events, so subscribing to peruse and reading traces
+# can never disagree about where the hooks sit.
+
+def coll_seq(comm) -> int:
+    """Next per-comm collective sequence number — the cross-rank
+    correlation key (MPI collective-ordering semantics make every
+    member's counter agree)."""
+    s = comm.__dict__.get("_coll_seq", 0) + 1
+    comm.__dict__["_coll_seq"] = s
+    return s
+
+
+def coll_begin(comm, coll: str, _time=time.time,
+               _pc=time.perf_counter):
+    """Blocking-collective entry.  Returns an opaque token for
+    coll_end, or None when both observability systems are off (the
+    merged-vtable shim passes straight through on None)."""
+    tr = comm.state.tracer
+    if tr is None and not peruse.enabled:
+        return None
+    seq = coll_seq(comm)
+    if peruse.enabled:
+        peruse.fire("coll_begin", cid=comm.cid, coll=coll, seq=seq)
+    return (seq, _time(), _pc(), tr)
+
+
+def coll_end(comm, coll: str, token) -> None:
+    if token is None:
+        return
+    seq, ts, tp, tr = token
+    if tr is not None:
+        tr.end((ts, tp), coll, "coll", cid=comm.cid, seq=seq)
+    if peruse.enabled:
+        peruse.fire("coll_end", cid=comm.cid, coll=coll, seq=seq)
+
+
+def nbc_begin(comm, coll: str):
+    """Nonblocking-collective activation (NBCRequest construction).
+    Returns the token the request stashes until completion."""
+    tr = comm.state.tracer
+    if tr is None and not peruse.enabled:
+        return None
+    seq = coll_seq(comm)
+    if peruse.enabled:
+        peruse.fire("nbc_activate", cid=comm.cid, coll=coll, seq=seq)
+    return (seq, time.time(), time.perf_counter(), tr, comm.cid, coll)
+
+
+def nbc_end(token) -> None:
+    if token is None:
+        return
+    seq, ts, tp, tr, cid, coll = token
+    if tr is not None:
+        tr.end((ts, tp), coll, "nbc", cid=cid, seq=seq)
+    if peruse.enabled:
+        peruse.fire("nbc_complete", cid=cid, coll=coll, seq=seq)

@@ -1,0 +1,336 @@
+"""Cross-rank span tracing (ompi_tpu/trace + tools/traceview):
+disabled-cost contract, ring wraparound accounting, clock-corrected
+multi-rank merge, histogram pvars, the extended PERUSE coll/nbc
+events, the pml/monitoring finalize dump, and pstat pvar idempotency
+across repeated worlds."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from ompi_tpu import peruse, trace
+from ompi_tpu.mca.params import registry
+from ompi_tpu.op import op as mpi_op
+from ompi_tpu.testing import run_ranks
+from ompi_tpu.tools import traceview
+
+
+@pytest.fixture(autouse=True)
+def _clean_trace():
+    yield
+    registry.set("trace_enable", "0")
+    registry.set("trace_dump_path", "")
+    registry.set("trace_buffer_events", "8192")
+    registry.set("pml_monitoring_enable", "0")
+    registry.set("pml_monitoring_dump_path", "")
+    peruse.unsubscribe_all()
+
+
+def _traffic(comm):
+    """A little of everything: p2p, blocking colls, an nbc."""
+    sbuf = np.ones(4, np.float32) * (comm.rank + 1)
+    rbuf = np.zeros(4, np.float32)
+    nxt = (comm.rank + 1) % comm.size
+    prv = (comm.rank - 1) % comm.size
+    rq = comm.Irecv(rbuf, prv, tag=3)
+    comm.Send(sbuf, nxt, tag=3)
+    rq.wait()
+    comm.Allreduce(sbuf, rbuf, mpi_op.SUM)
+    comm.Barrier()
+    r = comm.Ibarrier()
+    r.wait()
+
+
+# -- the cost contract ------------------------------------------------------
+
+def test_trace_disabled_costs_nothing():
+    """trace_enable off (default): every layer's cached tracer slot is
+    None — the single-attribute-check contract, asserted structurally
+    the way test_peruse_disabled_costs_nothing asserts the flag."""
+    assert not trace.enable_var.value
+
+    def fn(comm):
+        assert comm.state.tracer is None
+        assert comm.state.progress.tracer is None
+        # ob1 caches the tracer at selection time (unwrap monitoring/
+        # vprotocol interpositions if any)
+        pml = comm.state.pml
+        while not hasattr(pml, "_tracer"):
+            pml = pml._pml
+        assert pml._tracer is None
+        assert trace.current_tracer() is None
+        _traffic(comm)
+        return comm.state.tracer is None
+
+    assert all(run_ranks(2, fn))
+    assert trace.global_tracer() is None
+
+
+def test_ring_wraparound_counts_drops():
+    tr = trace.Tracer(0, capacity=8)
+    for i in range(20):
+        tr.instant(f"ev{i}", "test", i=i)
+    kept = tr.snapshot()
+    assert len(kept) == 8
+    # oldest-first unroll of the newest 8
+    assert [e["args"]["i"] for e in kept] == list(range(12, 20))
+    assert tr.recorded == 20
+    assert tr.dropped == 12
+
+
+def test_span_records_duration_and_histogram():
+    tr = trace.Tracer(0, capacity=64)
+    t0 = tr.start()
+    tr.end(t0, "op", "p2p", mid="0:0:1:1", bytes=16)
+    (ev,) = tr.snapshot()
+    assert ev["ph"] == "X" and ev["cat"] == "p2p"
+    assert ev["dur"] >= 0
+    assert ev["args"]["mid"] == "0:0:1:1"
+    assert tr.hist_total(trace.HIST_P2P_COMPLETE) == 1
+    # bucketing: 3 us -> bucket 2 ([2,4) us), 0 us -> bucket 0
+    tr.hist_add(trace.HIST_COLL_DISPATCH, 3e-6)
+    assert tr.hists[trace.HIST_COLL_DISPATCH][2] == 1
+    tr.hist_add(trace.HIST_COLL_DISPATCH, 0.0)
+    assert tr.hists[trace.HIST_COLL_DISPATCH][0] == 1
+    # far overflow lands in the last bucket, never raises
+    tr.hist_add(trace.HIST_COLL_DISPATCH, 3600.0)
+    assert tr.hists[trace.HIST_COLL_DISPATCH][trace.N_BUCKETS - 1] == 1
+
+
+# -- the traced world -------------------------------------------------------
+
+def test_traced_world_spans_and_correlation(tmp_path):
+    registry.set("trace_enable", "1")
+    registry.set("trace_dump_path", str(tmp_path))
+
+    def fn(comm):
+        _traffic(comm)
+        tr = comm.state.tracer
+        return {"rank": comm.rank,
+                "p2p": tr.span_count("p2p"),
+                "coll": tr.span_count("coll"),
+                "nbc": tr.span_count("nbc"),
+                "events": tr.snapshot()}
+
+    res = run_ranks(4, fn)
+    for r in res:
+        assert r["p2p"] >= 2      # the ring send + recv at least
+        assert r["coll"] >= 2     # allreduce + barrier entry spans
+        assert r["nbc"] == 1      # the ibarrier schedule
+    # p2p correlation: every receiver's mid appears as some sender's
+    # mid (the ob1 match id is constructed identically on both sides)
+    mids = [set(e["args"]["mid"] for e in r["events"]
+                if e["cat"] == "p2p" and e["name"] == name)
+            for name in ("send", "recv") for r in res]
+    sends, recvs = set().union(*mids[:4]), set().union(*mids[4:])
+    assert recvs <= sends
+    # collective correlation: every rank logged allreduce under the
+    # same (cid, seq)
+    ar = [next(e for e in r["events"] if e["name"] == "allreduce")
+          for r in res]
+    assert len({(e["args"]["cid"], e["args"]["seq"]) for e in ar}) == 1
+    # finalize dumped one file per rank
+    assert sorted(os.listdir(tmp_path)) == [
+        f"trace-r{r}.json" for r in range(4)]
+
+
+def test_histogram_pvars_match_span_counts():
+    registry.set("trace_enable", "1")
+    registry.set("trace_buffer_events", "65536")
+
+    def fn(comm):
+        _traffic(comm)
+        _traffic(comm)
+        tr = comm.state.tracer
+        assert tr.dropped == 0
+        # the histograms that mirror ring categories agree with the
+        # span counts — same instrumentation points feed both
+        assert tr.hist_total(trace.HIST_P2P_COMPLETE) == \
+            tr.span_count("p2p")
+        assert tr.hist_total(trace.HIST_COLL_DISPATCH) == \
+            tr.span_count("coll_dispatch")
+        # ...and the MPI_T pvar surface reads THIS rank's histograms
+        from ompi_tpu import mpit
+        mpit.init_thread()
+        try:
+            sess = mpit.pvar_session_create()
+            ph = mpit.pvar_handle_alloc(sess, "trace_hist_p2p_complete")
+            assert sum(mpit.pvar_read(ph)) == tr.span_count("p2p")
+            ph = mpit.pvar_handle_alloc(sess, "trace_events_recorded")
+            assert mpit.pvar_read(ph) == tr.recorded
+        finally:
+            mpit.finalize()
+        # progress ticks were observed (the loop ran at least once)
+        assert tr.hist_total(trace.HIST_PROGRESS_TICK) > 0
+        return True
+
+    assert all(run_ranks(2, fn))
+
+
+# -- the cross-rank merge ---------------------------------------------------
+
+def test_traceview_merges_clock_corrected(tmp_path):
+    registry.set("trace_enable", "1")
+    registry.set("trace_dump_path", str(tmp_path))
+    run_ranks(4, _traffic)
+    dumps = traceview.load_dumps([str(tmp_path / "*.json")])
+    assert [d["rank"] for d in dumps] == [0, 1, 2, 3]
+
+    # synthetic mpisync offsets (us): rank r's clock = rank0's + off
+    offsets = [0.0, 1000.0, -500.0, 250.0]
+    events = traceview.corrected_events(dumps, offsets)
+    assert events
+    # correction math: a rank's corrected timestamps are its raw
+    # timestamps minus its offset (then a common rebase) — verify on
+    # rank 1 against a manual recompute
+    raw1 = sorted(e["ts"] for d in dumps if d["rank"] == 1
+                  for e in d["events"])
+    base = min(e["ts"] - offsets[d["rank"]] / 1e6
+               for d in dumps for e in d["events"])
+    got1 = sorted(e["ts"] for e in events if e["rank"] == 1)
+    want1 = sorted((t - offsets[1] / 1e6 - base) * 1e6 for t in raw1)
+    assert got1 == pytest.approx(want1, abs=1.0)
+    # per-rank monotonic after correction (each rank's ring is
+    # recorded in time order; correction shifts a rank uniformly)
+    for r in range(4):
+        ts = [e["ts"] for e in events if e["rank"] == r]
+        assert ts == sorted(ts)
+
+    doc = traceview.chrome_trace(dumps, offsets)
+    # valid Chrome trace-event JSON: serializable, required keys
+    json.loads(json.dumps(doc))
+    evs = doc["traceEvents"]
+    assert {e["ph"] for e in evs} <= {"M", "X", "i"}
+    xs = [e for e in evs if e["ph"] == "X"]
+    assert xs and all(
+        {"name", "cat", "ts", "dur", "pid", "tid"} <= set(e) for e in xs)
+    assert {e["pid"] for e in xs} == {0, 1, 2, 3}
+    assert all(e["ts"] >= 0 and e["dur"] >= 0 for e in xs)
+    # the text summary runs end to end
+    text = traceview.summary(dumps, offsets, top=3)
+    assert "slowest" in text and "straggler" in text
+
+
+def test_traceview_cli(tmp_path):
+    registry.set("trace_enable", "1")
+    registry.set("trace_dump_path", str(tmp_path))
+    run_ranks(4, _traffic)
+    sync = tmp_path / "sync.json"
+    sync.write_text(json.dumps(
+        {"offsets_us": [0.0, 40.0, -15.0, 5.0], "rtts_us": []}))
+    out = tmp_path / "merged.json"
+    rc = traceview.main([str(tmp_path / "trace-r*.json"),
+                         "--sync", str(sync), "-o", str(out)])
+    assert rc == 0
+    doc = json.loads(out.read_text())
+    assert len([e for e in doc["traceEvents"] if e["ph"] == "X"]) > 0
+    assert doc["otherData"]["ranks"]["0"]["dropped"] == 0
+
+
+# -- shared PERUSE instrumentation points -----------------------------------
+
+def test_peruse_coll_and_nbc_events():
+    events = []
+    for ev in ("coll_begin", "coll_end", "nbc_activate",
+               "nbc_complete"):
+        peruse.subscribe(ev, lambda e, **kw: events.append((e, kw)))
+
+    def fn(comm):
+        x = np.ones(4, np.float32)
+        r = np.zeros(4, np.float32)
+        comm.Allreduce(x, r, mpi_op.SUM)
+        rq = comm.Ibarrier()
+        rq.wait()
+
+    run_ranks(2, fn)
+    kinds = [e for e, _ in events]
+    assert "coll_begin" in kinds and "coll_end" in kinds
+    assert "nbc_activate" in kinds and "nbc_complete" in kinds
+    # begin/end pair under the same correlation key
+    begins = [(kw["cid"], kw["seq"]) for e, kw in events
+              if e == "coll_begin"]
+    ends = [(kw["cid"], kw["seq"]) for e, kw in events
+            if e == "coll_end"]
+    assert sorted(begins) == sorted(ends)
+    assert all(kw["coll"] for _, kw in events)
+
+
+def test_peruse_events_fire_without_tracer():
+    """The shared hooks serve PERUSE alone: trace off, subscribe on."""
+    assert not trace.enable_var.value
+    seen = []
+    peruse.subscribe("coll_begin", lambda e, **kw: seen.append(kw))
+
+    def fn(comm):
+        comm.Barrier()
+        assert comm.state.tracer is None
+
+    run_ranks(2, fn)
+    assert seen and all("seq" in kw for kw in seen)
+
+
+# -- pml/monitoring finalize dump -------------------------------------------
+
+def test_monitoring_finalize_dump_and_matrices(tmp_path):
+    registry.set("pml_monitoring_enable", "1")
+    prefix = str(tmp_path / "traffic")
+    registry.set("pml_monitoring_dump_path", prefix)
+
+    def fn(comm):
+        buf = np.ones(8, np.float32)
+        r = np.zeros(8, np.float32)
+        if comm.rank == 0:
+            comm.Send(buf, 1, tag=5)
+            comm.Send(buf, 1, tag=6)
+            comm.Recv(r, 1, tag=7)
+        else:
+            comm.Recv(r, 0, tag=5)
+            comm.Recv(r, 0, tag=6)
+            comm.Send(buf, 0, tag=7)
+        comm.Barrier()
+
+    run_ranks(2, fn)
+    # per-rank .prof files (profile2mat.pl input format)
+    for rank in (0, 1):
+        lines = open(f"{prefix}.{rank}.prof").read().splitlines()
+        assert all(len(ln.split()) == 4 for ln in lines)
+    # rank 0 aggregated the matrices after the fence
+    msg = [[float(v) for v in ln.split()]
+           for ln in open(f"{prefix}_msg.mat").read().splitlines()]
+    size = [[float(v) for v in ln.split()]
+            for ln in open(f"{prefix}_size.mat").read().splitlines()]
+    avg = [[float(v) for v in ln.split()]
+           for ln in open(f"{prefix}_avg.mat").read().splitlines()]
+    assert msg[0][1] == 2 and msg[1][0] == 1
+    assert size[0][1] == 64 and size[1][0] == 32
+    assert avg[0][1] == 32 and avg[0][0] == 0
+
+
+def test_monitoring_dump_disabled_writes_nothing(tmp_path):
+    registry.set("pml_monitoring_dump_path", str(tmp_path / "t"))
+    # monitoring NOT enabled: the dump path alone must not interpose
+    run_ranks(2, lambda comm: comm.Barrier())
+    assert os.listdir(tmp_path) == []
+
+
+# -- pstat pvar idempotency -------------------------------------------------
+
+def test_pstat_pvars_idempotent_across_worlds():
+    from ompi_tpu.mca.params import registry as reg
+
+    def fn(comm):
+        comm.Barrier()
+
+    run_ranks(2, fn)
+    names = [p.full_name for p in reg.all_pvars()
+             if p.full_name.startswith("opal_pstat_")]
+    count0 = len(names)
+    assert len(set(names)) == count0  # no duplicates ever
+    for _ in range(3):
+        run_ranks(2, fn)
+    names = [p.full_name for p in reg.all_pvars()
+             if p.full_name.startswith("opal_pstat_")]
+    assert len(names) == count0
+    assert len(set(names)) == count0
