@@ -136,6 +136,14 @@ def main() -> None:
                          "persist under 'trace_overhead' in "
                          "BENCH_DETAIL.json, and FAIL (exit 1) if the "
                          "traced path costs more than 5%%")
+    ap.add_argument("--probe-recovery", action="store_true",
+                    help="Measure the ULFM forward-recovery pipeline "
+                         "(kill -> ERR_PROC_FAILED detect -> shrink -> "
+                         "first survivor collective) and the healthy-"
+                         "path cost of the ULFM entry checks on vs "
+                         "off; persist under 'probe_recovery' in "
+                         "BENCH_DETAIL.json, and FAIL (exit 1) if the "
+                         "on path costs more than 5%%")
     opts = ap.parse_args()
 
     detail_path = os.path.join(
@@ -191,6 +199,36 @@ def main() -> None:
             sys.stderr.write(
                 f"FAIL: tracing overhead {probe['overhead_pct']}% "
                 f"exceeds the {probe['budget_pct']}% budget\n")
+            sys.exit(1)
+        return
+
+    if opts.probe_recovery:
+        from benchmarks.probe_recovery import persist, run_probe
+
+        probe = run_probe()
+        notes = persist(probe, detail_path)
+        line = {
+            "metric": f"ulfm recovery, {probe['nranks']} ranks, kill "
+                      f"rank {probe['victim']} mid-allreduce "
+                      f"(best-of-{probe['reps']})",
+            "value": probe["total_ms"],
+            "unit": "ms_kill_to_first_survivor_coll",
+            "detect_ms": probe["detect_ms"],
+            "shrink_ms": probe["shrink_ms"],
+            "first_coll_ms": probe["first_coll_ms"],
+            "entry_check_overhead_pct": probe["overhead_pct"],
+            "within_budget": probe["within_budget"],
+        }
+        line.update({k: v for k, v in notes.items() if "error" in k})
+        sys.stderr.write(json.dumps(probe, indent=1) + "\n")
+        print(json.dumps(line))
+        if not probe["within_budget"]:
+            # same acceptance contract as --trace-overhead: resilience
+            # must be near-free when nothing fails
+            sys.stderr.write(
+                f"FAIL: ULFM entry-check overhead "
+                f"{probe['overhead_pct']}% exceeds the "
+                f"{probe['budget_pct']}% budget\n")
             sys.exit(1)
         return
 
@@ -307,7 +345,8 @@ def main() -> None:
     try:
         with open(detail_path, "w") as f:
             json.dump({**{k: prior[k]
-                          for k in ("probe_dispatch", "trace_overhead")
+                          for k in ("probe_dispatch", "trace_overhead",
+                                    "probe_recovery")
                           if isinstance(prior, dict) and k in prior},
                        "device_us": dev, "software_us": sw,
                        "software_tuned_tcp_us": sw_tcp,

@@ -1,0 +1,174 @@
+"""--probe-recovery microbench: ULFM forward-recovery latency + cost.
+
+Two questions, answered on a 4-rank thread-rank world (the TPU-host
+execution model, same harness as the other probes):
+
+1. **How fast is recovery?**  Rank 1 dies deterministically
+   (ulfm.kill_now, no timer race) while the survivors are parked in a
+   host Allreduce.  Each survivor times the forward-recovery pipeline
+   from the instant of death: detect (ERR_PROC_FAILED raised out of
+   the parked collective), shrink (survivor comm built, mesh caches
+   dropped), and first post-shrink collective completing with the
+   right answer.  Reported numbers are rank 0's, best-of-REPS — the
+   contamination-free floor, same convention as trace_overhead.
+
+2. **What does the capability cost when nothing fails?**  The ULFM
+   entry checks ride every blocking collective and p2p op; when
+   ``mpi_ft_ulfm`` is on but no failure has been recorded the cost is
+   one attribute load + one ``active`` flag check.  Measured like
+   trace_overhead: interleaved off/on reps of small host Allreduces,
+   best-of per side, LOUD failure in bench.py when the on-side
+   exceeds the budget.
+
+Results land in BENCH_DETAIL.json under ``probe_recovery``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict
+
+NRANKS = 4
+VICTIM = 1
+OPS = 400          # allreduces per overhead rep
+WARMUP = 20
+REPS = 5
+BUDGET_PCT = 5.0   # acceptance bound for the ULFM-on healthy path
+
+
+def _measure_recovery() -> Dict:
+    """One kill → detect → shrink → first-collective timeline."""
+    import numpy as np
+
+    from ompi_tpu.errhandler import MPIException
+    from ompi_tpu.ft import ulfm
+    from ompi_tpu.op.op import SUM
+    from ompi_tpu.testing import run_ranks
+
+    # the victim stamps t0 the instant before it dies; survivors
+    # subtract it from their own perf_counter reads (thread ranks
+    # share one clock, so no correction is needed)
+    t0 = [0.0]
+
+    def fn(comm):
+        sbuf = np.ones(16, dtype=np.float64)
+        rbuf = np.zeros(16, dtype=np.float64)
+        for _ in range(3):
+            comm.Allreduce(sbuf, rbuf, SUM)
+        comm.Barrier()
+        if comm.rank == VICTIM:
+            time.sleep(0.05)  # let survivors park in the Allreduce
+            t0[0] = time.perf_counter()
+            ulfm.kill_now(comm.state)
+        try:
+            while True:
+                comm.Allreduce(sbuf, rbuf, SUM)
+        except MPIException as e:
+            t_detect = time.perf_counter()
+            assert e.code in (75, 76, 77), e.code
+        sub = comm.shrink(name="bench-survivors")
+        t_shrink = time.perf_counter()
+        sub.Allreduce(sbuf, rbuf, SUM)
+        t_first = time.perf_counter()
+        assert rbuf[0] == float(sub.size)
+        return {
+            "detect_ms": (t_detect - t0[0]) * 1e3,
+            "shrink_ms": (t_shrink - t_detect) * 1e3,
+            "first_coll_ms": (t_first - t_shrink) * 1e3,
+            "total_ms": (t_first - t0[0]) * 1e3,
+        }
+
+    out = run_ranks(NRANKS, fn, allow_failures=True, timeout=120)
+    return out[0]  # rank 0's view; victim's slot is None
+
+
+def _measure_overhead(enabled: bool) -> float:
+    """us/op of the healthy small-Allreduce loop with ULFM on|off."""
+    import numpy as np
+
+    from ompi_tpu.mca.params import registry
+    from ompi_tpu.op.op import SUM
+    from ompi_tpu.testing import run_ranks
+
+    registry.set("mpi_ft_ulfm", "1" if enabled else "0")
+
+    def fn(comm):
+        if enabled:
+            assert comm.state.ulfm is not None
+        else:
+            assert comm.state.ulfm is None
+        sbuf = np.ones(8, dtype=np.float32)
+        rbuf = np.zeros(8, dtype=np.float32)
+        for _ in range(WARMUP):
+            comm.Allreduce(sbuf, rbuf, SUM)
+        comm.Barrier()
+        t0 = time.perf_counter()
+        for _ in range(OPS):
+            comm.Allreduce(sbuf, rbuf, SUM)
+        return (time.perf_counter() - t0) / OPS * 1e6
+
+    return run_ranks(NRANKS, fn, timeout=300)[0]
+
+
+def run_probe() -> Dict:
+    from ompi_tpu.mca.params import registry
+
+    prior = registry.get("mpi_ft_ulfm", "1")
+    recs = []
+    off_times, on_times = [], []
+    try:
+        registry.set("mpi_ft_ulfm", "1")
+        for _ in range(REPS):
+            recs.append(_measure_recovery())
+        for _ in range(REPS):
+            off_times.append(_measure_overhead(False))
+            on_times.append(_measure_overhead(True))
+    finally:
+        registry.set("mpi_ft_ulfm", prior)
+    best = min(recs, key=lambda r: r["total_ms"])
+    off_us = min(off_times)
+    on_us = min(on_times)
+    overhead = (on_us - off_us) / off_us * 100.0
+    return {
+        "nranks": NRANKS,
+        "victim": VICTIM,
+        "reps": REPS,
+        "detect_ms": round(best["detect_ms"], 3),
+        "shrink_ms": round(best["shrink_ms"], 3),
+        "first_coll_ms": round(best["first_coll_ms"], 3),
+        "total_ms": round(best["total_ms"], 3),
+        "total_ms_all": [round(r["total_ms"], 3) for r in recs],
+        "ops_per_rep": OPS,
+        "payload_bytes": 32,
+        "off_us_per_op": round(off_us, 2),
+        "on_us_per_op": round(on_us, 2),
+        "off_us_all": [round(x, 2) for x in off_times],
+        "on_us_all": [round(x, 2) for x in on_times],
+        "overhead_pct": round(overhead, 2),
+        "budget_pct": BUDGET_PCT,
+        "within_budget": bool(overhead <= BUDGET_PCT),
+    }
+
+
+def persist(probe: Dict, detail_path: str) -> Dict:
+    """Merge under 'probe_recovery' in BENCH_DETAIL.json, preserving
+    every other section (the probe_dispatch/trace_overhead pattern)."""
+    notes: Dict = {}
+    try:
+        with open(detail_path) as fh:
+            detail = json.load(fh)
+        if not isinstance(detail, dict):
+            detail = {}
+    except (OSError, ValueError):
+        detail = {}
+    detail["probe_recovery"] = probe
+    try:
+        tmp = f"{detail_path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as fh:
+            json.dump(detail, fh, indent=1)
+        os.replace(tmp, detail_path)
+    except OSError as e:
+        notes["detail_error"] = str(e)[:120]
+    return notes

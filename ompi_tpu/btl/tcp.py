@@ -321,6 +321,15 @@ class TcpModule(BTLModule):
             conn.sock.close()
         except OSError:
             pass
+        if self.reliable and conn.peer >= 0 and \
+                getattr(self.state, "ulfm", None) is not None:
+            # the reliable sublayer burned its whole reconnect budget
+            # on this peer: that is transport-level proof of permanent
+            # death — promote it to a job-wide ULFM failure record so
+            # parked ops drain with ERR_PROC_FAILED instead of timing
+            # out one by one
+            from ompi_tpu.ft import ulfm as _ulfm
+            _ulfm.publish_failure(self.state, conn.peer)
 
     def send(self, peer: int, frag) -> None:
         conn = self._connect(peer)

@@ -527,6 +527,19 @@ class CompiledLRU:
         with self._lock:
             self._d.clear()
 
+    def drop_mesh(self, dev_key: Tuple) -> int:
+        """Drop every executable compiled against `dev_key` (a tuple
+        of device ids — the mesh identity every _mesh_collective and
+        fused key embeds as a top-level element).  Comm.shrink calls
+        this: the survivor mesh re-keys on its own device list, so
+        entries for the dead shape would squat in the bounded cache
+        until evicted.  Returns how many entries were dropped."""
+        with self._lock:
+            stale = [k for k in self._d if dev_key in k]
+            for k in stale:
+                del self._d[k]
+            return len(stale)
+
     def get(self, key: Tuple, builder: Callable[[], Callable]) -> Callable:
         with self._lock:
             fn = self._d.get(key)
@@ -736,6 +749,7 @@ class TpuCollModule(CollModule):
         if cached is not None:
             return cached
         world = getattr(comm.state.rte, "world", None)
+        ulfm = comm.state.ulfm  # None when mpi_ft_ulfm is off
 
         def check():
             if world is not None and world.aborted and \
@@ -743,6 +757,12 @@ class TpuCollModule(CollModule):
                 raise RuntimeError(
                     f"peer rank {world.aborted[0]} aborted during "
                     "device collective")
+            if ulfm is not None and ulfm.active:
+                # a peer died while we were parked in the rendezvous:
+                # surface ERR_PROC_FAILED/ERR_REVOKED out of the wait
+                # instead of spinning until the meet timeout
+                ulfm.poll()
+                ulfm.check_comm(comm)
         comm.__dict__["_device_abort_check"] = check
         return check
 

@@ -87,6 +87,23 @@ def _instrumented(fname: str, fn):
     from ompi_tpu import trace
 
     def shim(comm, *args, **kwargs):
+        pr = comm.state.progress
+        if pr.interrupt is not None:
+            # armed interrupts (ft recovery, ulfm rank_kill) fire at
+            # blocking-collective entry: seg/device providers can
+            # complete whole ops on their own fast paths without one
+            # progress sweep, so a rank looping over collectives would
+            # otherwise never consume its pending interrupt
+            pr.progress()
+        u = comm.state.ulfm
+        if u is not None and u.active:
+            # ULFM entry check: a collective on a revoked comm raises
+            # ERR_REVOKED, one naming a failed member ERR_PROC_FAILED
+            # (instead of hanging on the dead rank).  Healthy-path
+            # cost is the is-None check above — `active` only flips
+            # once a failure record has actually arrived.
+            u.poll()
+            u.check_comm(comm)
         tok = trace.coll_begin(comm, fname)
         if tok is None:
             return fn(comm, *args, **kwargs)

@@ -95,6 +95,12 @@ class FusedRequest(Request):
             self._engine.flush()
         st = super().wait(timeout)
         if self._error is not None:
+            from ompi_tpu.errhandler import MPIException
+            if isinstance(self._error, MPIException):
+                # ULFM classes (PROC_FAILED/REVOKED) must surface
+                # unchanged so the app's recovery logic can match on
+                # the error class
+                raise self._error
             raise RuntimeError(
                 f"fused device collective failed: {self._error}"
             ) from self._error

@@ -391,6 +391,18 @@ class SegCollModule(TunedModule):
         return ok
 
     # -- segment machinery -----------------------------------------------
+    @staticmethod
+    def _ulfm_check(comm) -> None:
+        """Failure-aware parking: a ULFM failure record naming a
+        member of this comm (or a revoke) turns a parked seg wait into
+        ERR_PROC_FAILED/ERR_REVOKED now, instead of a generic stall
+        RuntimeError after the full timeout.  One is-None check when
+        ULFM is off or no failure has ever been recorded."""
+        u = comm.state.ulfm
+        if u is not None and u.active:
+            u.poll()
+            u.check_comm(comm)
+
     def _wait(self, comm, cond, what: str) -> None:
         """Poll ``cond`` with a cheap flag read per iteration, a brief
         sleep between polls (oversubscribed hosts: the flag-writer
@@ -408,6 +420,7 @@ class SegCollModule(TunedModule):
             spins += 1
             if spins % stride == 0:
                 progress.progress()
+                self._ulfm_check(comm)
                 if time.monotonic() > deadline:
                     raise RuntimeError(
                         f"coll/seg stalled >{_timeout_var.value}s "
@@ -455,6 +468,7 @@ class SegCollModule(TunedModule):
             if vals32[i] < g and now - t0 >= park / 2:
                 # timed out, not event-woken: background service
                 progress.progress()
+                self._ulfm_check(comm)
             # stall check OUTSIDE the timed-out branch: a wait() that
             # returns instantly without progress (e.g. a broken futex
             # probe) must still reach the dead-peer diagnosis instead
@@ -495,6 +509,7 @@ class SegCollModule(TunedModule):
             now = time.monotonic()
             if word64[0] < g and now - t0 >= park / 2:
                 progress.progress()
+                self._ulfm_check(comm)
             if now > deadline and not cond():
                 raise RuntimeError(
                     f"coll/seg stalled >{_timeout_var.value}s "
@@ -569,6 +584,7 @@ class SegCollModule(TunedModule):
         deadline = time.monotonic() + _timeout_var.value
         while True:
             progress.progress()
+            self._ulfm_check(comm)
             r = seg.fn(*call)
             if r == 0:
                 _pvar_native.add(1)

@@ -330,6 +330,56 @@ class Communicator:
     def abort(self, errorcode: int = 1) -> None:
         self.state.rte.abort(errorcode, f"abort on {self.name}")
 
+    # -- ULFM fault tolerance (ompi_tpu/ft/ulfm; the MPIX_Comm_*
+    # surface of the MPI-4 FT proposal) ---------------------------------
+    def revoke(self) -> None:
+        """MPIX_Comm_revoke: poison this communicator on every member
+        (NOT collective — any member may call it; typically the first
+        rank that catches ERR_PROC_FAILED mid-algorithm).  In-flight
+        and future operations drain with ERR_REVOKED; agree/shrink
+        keep working — they are the escape hatch."""
+        from ompi_tpu.ft import ulfm as _ulfm
+        _ulfm.publish_revoke(self)
+
+    def is_revoked(self) -> bool:
+        u = self.state.ulfm
+        if u is None:
+            return False
+        u.poll()
+        return (self.cid, tuple(self.group)) in u.revoked
+
+    def get_failed(self) -> List[int]:
+        """MPIX_Comm_get_failed analog: comm ranks known failed."""
+        u = self.state.ulfm
+        if u is None:
+            return []
+        u.poll()
+        return [r for r, g in enumerate(self.group) if g in u.failed]
+
+    def ack_failed(self) -> int:
+        """MPIX_Comm_ack_failed: acknowledge the current failure set
+        (re-arms ANY_SOURCE receives); returns how many are acked."""
+        u = self.state.ulfm
+        if u is None:
+            return 0
+        u.poll()
+        u.acked |= u.failed.intersection(self.group)
+        return sum(1 for g in self.group if g in u.acked)
+
+    def agree(self, flag=True) -> bool:
+        """MPIX_Comm_agree: fault-tolerant agreement — every survivor
+        returns the same AND of the contributed flags, no matter when
+        members die (see ompi_tpu/ft/ulfm.agree)."""
+        from ompi_tpu.ft import ulfm as _ulfm
+        return _ulfm.agree(self, flag)
+
+    def shrink(self, name: str = "") -> "Communicator":
+        """MPIX_Comm_shrink: a new communicator of the survivors, with
+        the device mesh rebuilt and stale compiled collectives
+        dropped.  Collective over the survivors."""
+        from ompi_tpu.ft import ulfm as _ulfm
+        return _ulfm.shrink(self, name)
+
     # -- error handlers (ref: ompi/errhandler) --------------------------
     def Set_errhandler(self, handler) -> None:
         self.errhandler = handler

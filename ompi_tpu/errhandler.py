@@ -61,6 +61,11 @@ ERR_SPAWN = 46
 ERR_UNSUPPORTED_DATAREP = 47
 ERR_UNSUPPORTED_OPERATION = 48
 ERR_WIN = 49
+# ULFM / MPI-4 fault-tolerance classes (values match the reference's
+# MPIX_ERR_* block in ompi/include/mpi.h.in)
+ERR_PROC_FAILED = 75
+ERR_PROC_FAILED_PENDING = 76
+ERR_REVOKED = 77
 ERR_LASTCODE = 93
 
 _CLASS_NAMES = {
@@ -158,6 +163,37 @@ ERRORS_ARE_FATAL = Errhandler(None, "MPI_ERRORS_ARE_FATAL")
 ERRORS_RETURN = Errhandler(None, "MPI_ERRORS_RETURN")
 ERRORS_ABORT = Errhandler(None, "MPI_ERRORS_ABORT")  # MPI-4 alias
 
+# The world communicator's default is wired EXPLICITLY at mpi_init
+# (never reached through the dispatch fallback): MPI's C default is
+# MPI_ERRORS_ARE_FATAL, but in this binding raising IS the error-return
+# mechanism (the mpi4py stance — mpi4py likewise installs ERRORS_RETURN
+# on the predefined communicators), so 'return' stays the default and
+# 'fatal'/'abort' restore the reference behavior per job.
+_world_default_var = None
+
+
+def _world_var():
+    global _world_default_var
+    if _world_default_var is None:
+        from ompi_tpu.mca.params import registry
+        _world_default_var = registry.register(
+            "mpi", "errhandler", "world_default", "return", str,
+            help="Error handler installed on the predefined "
+                 "communicators (COMM_WORLD/COMM_SELF) at MPI_Init: "
+                 "'return' (raise MPIException, the mpi4py stance), "
+                 "'fatal' (the reference C default MPI_ERRORS_ARE_FATAL"
+                 " — abort the job via the rte), 'abort' (the MPI-4 "
+                 "MPI_ERRORS_ABORT alias)")
+    return _world_default_var
+
+
+def world_default() -> Errhandler:
+    """Resolve mpi_errhandler_world_default into the handler object
+    mpi_init installs on COMM_WORLD/COMM_SELF."""
+    return {"fatal": ERRORS_ARE_FATAL,
+            "abort": ERRORS_ABORT}.get(
+                _world_var().value.strip().lower(), ERRORS_RETURN)
+
 
 def attach_api(cls) -> None:
     """Install Set/Get/Call_errhandler methods on an MPI object class
@@ -181,8 +217,18 @@ def dispatch(obj, exc: BaseException, state=None):
     """Route an error through `obj`'s installed handler
     (ref: OMPI_ERRHANDLER_INVOKE): FATAL/ABORT aborts the job via the
     rte; RETURN re-raises (the Python 'return code'); a user handler
-    runs fn(obj, code) first, then the exception propagates."""
-    handler = getattr(obj, "errhandler", None) or ERRORS_RETURN
+    runs fn(obj, code) first, then the exception propagates.
+
+    A handler-less object resolves through the world communicator's
+    installed handler when a state is reachable (the reference routes
+    object-less errors to MPI_COMM_WORLD's handler, ref:
+    ompi/errhandler/errhandler.h OMPI_ERRHANDLER_INVOKE(NULL,...));
+    only with no state at all does the wired job default apply."""
+    handler = getattr(obj, "errhandler", None)
+    if handler is None:
+        st = state or getattr(obj, "state", None)
+        cw = getattr(st, "comm_world", None) if st is not None else None
+        handler = getattr(cw, "errhandler", None) or world_default()
     code = classify(exc)
     if handler in (ERRORS_ARE_FATAL, ERRORS_ABORT):
         st = state or getattr(obj, "state", None)

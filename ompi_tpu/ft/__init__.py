@@ -1,0 +1,23 @@
+"""User-level failure mitigation (ULFM / MPI-4 FT proposal analog).
+
+The forward-recovery complement to ``runtime/ft.py``'s whole-job
+rollback: permanent rank death is detected, surfaced to the
+application as ``MPI_ERR_PROC_FAILED``, and mitigated in place with
+``Comm.revoke()`` / ``Comm.agree()`` / ``Comm.shrink()`` so the job
+continues on the survivors (ref: ompi/communicator/ft and the
+MPIX_Comm_* surface of the ULFM prototype).
+"""
+
+from ompi_tpu.ft.ulfm import (  # noqa: F401
+    RankKilled,
+    UlfmState,
+    agree,
+    arm_rank_kill,
+    attach,
+    kill_now,
+    publish_failure,
+    publish_revoke,
+    publish_world_failure,
+    shrink,
+    start_watcher,
+)

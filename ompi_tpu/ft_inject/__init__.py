@@ -43,7 +43,8 @@ _plan_var = registry.register(
     help="Comma list of fault classes to arm, each optionally "
          "class:rate — e.g. 'drop:0.05,sever:0.01'.  Classes: drop, "
          "delay, dup, reorder, corrupt, sever, daemon_kill, "
-         "oob_sever, kv_partition.  Empty = framework disabled")
+         "oob_sever, kv_partition, rank_kill.  Empty = framework "
+         "disabled")
 _rate_var = registry.register(
     "ft", "inject", "rate", 0.02, float,
     help="Default per-event injection probability for plan entries "
@@ -63,6 +64,10 @@ _after_var = registry.register(
 _victim_var = registry.register(
     "ft", "inject", "victim_node", 1, int,
     help="Node id that hosts the daemon_kill/oob_sever scenarios")
+_victim_rank_var = registry.register(
+    "ft", "inject", "victim_rank", 1, int,
+    help="Global rank killed by the rank_kill scenario (permanent "
+         "death: the ULFM detect/revoke/shrink/agree test target)")
 _delay_ms_var = registry.register(
     "ft", "inject", "delay_ms", 20, int,
     help="How long a 'delay'-class frame is held before hitting the "
@@ -70,6 +75,9 @@ _delay_ms_var = registry.register(
 
 BTL_CLASSES = ("drop", "delay", "dup", "reorder", "corrupt", "sever")
 NODE_CLASSES = ("daemon_kill", "oob_sever")
+# permanent per-RANK scenarios: unlike the transient classes these
+# fire exactly once (there is no rate — death is not probabilistic)
+RANK_CLASSES = ("rank_kill",)
 
 
 def plan() -> Dict[str, float]:
@@ -186,6 +194,20 @@ def node_faults(node_id: int) -> List[str]:
         return []
     p = plan()
     return [c for c in NODE_CLASSES if c in p]
+
+
+def rank_faults(rank: int) -> List[str]:
+    """Permanent rank-level scenario classes armed on THIS global
+    rank (mpi_init consults this once and arms a one-shot timer;
+    tpud consults it to kill the victim's child process for real)."""
+    if rank != _victim_rank_var.value:
+        return []
+    p = plan()
+    return [c for c in RANK_CLASSES if c in p]
+
+
+def rank_kill_victim() -> int:
+    return _victim_rank_var.value
 
 
 def after_s() -> float:

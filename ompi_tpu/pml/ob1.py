@@ -70,15 +70,16 @@ MSEG = "MG"        # segmented MATCH: vprotocol replay of payloads
 
 
 class SendRequest(Request):
-    __slots__ = ("conv", "req_id", "total", "dst", "acked", "mc_crc",
-                 "tr")
+    __slots__ = ("conv", "req_id", "total", "dst", "cid", "acked",
+                 "mc_crc", "tr")
 
-    def __init__(self, progress, conv, req_id, dst):
+    def __init__(self, progress, conv, req_id, dst, cid=-1):
         super().__init__(progress)
         self.conv = conv
         self.req_id = req_id
         self.total = conv.packed_size
-        self.dst = dst
+        self.dst = dst           # GLOBAL rank (failure matching)
+        self.cid = cid           # communicator id (revoke matching)
         self.tr = None  # (t0, mid) while a span tracer is attached
 
 
@@ -162,6 +163,10 @@ class PmlOb1:
         # selection): the p2p hot paths pay one is-None check when
         # tracing is off — the peruse-flag discipline
         self._tracer = getattr(state, "tracer", None)
+        # ULFM state, same caching discipline; u.active only flips
+        # once the first failure/revoke record arrives, so the
+        # healthy-path cost is one attribute fetch + one falsy check
+        self._ulfm = getattr(state, "ulfm", None)
         state.progress.register(self.progress)
 
     # -- wiring ----------------------------------------------------------
@@ -198,6 +203,10 @@ class PmlOb1:
               mode=MODE_STANDARD, offset: int = 0) -> Request:
         if dst == PROC_NULL:
             return CompletedRequest(self.state.progress)
+        u = self._ulfm
+        if u is not None and u.active:
+            u.poll()
+            u.check_peer(comm, dst)
         # convertor construction FIRST: an argument error must not
         # consume the (cid,dst) sequence number (a burned seq wedges
         # the channel — the receiver can never match past the hole)
@@ -207,7 +216,7 @@ class PmlOb1:
         cid = comm.cid
         src = comm.rank
         req_id = next(self._req_counter)
-        req = SendRequest(self.state.progress, conv, req_id, gdst)
+        req = SendRequest(self.state.progress, conv, req_id, gdst, cid)
         req.status.count = conv.packed_size
         self.pvar_sent.add(conv.packed_size)
         if peruse.enabled:
@@ -300,6 +309,10 @@ class PmlOb1:
             r.status.source = PROC_NULL
             r.status.tag = ANY_TAG
             return r
+        u = self._ulfm
+        if u is not None and u.active:
+            u.poll()
+            u.check_peer(comm, src)
         conv = make_convertor(datatype, count, buf, offset=offset,
                               writable=True) \
             if buf is not None else Convertor(datatype, 0, b"")
@@ -841,6 +854,61 @@ class PmlOb1:
         self._replay_want.clear()
         self.cr_sent.clear()
         self.cr_arrived.clear()
+
+    # -- ULFM drain (ompi_tpu/ft/ulfm) ------------------------------------
+    def ulfm_sweep(self, failed, revoked) -> int:
+        """Complete every parked request naming a failed peer or a
+        revoked communicator with the matching ULFM error class
+        (Request.wait raises it) instead of hanging forever.  Called
+        from UlfmState._ingest whenever a failure/revoke record is
+        ingested — the drain half of detect → report."""
+        from ompi_tpu import errhandler as _eh
+        n = 0
+        for req in list(self._send_reqs.values()):
+            err = 0
+            group = self._ulfm_group(req.cid)
+            if group is not None and (req.cid, group) in revoked:
+                err = _eh.ERR_REVOKED
+            elif req.dst in failed:
+                err = _eh.ERR_PROC_FAILED
+            if err:
+                self._send_reqs.pop(req.req_id, None)
+                req.status.error = err
+                req._complete()
+                if req.tr is not None:
+                    self._trace_p2p_end(req, "send", 0)
+                n += 1
+        for req in list(self._recv_reqs.values()):
+            err = 0
+            group = self._ulfm_group(req.cid)
+            if group is not None:
+                src = req.status.source if req.matched else req.src
+                if (req.cid, group) in revoked:
+                    err = _eh.ERR_REVOKED
+                elif src == ANY_SOURCE:
+                    # simplification vs the reference: a parked
+                    # wildcard receive completes with the PENDING
+                    # class rather than staying pending until
+                    # failure_ack (there is no re-park here)
+                    if any(g in failed for g in group):
+                        err = _eh.ERR_PROC_FAILED_PENDING
+                elif 0 <= src < len(group) and group[src] in failed:
+                    err = _eh.ERR_PROC_FAILED
+            if err:
+                posted = self._posted.get(req.cid, [])
+                if req in posted:
+                    posted.remove(req)
+                self._recv_reqs.pop(req.req_id, None)
+                req.status.error = err
+                req._complete()
+                if req.tr is not None:
+                    self._trace_p2p_end(req, "recv", 0)
+                n += 1
+        return n
+
+    def _ulfm_group(self, cid: int):
+        comm = self.state.comms.get(cid)
+        return None if comm is None else tuple(comm.group)
 
     # -- cancel ----------------------------------------------------------
     def cancel_recv(self, req: RecvRequest) -> bool:

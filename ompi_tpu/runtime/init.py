@@ -77,6 +77,11 @@ def mpi_init(state: ProcState, device=None) -> ProcState:
         world.size if world is not None
         else int(os.environ.get("TPUMPI_LOCAL_SIZE", "1")))
     state.progress.oversubscribed = nlocal > (os.cpu_count() or 1)
+    # ULFM failure-mitigation state BEFORE pml selection so the pml
+    # can cache state.ulfm (None when mpi_ft_ulfm is off — the same
+    # one-is-None-check contract as the tracer)
+    from ompi_tpu.ft import ulfm as _ulfm
+    _ulfm.attach(state)
     # 1. select the single pml engine (ref: ompi_mpi_init.c:640),
     # optionally interposed by pml/monitoring
     comp, pml_cls = _pml_ob1.pml_framework.select_one(state)
@@ -137,6 +142,14 @@ def mpi_init(state: ProcState, device=None) -> ProcState:
     _attrs.init_world_attrs(state.comm_world)
     state.comm_self = Communicator(state, 1, Group([state.rank]),
                                    name="MPI_COMM_SELF")
+    # wire the predefined communicators' error handler EXPLICITLY
+    # (mpi_errhandler_world_default; derived comms keep inheriting
+    # from their parent) — the dispatch fallback for handler-less
+    # objects resolves through comm_world, so this is the one place
+    # the job default is installed
+    from ompi_tpu import errhandler as _eh
+    state.comm_world.errhandler = _eh.world_default()
+    state.comm_self.errhandler = state.comm_world.errhandler
     # 4. collective module stacks are installed by Communicator
     # construction itself (coll_base_comm_select analog)
     # 5. final fence before returning (sync #2, ref: :833-838)
@@ -148,6 +161,17 @@ def mpi_init(state: ProcState, device=None) -> ProcState:
         # instead of hanging them (runtime/ft.py)
         from ompi_tpu.runtime import ft as _ft
         _ft.start_watcher(state)
+    if state.ulfm is not None:
+        # ft_inject rank_kill: this rank is the victim — arm the
+        # one-shot death timer (fires as a RankKilled interrupt out
+        # of the next progress sweep)
+        from ompi_tpu import ft_inject as _fi
+        if "rank_kill" in _fi.rank_faults(state.rank):
+            _ulfm.arm_rank_kill(state, _fi.after_s())
+        if os.environ.get("TPUMPI_ULFM"):
+            # launcher runs the ulfm errmgr policy: consume job-wide
+            # ulfm:note:<n> failure/revoke records from the KV store
+            _ulfm.start_watcher(state)
     return state
 
 

@@ -451,6 +451,18 @@ class KVClient:
         resp = self._request({"op": "incr", "key": key})
         return int(resp["value"])
 
+    def put_once(self, key: str, value: Any) -> bool:
+        """First-writer-wins publish: claims ``key`` through an
+        incr-ticket (pre-increment 0 == first claimant) and only the
+        winner stores the value.  Losers return False and must
+        ``get`` the winner's value.  Gives the ULFM agreement/shrink
+        protocols a decide-once primitive without a server-side CAS
+        op."""
+        if self.incr("claim:" + key) == 0:
+            self.put(key, value)
+            return True
+        return False
+
     def uncr(self, key: str, expect: int) -> bool:
         """Roll back a ticket taken with incr() (which returned
         ``expect``) — succeeds only if no later ticket was issued."""

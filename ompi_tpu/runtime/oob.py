@@ -50,6 +50,17 @@ reconnect_grace_var = registry.register(
          "the legacy behavior)")
 
 
+def silence_budget_s() -> float:
+    """Heartbeat-silence horizon: how long a daemon may stay quiet
+    before the HNP declares it lost (0.0 = monitoring disabled).
+    The ULFM errmgr policy promotes this signal into per-rank failure
+    records — the same budget, one definition."""
+    if heartbeat_budget_var.value <= 0 or \
+            heartbeat_interval_var.value <= 0:
+        return 0.0
+    return heartbeat_budget_var.value * heartbeat_interval_var.value
+
+
 class Channel:
     """One framed bidirectional control connection.  ``send`` is
     thread-safe; inbound messages are dispatched from a dedicated

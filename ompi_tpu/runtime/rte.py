@@ -19,7 +19,8 @@ from __future__ import annotations
 
 import os
 import threading
-from typing import Any, Dict, List, Optional
+import time
+from typing import Any, Dict, List, Optional, Set
 
 from ompi_tpu.mca.params import registry
 
@@ -66,6 +67,41 @@ class InprocWorld:
         # coll/hbm), keyed by communicator cid
         self.shared: Dict[Any, Any] = {}
         self.shared_lock = threading.Lock()
+        # ULFM (ompi_tpu/ft/ulfm): global ranks declared permanently
+        # dead.  Fences count survivors only, so a kill shrinks the
+        # quorum instead of hanging every later fence
+        self.ulfm_failed: Set[int] = set()
+        self._uf_cv = threading.Condition()
+        self._uf_count = 0
+        self._uf_gen = 0
+
+    def ulfm_fence(self, rank: int, timeout: float) -> None:
+        """Generation-counting barrier over the SURVIVORS: `need` is
+        recomputed on every wake, so a rank dying while others are
+        parked here shrinks the quorum and releases them (a
+        threading.Barrier's party count is frozen at construction —
+        exactly what a failure-aware fence cannot use).  The short
+        wait slices double as an abort poll: a peer that errors out
+        releases everyone without needing to know about this cv."""
+        with self._uf_cv:
+            gen = self._uf_gen
+            self._uf_count += 1
+            deadline = time.monotonic() + timeout
+            while gen == self._uf_gen:
+                if self.aborted is not None and self.aborted[0] != rank:
+                    raise RuntimeError(
+                        f"peer rank {self.aborted[0]} aborted: "
+                        f"{self.aborted[2]}")
+                if self._uf_count >= self.size - len(self.ulfm_failed):
+                    self._uf_count = 0
+                    self._uf_gen += 1
+                    self._uf_cv.notify_all()
+                    return
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    raise TimeoutError(
+                        f"fence timed out (rank {rank})")
+                self._uf_cv.wait(timeout=min(left, 0.05))
 
     def is_local(self, rank: int) -> bool:
         """Is `rank` a thread in this process (inproc-btl reachable,
@@ -121,7 +157,7 @@ class InprocRTE(RTE):
             return self.world.modex[(peer, key)]
 
     def fence(self) -> None:
-        self.world.barrier.wait(timeout=_fence_timeout_var.value)
+        self.world.ulfm_fence(self.rank, _fence_timeout_var.value)
 
     def abort(self, code: int, msg: str = "") -> None:
         self.world.aborted = (self.rank, code, msg)
@@ -182,10 +218,17 @@ class EnvRTE(RTE):
 
     def fence(self) -> None:
         # namespaced by job and sized to the job's world: spawned
-        # jobs fence among themselves, never with the parent job
+        # jobs fence among themselves, never with the parent job.
+        # ULFM-declared dead ranks (ulfm_failed is maintained by
+        # UlfmState._ingest) never arrive — shrink the quorum so
+        # survivor fences complete (the KV server honors per-message
+        # weights)
         self._fence_count += 1
+        dead = sum(1 for r in getattr(self, "ulfm_failed", ())
+                   if self.world_base <= r <
+                   self.world_base + self.world_size)
         self.kv.fence(f"{self.jobid}:f{self._fence_count}",
-                      n=self.world_size)
+                      n=self.world_size - dead)
 
     def abort(self, code: int, msg: str = "") -> None:
         import os

@@ -31,6 +31,9 @@ class ProcState:
         # span tracer (ompi_tpu/trace); None unless trace_enable —
         # hot paths pay exactly one is-None check when tracing is off
         self.tracer: Any = None
+        # ULFM failure-mitigation state (ompi_tpu/ft/ulfm); None when
+        # mpi_ft_ulfm is off — same one-is-None-check hot-path contract
+        self.ulfm: Any = None
         self.finalized = False
         self.initialized = False
         self.extra: Dict[str, Any] = {}

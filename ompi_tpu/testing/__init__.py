@@ -26,13 +26,19 @@ class RankError(RuntimeError):
 
 
 def run_ranks(n: int, fn: Callable, devices: bool = False,
-              timeout: float = 120.0, device_map=None) -> List[Any]:
+              timeout: float = 120.0, device_map=None,
+              allow_failures: bool = False) -> List[Any]:
     """Run fn(comm_world) on n thread-ranks; returns per-rank results.
 
     devices=True maps rank i to jax.devices()[i % ndev] so coll/tpu
     and coll/hbm become eligible.  device_map overrides: a callable
     rank -> jax device (e.g. lambda r: jax.devices()[0] to co-locate
     every rank on one chip and exercise coll/hbm).
+
+    allow_failures=True treats a rank dying with ulfm.RankKilled as
+    the scenario, not an error: its failure is published ULFM-style
+    (survivors get ERR_PROC_FAILED and may revoke/agree/shrink), its
+    result slot stays None, and only survivor errors raise.
     """
     world = InprocWorld(n)
     results: List[Any] = [None] * n
@@ -66,6 +72,13 @@ def run_ranks(n: int, fn: Callable, devices: bool = False,
             # against peers that died before reaching it
             mpi_finalize(state)
         except BaseException as e:  # noqa: BLE001
+            if allow_failures:
+                from ompi_tpu.ft import ulfm as _ulfm
+                if isinstance(e, _ulfm.RankKilled):
+                    # the injected death IS the test scenario: the
+                    # rank is gone, survivors mitigate via ULFM
+                    _ulfm.publish_world_failure(world, rank)
+                    return
             errors[rank] = RankError(rank, e, traceback.format_exc())
             if world.aborted is None:
                 world.aborted = (rank, 1, str(e))

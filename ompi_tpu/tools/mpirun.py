@@ -60,7 +60,11 @@ _errmgr_policy_var = _params.register(
          "ranks onto a survivor at a bumped recovery epoch while "
          "the job keeps running — live re-route, runtime/ft.py; "
          "ref: rmaps_resilient.c:76+, routed_radix.c:58 and "
-         "orte/mca/rmaps/resilient/rmaps_resilient.c)")
+         "orte/mca/rmaps/resilient/rmaps_resilient.c), or 'ulfm' "
+         "(forward recovery, ompi_tpu/ft/ulfm: a dead rank becomes a "
+         "job-wide failure record; survivors get ERR_PROC_FAILED and "
+         "continue via Comm.revoke/agree/shrink — no restart, no "
+         "rollback)")
 _errmgr_max_restarts_var = _params.register(
     "errmgr", "base", "max_restarts", 2, int,
     help="Automatic relaunch attempts before giving up (restart "
@@ -85,6 +89,33 @@ def _pkg_root() -> str:
     import ompi_tpu as _pkg
     return os.path.dirname(os.path.dirname(os.path.abspath(
         _pkg.__file__)))
+
+
+def _ulfm_publish_failed(server: KVServer, ranks) -> None:
+    """Append job-wide ULFM failure records (``ulfm:note:<n>``) for
+    dead ranks; every surviving rank's ulfm watcher consumes them in
+    order.  Written under the server lock so getters blocked on the
+    next note wake through the server's condition variable."""
+    with server.cv:
+        n = server.counters.get("ulfm:nseq", 0)
+        for r in ranks:
+            server.data[f"ulfm:note:{n}"] = ["fail", int(r)]
+            n += 1
+        server.counters["ulfm:nseq"] = n
+        server.cv.notify_all()
+
+
+def _tag_ranks(tag: str) -> List[int]:
+    """Global ranks named by a launch-unit tag ('3', '4-7', or the
+    multinode 'node:3' / 'node:4-7' forms)."""
+    tag = tag.rsplit(":", 1)[-1]
+    try:
+        if "-" in tag:
+            lo, hi = tag.split("-", 1)
+            return list(range(int(lo), int(hi) + 1))
+        return [int(tag)]
+    except ValueError:
+        return []
 
 
 def _wire_abort(server: KVServer, sm: smx.StateMachine) -> None:
@@ -261,6 +292,9 @@ def run_multinode(opts, nodes, rpp: int, hybrid: bool) -> int:
         if _errmgr_policy_var.value == "recover" and opts.ckpt_dir:
             # ranks start the ft epoch watcher (runtime/ft.py)
             job_env["TPUMPI_FT_RECOVER"] = "1"
+        if _errmgr_policy_var.value == "ulfm":
+            # ranks start the ulfm note watcher (ompi_tpu/ft/ulfm)
+            job_env["TPUMPI_ULFM"] = "1"
         if hybrid:
             job_env["TPUMPI_DEVICES"] = opts.devices
         for key, value in opts.mca:
@@ -302,7 +336,33 @@ def run_multinode(opts, nodes, rpp: int, hybrid: bool) -> int:
             return  # clean teardown closes daemon channels
         if sm.state == smx.RUNNING and try_recover(sm, info["node"]):
             return  # job keeps running on the survivors
+        if sm.state == smx.RUNNING \
+                and _errmgr_policy_var.value == "ulfm" \
+                and try_ulfm_node(sm, info["node"]):
+            return  # survivors continue with ERR_PROC_FAILED
         sm.activate(smx.DAEMON_FAILED, node=info["node"])
+
+    def try_ulfm_node(sm, node: int) -> bool:
+        """ULFM forward recovery on daemon loss: declare every rank
+        the dead node hosted permanently failed (one note each) and
+        keep the job running — survivors shrink around the hole."""
+        failed = next((m for m in d["maps"]
+                       if m.node.node_id == node and m.procs), None)
+        if failed is None:
+            return False
+        ranks: List[int] = []
+        for p in failed.procs:
+            ranks += list(range(p.rank_base,
+                                p.rank_base + max(1, p.nlocal)))
+        _ulfm_publish_failed(d["server"], ranks)
+        d["done"].add(node)  # the node will never report node_done
+        sys.stderr.write(
+            f"mpirun: daemon on node {node} lost; ulfm policy: "
+            f"ranks {ranks} declared failed, job continues on "
+            f"survivors\n")
+        if d["active"] <= d["done"]:
+            sm.activate(smx.DRAINING, failed=False)
+        return True
 
     def try_recover(sm, node: int) -> bool:
         """Live fault recovery (errmgr_base_policy=recover +
@@ -408,9 +468,20 @@ def run_multinode(opts, nodes, rpp: int, hybrid: bool) -> int:
         sm.activate(smx.RUNNING)
 
     def ev_proc_exit(sm, info):  # only abnormal exits are posted
-        if not d.get("drained"):
-            sm.activate(smx.PROC_FAILED, who=info["tag"],
-                        code=info["code"], error=info.get("error", ""))
+        if d.get("drained"):
+            return
+        if sm.state == smx.RUNNING \
+                and _errmgr_policy_var.value == "ulfm":
+            ranks = _tag_ranks(info["tag"])
+            if ranks:
+                _ulfm_publish_failed(d["server"], ranks)
+                sys.stderr.write(
+                    f"mpirun: {info['tag']} exited with status "
+                    f"{info['code']}; ulfm policy: ranks {ranks} "
+                    f"declared failed, job continues on survivors\n")
+                return
+        sm.activate(smx.PROC_FAILED, who=info["tag"],
+                    code=info["code"], error=info.get("error", ""))
 
     def ev_node_done(sm, info):
         d["done"].add(info["node"])
@@ -488,6 +559,9 @@ def run_local(opts, rpp: int, hybrid: bool, ckpt_env: dict) -> int:
     })
     for key, value in opts.mca:
         env_base[f"TPUMPI_MCA_{key}"] = value
+    if _errmgr_policy_var.value == "ulfm":
+        # ranks start the ulfm note watcher (ompi_tpu/ft/ulfm)
+        env_base["TPUMPI_ULFM"] = "1"
 
     def _write_proctable() -> None:
         """MPIR proctable analog (ref: ompi/debuggers MPIR_proctable):
@@ -526,7 +600,8 @@ def run_local(opts, rpp: int, hybrid: bool, ckpt_env: dict) -> int:
 
         def reap() -> None:
             code = p.wait()
-            sm.activate("EV_PROC_EXIT", code=code, who=f"rank {tag}"
+            sm.activate("EV_PROC_EXIT", code=code, tag=tag,
+                        who=f"rank {tag}"
                         if "-" not in tag else f"ranks {tag}")
         threading.Thread(target=reap, daemon=True).start()
 
@@ -614,6 +689,21 @@ def run_local(opts, rpp: int, hybrid: bool, ckpt_env: dict) -> int:
                                             smx.TERMINATED):
             return
         if info["code"] != 0:
+            if sm.state == smx.RUNNING \
+                    and _errmgr_policy_var.value == "ulfm":
+                ranks = _tag_ranks(info.get("tag", ""))
+                if ranks:
+                    # ulfm policy: promote the dead ranks into
+                    # job-wide failure records and keep running —
+                    # survivors see ERR_PROC_FAILED and shrink
+                    _ulfm_publish_failed(server, ranks)
+                    sys.stderr.write(
+                        f"mpirun: {info['who']} exited with status "
+                        f"{info['code']}; ulfm policy: declared "
+                        f"failed, job continues on survivors\n")
+                    if left <= 0:
+                        sm.activate(smx.DRAINING, failed=False)
+                    return
             # errmgr default-HNP policy: first abnormal exit kills
             # the job and its code is the job's code
             sm.activate(smx.PROC_FAILED, who=info["who"],

@@ -369,6 +369,29 @@ def main(argv: Optional[List[str]] = None) -> int:
         t.daemon = True
         t.start()
 
+    # rank_kill: SIGKILL the launch unit hosting the victim GLOBAL
+    # rank — a REAL dead child (not a simulated one), so detection
+    # runs the production path: proc-exit report → HNP ulfm errmgr
+    # policy → job-wide failure record
+    if "rank_kill" in ft_inject.plan():
+        victim = ft_inject.rank_kill_victim()
+
+        def _rank_kill() -> None:
+            with units_lock:
+                snapshot = list(units)
+            for u in snapshot:
+                lo, hi = u.rank_base, u.rank_base + max(1, u.nlocal)
+                if lo <= victim < hi and u.proc.poll() is None:
+                    try:
+                        u.proc.kill()
+                    except OSError:
+                        pass
+                    return
+
+        tk = threading.Timer(ft_inject.after_s(), _rank_kill)
+        tk.daemon = True
+        tk.start()
+
     # monitor loop: report unit exits; finish when every unit the
     # launch message promised has been spawned AND exited (guards the
     # race where the first unit dies while later ones are still being

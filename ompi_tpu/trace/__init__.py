@@ -231,6 +231,15 @@ def dump_state(state) -> Optional[str]:
     return path
 
 
+def instant_state(state, name: str, cat: str, **args) -> None:
+    """Record an instant against a specific rank's tracer (the ULFM
+    layer annotates detect/revoke/shrink/agree this way — state in
+    hand, no thread-local lookup); no-op when tracing is off."""
+    tr = getattr(state, "tracer", None)
+    if tr is not None:
+        tr.instant(name, cat, **args)
+
+
 # -- process-global tracer (daemons: no ProcState) --------------------------
 
 _global: Optional[Tracer] = None

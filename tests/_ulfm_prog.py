@@ -1,0 +1,29 @@
+"""ULFM chaos program (run via mpirun by test_ulfm.py): one rank is
+killed mid-loop by ft_inject ``rank_kill``; under the ``ulfm`` errmgr
+policy the survivors see ERR_PROC_FAILED, shrink, and finish the job
+on the remaining ranks — forward recovery, no restart."""
+import time
+
+import numpy as np
+
+import ompi_tpu
+from ompi_tpu.errhandler import MPIException
+from ompi_tpu.op import op as mpi_op
+
+comm = ompi_tpu.init()
+work = comm
+r = np.empty(64, dtype=np.float64)
+shrunk = 0
+for step in range(120):
+    try:
+        buf = np.full(64, work.rank + 1.0, dtype=np.float64)
+        work.Allreduce(buf, r, mpi_op.SUM)
+    except MPIException as e:
+        assert e.code in (75, 76, 77), e.code
+        work = work.shrink(name="survivors")
+        shrunk += 1
+        continue
+    time.sleep(0.02)
+print(f"rank={work.rank} size={work.size} shrunk={shrunk} "
+      f"sum={float(r[0])}", flush=True)
+ompi_tpu.finalize()

@@ -281,6 +281,52 @@ def test_keyval_free_deferred_while_attached():
         attrs.set_attr(Obj(), kv, 1)
 
 
+# ---- mpi_errhandler_world_default (PR 4) ---------------------------
+
+def test_world_default_fatal_param():
+    """--mca mpi_errhandler_world_default fatal restores the reference
+    C default: the predefined comms come up FATAL, derived comms
+    inherit it, and an error aborts via the rte (SystemExit in thread
+    worlds)."""
+    from ompi_tpu.mca.params import registry
+    prior = registry.get("mpi_errhandler_world_default", "return")
+    registry.set("mpi_errhandler_world_default", "fatal")
+    try:
+        def fn(comm):
+            assert comm.Get_errhandler() is ERRORS_ARE_FATAL
+            d = comm.dup()
+            assert d.Get_errhandler() is ERRORS_ARE_FATAL
+            try:
+                comm.Send(np.zeros(1), dest=99)
+            except SystemExit:
+                return "aborted"
+            return "no-abort"
+
+        assert run_ranks(1, fn) == ["aborted"]
+    finally:
+        registry.set("mpi_errhandler_world_default", prior)
+
+
+def test_handlerless_object_resolves_through_world():
+    """An errhandler-less MPI object dispatches through COMM_WORLD's
+    installed handler (OMPI_ERRHANDLER_INVOKE(NULL, ...) analog), not
+    straight to the compiled-in default."""
+    def fn(comm):
+        hits = []
+        comm.Set_errhandler(Errhandler(
+            lambda obj, code: hits.append(code)))
+
+        class Bare:  # e.g. a window/file before its handler is set
+            state = comm.state
+
+        with pytest.raises(MPIException):
+            errhandler.dispatch(Bare(), MPIException(errhandler.ERR_IO))
+        assert hits == [errhandler.ERR_IO]
+        return True
+
+    assert run_ranks(1, fn) == [True]
+
+
 def test_keyval_free_unattached_is_immediate():
     class Obj:
         def __init__(self):
