@@ -144,6 +144,14 @@ def main() -> None:
                          "off; persist under 'probe_recovery' in "
                          "BENCH_DETAIL.json, and FAIL (exit 1) if the "
                          "on path costs more than 5%%")
+    ap.add_argument("--probe-respawn", action="store_true",
+                    help="Measure the self-healing respawn MTTR (kill "
+                         "-> detect -> respawn/rejoin -> buddy restore "
+                         "-> first full-size collective) and the "
+                         "degree-0 cost of the buddy.checkpoint call; "
+                         "persist under 'probe_respawn' in "
+                         "BENCH_DETAIL.json, and FAIL (exit 1) if the "
+                         "off-call costs more than 5%%")
     opts = ap.parse_args()
 
     detail_path = os.path.join(
@@ -227,6 +235,37 @@ def main() -> None:
             # must be near-free when nothing fails
             sys.stderr.write(
                 f"FAIL: ULFM entry-check overhead "
+                f"{probe['overhead_pct']}% exceeds the "
+                f"{probe['budget_pct']}% budget\n")
+            sys.exit(1)
+        return
+
+    if opts.probe_respawn:
+        from benchmarks.probe_respawn import persist, run_probe
+
+        probe = run_probe()
+        notes = persist(probe, detail_path)
+        line = {
+            "metric": f"respawn MTTR, {probe['nranks']} ranks, kill "
+                      f"rank {probe['victim']} mid-allreduce "
+                      f"(best-of-{probe['reps']})",
+            "value": probe["total_ms"],
+            "unit": "ms_kill_to_first_full_size_coll",
+            "detect_ms": probe["detect_ms"],
+            "respawn_ms": probe["respawn_ms"],
+            "restore_ms": probe["restore_ms"],
+            "first_coll_ms": probe["first_coll_ms"],
+            "buddy_off_overhead_pct": probe["overhead_pct"],
+            "within_budget": probe["within_budget"],
+        }
+        line.update({k: v for k, v in notes.items() if "error" in k})
+        sys.stderr.write(json.dumps(probe, indent=1) + "\n")
+        print(json.dumps(line))
+        if not probe["within_budget"]:
+            # same acceptance contract as the other probes: buddy
+            # replication must be FREE when it is off
+            sys.stderr.write(
+                f"FAIL: degree-0 buddy.checkpoint overhead "
                 f"{probe['overhead_pct']}% exceeds the "
                 f"{probe['budget_pct']}% budget\n")
             sys.exit(1)
@@ -346,7 +385,7 @@ def main() -> None:
         with open(detail_path, "w") as f:
             json.dump({**{k: prior[k]
                           for k in ("probe_dispatch", "trace_overhead",
-                                    "probe_recovery")
+                                    "probe_recovery", "probe_respawn")
                           if isinstance(prior, dict) and k in prior},
                        "device_us": dev, "software_us": sw,
                        "software_tuned_tcp_us": sw_tcp,

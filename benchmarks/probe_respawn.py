@@ -1,0 +1,196 @@
+"""--probe-respawn microbench: self-healing respawn MTTR + cost.
+
+Two questions, answered on a 4-rank thread-rank world (the same
+harness and conventions as probe_recovery):
+
+1. **How long from kill to healed?**  Rank 1 dies deterministically
+   after a buddy checkpoint has committed; the survivors and the
+   driver-respawned replacement run the full recovery pipeline.  Each
+   survivor times it from the instant of death: detect
+   (ERR_PROC_FAILED out of the parked collective), respawn+rejoin
+   (replacement up, decision agreed, un-fail, epoch fences, new
+   full-world communicator), restore (buddy copy pulled from a
+   partner, every rank rolled back), and the first FULL-SIZE
+   collective completing with the right answer — the MTTR the paper's
+   availability story turns on.  Reported numbers are rank 0's,
+   best-of-REPS.
+
+2. **What does buddy replication cost when OFF?**  With
+   ``cr_buddy_degree=0`` (the default) ``buddy.checkpoint`` must be a
+   single int check.  Measured like trace_overhead: interleaved reps
+   of the same app loop with the call absent vs present-but-off,
+   best-of per side, LOUD failure in bench.py when the off-call side
+   exceeds the budget.
+
+Results land in BENCH_DETAIL.json under ``probe_respawn``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict
+
+NRANKS = 4
+VICTIM = 1
+OPS = 400          # allreduces per overhead rep
+WARMUP = 20
+REPS = 5
+BUDGET_PCT = 5.0   # acceptance bound for the degree-0 checkpoint call
+
+
+def _measure_mttr() -> Dict:
+    """One kill → detect → respawn/rejoin → restore → first full-size
+    collective timeline."""
+    import numpy as np
+
+    from ompi_tpu.cr import buddy
+    from ompi_tpu.errhandler import MPIException
+    from ompi_tpu.ft import respawn, ulfm
+    from ompi_tpu.mca.params import registry
+    from ompi_tpu.op.op import SUM
+    from ompi_tpu.testing import run_ranks
+
+    registry.set("cr_buddy_degree", "1")
+    # the victim stamps t0 the instant before it dies; survivors
+    # subtract it from their own perf_counter reads (thread ranks
+    # share one clock, so no correction is needed)
+    t0 = [0.0]
+
+    def fn(comm):
+        sbuf = np.ones(16, dtype=np.float64)
+        rbuf = np.zeros(16, dtype=np.float64)
+        if respawn.joining(comm.state):
+            # the replacement's half of the pipeline: rejoin, pull the
+            # buddy copy, then meet the survivors' first collective
+            comm = respawn.rejoin(comm)
+            buddy.restore(comm)
+            comm.Allreduce(sbuf, rbuf, SUM)
+            return None
+        buddy.checkpoint(comm, {"step": 0})
+        if comm.rank == VICTIM:
+            time.sleep(0.05)  # let survivors park in the Allreduce
+            t0[0] = time.perf_counter()
+            ulfm.kill_now(comm.state)
+        try:
+            while True:
+                comm.Allreduce(sbuf, rbuf, SUM)
+        except MPIException as e:
+            t_detect = time.perf_counter()
+            assert e.code in (75, 76, 77), e.code
+        comm = respawn.rejoin(comm)
+        t_rejoin = time.perf_counter()
+        buddy.restore(comm)
+        t_restore = time.perf_counter()
+        comm.Allreduce(sbuf, rbuf, SUM)
+        t_first = time.perf_counter()
+        assert comm.size == NRANKS            # healed to FULL size
+        assert rbuf[0] == float(comm.size)
+        return {
+            "detect_ms": (t_detect - t0[0]) * 1e3,
+            "respawn_ms": (t_rejoin - t_detect) * 1e3,
+            "restore_ms": (t_restore - t_rejoin) * 1e3,
+            "first_coll_ms": (t_first - t_restore) * 1e3,
+            "total_ms": (t_first - t0[0]) * 1e3,
+        }
+
+    out = run_ranks(NRANKS, fn, respawn=True, timeout=120)
+    return out[0]  # rank 0's view; the victim slot holds the
+    #                replacement's None
+
+
+def _measure_overhead(with_call: bool) -> float:
+    """us/op of the healthy app loop without the buddy.checkpoint
+    call vs with it present at degree 0 (the zero-cost-when-off
+    contract)."""
+    import numpy as np
+
+    from ompi_tpu.cr import buddy
+    from ompi_tpu.op.op import SUM
+    from ompi_tpu.testing import run_ranks
+
+    def fn(comm):
+        sbuf = np.ones(8, dtype=np.float32)
+        rbuf = np.zeros(8, dtype=np.float32)
+        payload = {"step": 0}
+        for _ in range(WARMUP):
+            comm.Allreduce(sbuf, rbuf, SUM)
+        comm.Barrier()
+        t0 = time.perf_counter()
+        if with_call:
+            for _ in range(OPS):
+                assert buddy.checkpoint(comm, payload) == -1
+                comm.Allreduce(sbuf, rbuf, SUM)
+        else:
+            for _ in range(OPS):
+                comm.Allreduce(sbuf, rbuf, SUM)
+        return (time.perf_counter() - t0) / OPS * 1e6
+
+    return run_ranks(NRANKS, fn, timeout=300)[0]
+
+
+def run_probe() -> Dict:
+    from ompi_tpu.mca.params import registry
+
+    prior_ulfm = registry.get("mpi_ft_ulfm", "1")
+    prior_deg = registry.get("cr_buddy_degree", "0")
+    recs = []
+    off_times, on_times = [], []
+    try:
+        registry.set("mpi_ft_ulfm", "1")
+        for _ in range(REPS):
+            recs.append(_measure_mttr())
+        registry.set("cr_buddy_degree", "0")
+        for _ in range(REPS):
+            off_times.append(_measure_overhead(False))
+            on_times.append(_measure_overhead(True))
+    finally:
+        registry.set("mpi_ft_ulfm", prior_ulfm)
+        registry.set("cr_buddy_degree", prior_deg)
+    best = min(recs, key=lambda r: r["total_ms"])
+    off_us = min(off_times)
+    on_us = min(on_times)
+    overhead = (on_us - off_us) / off_us * 100.0
+    return {
+        "nranks": NRANKS,
+        "victim": VICTIM,
+        "reps": REPS,
+        "detect_ms": round(best["detect_ms"], 3),
+        "respawn_ms": round(best["respawn_ms"], 3),
+        "restore_ms": round(best["restore_ms"], 3),
+        "first_coll_ms": round(best["first_coll_ms"], 3),
+        "total_ms": round(best["total_ms"], 3),
+        "total_ms_all": [round(r["total_ms"], 3) for r in recs],
+        "ops_per_rep": OPS,
+        "payload_bytes": 32,
+        "off_us_per_op": round(off_us, 2),
+        "on_us_per_op": round(on_us, 2),
+        "off_us_all": [round(x, 2) for x in off_times],
+        "on_us_all": [round(x, 2) for x in on_times],
+        "overhead_pct": round(overhead, 2),
+        "budget_pct": BUDGET_PCT,
+        "within_budget": bool(overhead <= BUDGET_PCT),
+    }
+
+
+def persist(probe: Dict, detail_path: str) -> Dict:
+    """Merge under 'probe_respawn' in BENCH_DETAIL.json, preserving
+    every other section (the probe_dispatch/trace_overhead pattern)."""
+    notes: Dict = {}
+    try:
+        with open(detail_path) as fh:
+            detail = json.load(fh)
+        if not isinstance(detail, dict):
+            detail = {}
+    except (OSError, ValueError):
+        detail = {}
+    detail["probe_respawn"] = probe
+    try:
+        tmp = f"{detail_path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as fh:
+            json.dump(detail, fh, indent=1)
+        os.replace(tmp, detail_path)
+    except OSError as e:
+        notes["detail_error"] = str(e)[:120]
+    return notes

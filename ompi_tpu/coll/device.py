@@ -540,6 +540,22 @@ class CompiledLRU:
                 del self._d[k]
             return len(stale)
 
+    def drop_device(self, dev_id: int) -> int:
+        """Drop every executable whose mesh includes device ``dev_id``
+        (any top-level dev_key tuple containing it).  The respawn
+        rejoin calls this for each replaced rank's device: the
+        replacement re-binds the same world rank but possibly a
+        different physical device, and an executable compiled against
+        a mesh naming the old device must never be served against the
+        rebuilt one.  Returns how many entries were dropped."""
+        with self._lock:
+            stale = [k for k in self._d
+                     if any(isinstance(p, tuple) and dev_id in p
+                            for p in k)]
+            for k in stale:
+                del self._d[k]
+            return len(stale)
+
     def get(self, key: Tuple, builder: Callable[[], Callable]) -> Callable:
         with self._lock:
             fn = self._d.get(key)

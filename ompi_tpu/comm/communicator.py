@@ -35,6 +35,13 @@ UNDEFINED = -32766
 
 COMM_TYPE_SHARED = 1
 
+# respawn recovery epochs partition the cid space into disjoint bands
+# (epoch E allocates from [E*STRIDE, (E+1)*STRIDE)): a fragment or
+# cached plan addressed to a pre-failure cid can never alias a
+# communicator built after an in-job rank replacement.  Far above both
+# next_cid_local's dense counting and the ULFM store's 4096+ range.
+EPOCH_CID_STRIDE = 65536
+
 
 class Group:
     """Dense ordered set of global ranks (ref: ompi/group)."""
@@ -140,9 +147,16 @@ class Communicator:
 
     def next_cid(self) -> int:
         """Agree on a cid free on every member of *this* comm
-        (ref: ompi_comm_nextcid multi-round agreement)."""
+        (ref: ompi_comm_nextcid multi-round agreement).  After a
+        respawn recovery the proposal is floored into the current
+        epoch's cid band — see EPOCH_CID_STRIDE."""
+        floor = self.state.respawn_epoch * EPOCH_CID_STRIDE
         while True:
             proposal = self.state.next_cid_local()
+            if proposal < floor:
+                proposal = floor
+                while proposal in self.state.comms:
+                    proposal += 1
             agreed = self._allreduce_max_int(proposal, TAG_CID)
             ok = 1 if agreed not in self.state.comms else 0
             all_ok = self._allreduce_max_int(-ok, TAG_CID)  # max(-ok)=0 iff any not ok

@@ -66,6 +66,13 @@ _quiesce_timeout_var = _registry.register(
     help="Seconds the checkpoint quiesce may stall without counter "
          "progress before raising (bounds a hang on a lost peer)")
 
+_keep_var = _registry.register(
+    "cr", "", "keep", 0, int,
+    help="Job-wide default for checkpoint(..., keep=): prune the "
+         "store to the newest N complete snapshots after each commit "
+         "(0 = keep all).  mpirun --ckpt-keep exports it so long "
+         "chaos runs don't fill the disk")
+
 
 
 # ---------------------------------------------------------------------
@@ -273,9 +280,12 @@ def _store_for(root: Optional[str]) -> Store:
 
 
 def checkpoint(comm, payload: Any, store_dir: Optional[str] = None,
-               shmem_ctx=None, keep: int = 0) -> int:
+               shmem_ctx=None, keep: Optional[int] = None) -> int:
     """Collective snapshot; returns the sequence number.  ``keep``
-    prunes to the newest N complete snapshots (0 = keep all)."""
+    prunes to the newest N complete snapshots (0 = keep all; None =
+    the job-wide cr_keep MCA default)."""
+    if keep is None:
+        keep = int(_keep_var.value)
     store = _store_for(store_dir)
     quiesce(comm)
     # quiesce stays interruptible (a recovery signal there means the
@@ -333,7 +343,7 @@ def _vlayer(comm):
 
 def checkpoint_local(comm, payload: Any,
                      store_dir: Optional[str] = None,
-                     keep: int = 0) -> int:
+                     keep: Optional[int] = None) -> int:
     """UNCOORDINATED snapshot (vprotocol/pessimist): no quiesce, no
     collective, no drain — each rank snapshots at its own moment and
     writes its own sequence under ``local_r<rank>/``.  Messages
@@ -342,6 +352,8 @@ def checkpoint_local(comm, payload: Any,
     snapshotted sequence maps make redelivery exactly-once.  The
     only local contract: wait your own requests first (same as MPI
     C/R semantics)."""
+    if keep is None:
+        keep = int(_keep_var.value)
     store = _store_for(store_dir)
     v = _vlayer(comm)
     base = v._base

@@ -1,0 +1,230 @@
+"""Buddy checkpointing: in-memory partner-replicated snapshots.
+
+SCR-style multi-level C/R (Moody et al., "Design, Modeling, and
+Evaluation of a Scalable Multi-level Checkpointing System") applied to
+the TPU-host model: each rank's checkpoint blob — the same
+host-captured payload ``cr.checkpoint`` would write to the filesystem
+store — is pickled once and replicated over the wire to
+``cr_buddy_degree`` partner ranks, who hold it in process memory
+(``ProcState.extra["cr_buddy"]``).  Nothing touches a filesystem: the
+copies live exactly where a respawned replacement can reach them over
+MPI p2p, which is what makes kill -> respawn -> restore work without a
+shared store (ISSUE 5 acceptance: the replacement restores "without
+reading the filesystem checkpoint store").
+
+Placement is the classic ring: copy k of rank r lives on
+``(r + k) % size``.  A single failure between two checkpoints is
+always recoverable with degree >= 1; simultaneous loss of a rank AND
+all its partners is not (that is the filesystem store's job — the two
+layers compose, ``cr.checkpoint`` for cold durability, buddy for fast
+in-job recovery).
+
+Commit protocol (tolerates a rank dying mid-checkpoint): every rank
+stores its own blob AND its partners' blobs *before* the barrier;
+``committed`` advances only after.  At restore the target sequence is
+``max(committed)`` over the group — if any rank committed S, every
+rank reached the barrier for S, so every rank (including a dead one's
+partner) stored S first.  The last ``KEEP_SEQS`` sequences are
+retained so the pre-barrier window never discards the only restorable
+snapshot.
+
+API (collective over a full-world-size communicator, same contract as
+``cr.quiesce``):
+
+    buddy.checkpoint(comm, payload)   # -> seq, or -1 when degree == 0
+    payload = buddy.restore(comm)     # -> None when nothing committed
+
+Zero-cost-when-off: with ``cr_buddy_degree`` 0 (the default),
+``checkpoint`` returns after a single int check — no quiesce, no
+pickle, no traffic (the --probe-respawn budget check measures this).
+"""
+
+from __future__ import annotations
+
+import pickle
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ompi_tpu.cr import _decode, _encode, quiesce
+from ompi_tpu.mca.params import registry as _registry
+
+_degree_var = _registry.register(
+    "cr", "buddy", "degree", 0, int,
+    help="In-memory buddy-checkpoint replicas per rank (SCR-style "
+         "partner placement on (rank+k) %% size).  0 disables buddy "
+         "replication entirely; 1 survives any single rank failure "
+         "between checkpoints")
+
+_pv_ckpts = _registry.register_pvar(
+    "cr", "buddy", "checkpoints",
+    help="Buddy checkpoints committed by this rank")
+_pv_bytes = _registry.register_pvar(
+    "cr", "buddy", "bytes_replicated",
+    help="Checkpoint bytes this rank shipped to partner ranks")
+_pv_partner = _registry.register_pvar(
+    "cr", "buddy", "partner_restores",
+    help="Times this rank served a held partner copy to a restoring "
+         "(typically respawned) rank")
+_pv_us = _registry.register_pvar(
+    "cr", "buddy", "replicate_us", var_class="highwatermark",
+    help="Worst-case wall time of one buddy checkpoint (quiesce + "
+         "pickle + ring exchange + commit barrier), microseconds")
+
+# user tags must be >= 0; park buddy traffic far above anything an
+# application plausibly uses (one tag pair per ring distance k, one
+# pair for restore pulls)
+_TAG_BASE = 998_000_000
+_TAG_RESTORE = 998_500_000
+
+# self + held sequences retained.  2, not 1: a rank can die after
+# storing seq S but before committing it — survivors may then agree on
+# S-1, which a keep-1 policy would already have dropped.
+KEEP_SEQS = 2
+
+
+def _buddy_state(state) -> Dict[str, Any]:
+    """Per-rank replica store, private to this rank's ProcState (NOT
+    world-shared: partners hold copies the way a remote node's RAM
+    would, so a thread-world test exercises the same reachability a
+    process job has)."""
+    bs = state.extra.get("cr_buddy")
+    if bs is None:
+        bs = {
+            "self": {},       # seq -> my pickled blob
+            "held": {},       # (owner comm-rank, seq) -> their blob
+            "committed": -1,  # newest barrier-committed seq
+        }
+        state.extra["cr_buddy"] = bs
+    return bs
+
+
+def committed_seq(state) -> int:
+    """Newest committed sequence on this rank (-1 = none)."""
+    return _buddy_state(state)["committed"]
+
+
+def _prune(bs: Dict[str, Any], seq: int) -> None:
+    floor = seq - KEEP_SEQS  # keep (seq, seq-1, ...): KEEP_SEQS of them
+    for s in [s for s in bs["self"] if s <= floor]:
+        del bs["self"][s]
+    for k in [k for k in bs["held"] if k[1] <= floor]:
+        del bs["held"][k]
+
+
+def checkpoint(comm, payload: Any, degree: Optional[int] = None) -> int:
+    """Collective in-memory snapshot; returns the committed sequence
+    number, or -1 when buddy replication is off.  ``degree`` overrides
+    the ``cr_buddy_degree`` MCA default for this call."""
+    deg = int(_degree_var.value) if degree is None else int(degree)
+    if deg <= 0:
+        return -1  # zero-cost-when-off: one int check, nothing else
+    state = comm.state
+    if len(comm.group) != state.size:
+        raise ValueError(
+            "buddy.checkpoint must run on a full-world-size "
+            "communicator (partner placement is defined over the "
+            "whole job, like cr.quiesce)")
+    size = comm.size
+    deg = min(deg, size - 1)
+    if deg <= 0:
+        return -1
+    quiesce(comm)
+    # quiesce stays interruptible; the capture/replicate/commit phases
+    # must not be torn by an armed ft interrupt (same discipline as
+    # cr.checkpoint)
+    with state.progress.deferred_interrupts():
+        t0 = time.perf_counter()
+        bs = _buddy_state(state)
+        seq = bs["committed"] + 1
+        blob = pickle.dumps(
+            {"payload": _encode(payload), "rank": comm.rank, "seq": seq},
+            protocol=pickle.HIGHEST_PROTOCOL)
+        mine = np.frombuffer(blob, dtype=np.uint8)
+        nbytes = np.array([len(blob)], dtype=np.int64)
+        peer_n = np.zeros(1, dtype=np.int64)
+        for k in range(1, deg + 1):
+            dst = (comm.rank + k) % size
+            src = (comm.rank - k) % size
+            comm.Sendrecv(nbytes, dst, _TAG_BASE + 2 * k,
+                          peer_n, src, _TAG_BASE + 2 * k)
+            rbuf = np.empty(int(peer_n[0]), dtype=np.uint8)
+            comm.Sendrecv(mine, dst, _TAG_BASE + 2 * k + 1,
+                          rbuf, src, _TAG_BASE + 2 * k + 1)
+            bs["held"][(src, seq)] = rbuf.tobytes()
+            _pv_bytes.add(len(blob))
+        bs["self"][seq] = blob
+        _prune(bs, seq)
+        # every rank stored seq (own + held copies) before anyone
+        # commits: max(committed) at restore is therefore always a
+        # sequence every surviving partner still holds
+        comm.Barrier()
+        bs["committed"] = seq
+        _pv_ckpts.add(1)
+        _pv_us.update_max((time.perf_counter() - t0) * 1e6)
+    return seq
+
+
+def restore(comm) -> Optional[Any]:
+    """Collective restore from the newest committed buddy snapshot.
+    Ranks missing their own copy (a respawned replacement) pull it
+    from the lowest-distance surviving partner; every rank then rolls
+    back to the same sequence.  Returns the payload, or None when no
+    sequence has ever committed."""
+    state = comm.state
+    if len(comm.group) != state.size:
+        raise ValueError(
+            "buddy.restore must run on a full-world-size communicator")
+    size = comm.size
+    bs = _buddy_state(state)
+    me = np.array([max(bs["self"], default=-1), bs["committed"]],
+                  dtype=np.int64)
+    table = np.empty((size, 2), dtype=np.int64)
+    comm.Allgather(me, table)
+    restore_seq = int(table[:, 1].max())
+    if restore_seq < 0:
+        comm.Barrier()
+        return None
+    missing = {r for r in range(size) if table[r, 0] < restore_seq}
+    # who holds whose copy at restore_seq (the degree at checkpoint
+    # time is not assumed — a copy either survived or it didn't)
+    holds = np.zeros(size, dtype=np.uint8)
+    for r in range(size):
+        if r == comm.rank:
+            holds[r] = 1 if restore_seq in bs["self"] else 0
+        elif (r, restore_seq) in bs["held"]:
+            holds[r] = 1
+    htab = np.empty((size, size), dtype=np.uint8)
+    comm.Allgather(holds, htab)
+    for m in sorted(missing):
+        supplier = None
+        for k in range(1, size):
+            s = (m + k) % size
+            if s not in missing and htab[s][m]:
+                supplier = s
+                break
+        if supplier is None:
+            raise RuntimeError(
+                f"buddy restore: no surviving replica of rank {m}'s "
+                f"checkpoint seq {restore_seq} — every partner holding "
+                f"it is gone (raise cr_buddy_degree, or checkpoint "
+                f"again between failures)")
+        if comm.rank == supplier:
+            blob = bs["held"][(m, restore_seq)]
+            n = np.array([len(blob)], dtype=np.int64)
+            comm.Send(n, m, _TAG_RESTORE)
+            comm.Send(np.frombuffer(blob, dtype=np.uint8),
+                      m, _TAG_RESTORE + 1)
+            _pv_partner.add(1)
+        elif comm.rank == m:
+            n = np.zeros(1, dtype=np.int64)
+            comm.Recv(n, supplier, _TAG_RESTORE)
+            rbuf = np.empty(int(n[0]), dtype=np.uint8)
+            comm.Recv(rbuf, supplier, _TAG_RESTORE + 1)
+            bs["self"][restore_seq] = rbuf.tobytes()
+    obj = pickle.loads(bs["self"][restore_seq])
+    bs["committed"] = restore_seq
+    out = _decode(obj["payload"], state.device)
+    comm.Barrier()
+    return out

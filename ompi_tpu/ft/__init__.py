@@ -6,6 +6,11 @@ application as ``MPI_ERR_PROC_FAILED``, and mitigated in place with
 ``Comm.revoke()`` / ``Comm.agree()`` / ``Comm.shrink()`` so the job
 continues on the survivors (ref: ompi/communicator/ft and the
 MPIX_Comm_* surface of the ULFM prototype).
+
+``ft/respawn.py`` adds the third tier: instead of shrinking around a
+dead rank, mpirun (or the thread-world driver) launches a replacement
+that re-registers under the same world rank, restores its state from
+a buddy checkpoint (``cr/buddy.py``) and rejoins at full size.
 """
 
 from ompi_tpu.ft.ulfm import (  # noqa: F401
@@ -18,6 +23,9 @@ from ompi_tpu.ft.ulfm import (  # noqa: F401
     publish_failure,
     publish_revoke,
     publish_world_failure,
+    purge_store,
+    purge_tickets,
     shrink,
     start_watcher,
 )
+from ompi_tpu.ft import respawn  # noqa: F401

@@ -142,6 +142,17 @@ class UlfmState:
     def _ingest(self, rec: tuple) -> int:
         if rec[0] == "fail":
             grank = int(rec[1])
+            if grank == self.state.rank:
+                # a respawned replacement replays the KV note stream
+                # and meets its predecessor's death note: its own rank
+                # is alive by construction
+                return 0
+            if (len(rec) > 2 and int(rec[2]) <=
+                    getattr(self.state, "respawn_epoch", 0)):
+                # epoch-tagged note from a failure the respawn
+                # protocol already recovered: ingesting it would
+                # re-mark a revived rank dead forever
+                return 0
             if grank in self.failed:
                 return 0
             self.failed.add(grank)
@@ -166,6 +177,26 @@ class UlfmState:
             return 0
         self._sweep_pml()
         return 1
+
+    def unfail(self, grank: int) -> None:
+        """Respawn rejoin (ft/respawn): ``grank`` has been replaced in
+        place — stop treating it as dead.  The delivery dedup for its
+        old failure records is cleared too, so a LATER kill of the same
+        world rank is detected again (``active`` stays True: the
+        entry-check cost is already paid and a re-kill must drain
+        instantly)."""
+        with self.lock:
+            self.failed.discard(grank)
+            self.acked.discard(grank)
+            self._seen = {
+                r for r in self._seen
+                if not (r[0] == "fail" and int(r[1]) == grank)}
+            self._pending = [
+                r for r in self._pending
+                if not (r[0] == "fail" and int(r[1]) == grank)]
+        rte = self.state.rte
+        if getattr(rte, "kv", None) is not None:
+            rte.ulfm_failed = set(self.failed)
 
     def _sweep_pml(self) -> None:
         # reaches PmlOb1 through any monitoring/vprotocol wrapper
@@ -326,7 +357,13 @@ def start_watcher(state) -> None:
             if u is None or getattr(state, "finalized", False):
                 return
             if rec and rec[0] == "fail":
-                u.deliver(("fail", int(rec[1])))
+                # respawn-mode notes carry the recovery epoch the
+                # failure opens; _ingest drops stale epochs so note
+                # replay after a rejoin cannot re-kill a revived rank
+                if len(rec) > 2:
+                    u.deliver(("fail", int(rec[1]), int(rec[2])))
+                else:
+                    u.deliver(("fail", int(rec[1])))
             elif rec and rec[0] == "revoke":
                 u.deliver(("revoke", int(rec[1]), tuple(rec[2])))
 
@@ -531,6 +568,56 @@ def _invalidate(comm) -> None:
         with world.shared_lock:
             world.shared.pop(
                 ("coll_rv", comm.cid, tuple(comm.group)), None)
+
+
+# -- store hygiene ----------------------------------------------------------
+
+# first elements of world.shared tuple keys owned by the ULFM/respawn
+# control plane (the KV spellings all live under the "ulfm:" prefix)
+_STORE_KEY_HEADS = ("agree", "shrink", "respawn", "ulfm")
+
+
+def purge_tickets(state) -> None:
+    """Epoch-rollover hygiene: drop consumed agreement/shrink tickets
+    (contributions, decisions, and their put-once claim counters).
+    Failure notes are deliberately kept — a late-starting watcher
+    replays the note stream from n=0 and relies on the epoch filter,
+    not on deletion, to skip recovered failures."""
+    world = getattr(state.rte, "world", None)
+    if world is not None and hasattr(world, "shared"):
+        with world.shared_lock:
+            for k in [k for k in world.shared
+                      if isinstance(k, tuple) and k
+                      and k[0] in ("agree", "shrink")]:
+                del world.shared[k]
+    kv = getattr(state.rte, "kv", None)
+    if kv is not None:
+        try:
+            kv.purge("ulfm:agree:")
+            kv.purge("ulfm:shrink:")
+        except (ConnectionError, OSError, RuntimeError):
+            pass
+
+
+def purge_store(state) -> None:
+    """Finalize hygiene (stale-note satellite): remove every ULFM
+    record this job wrote — failure notes, the note sequence counter,
+    agreement/shrink/respawn tickets and their claim counters — so a
+    looped world (pytest re-entry, warm launcher pools) starts with a
+    clean failure plane instead of replaying last run's deaths."""
+    world = getattr(state.rte, "world", None)
+    if world is not None and hasattr(world, "shared"):
+        with world.shared_lock:
+            for k in [k for k in world.shared
+                      if isinstance(k, tuple) and k
+                      and k[0] in _STORE_KEY_HEADS]:
+                del world.shared[k]
+    kv = getattr(state.rte, "kv", None)
+    if kv is not None:
+        try:
+            kv.purge("ulfm:")
+        except (ConnectionError, OSError, RuntimeError):
+            pass
 
 
 def shrink(comm, name: str = ""):

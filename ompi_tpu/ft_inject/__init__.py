@@ -65,9 +65,12 @@ _victim_var = registry.register(
     "ft", "inject", "victim_node", 1, int,
     help="Node id that hosts the daemon_kill/oob_sever scenarios")
 _victim_rank_var = registry.register(
-    "ft", "inject", "victim_rank", 1, int,
-    help="Global rank killed by the rank_kill scenario (permanent "
-         "death: the ULFM detect/revoke/shrink/agree test target)")
+    "ft", "inject", "victim_rank", "1", str,
+    help="Global rank(s) killed by the rank_kill scenario (permanent "
+         "death: the ULFM detect/revoke/shrink/agree test target).  A "
+         "single rank, a comma list ('1,3'), or 'random' for a "
+         "seed-deterministic pick — chaos runs sweep victims without "
+         "editing the plan")
 _delay_ms_var = registry.register(
     "ft", "inject", "delay_ms", 20, int,
     help="How long a 'delay'-class frame is held before hitting the "
@@ -196,18 +199,47 @@ def node_faults(node_id: int) -> List[str]:
     return [c for c in NODE_CLASSES if c in p]
 
 
-def rank_faults(rank: int) -> List[str]:
+def victim_ranks(size: Optional[int] = None) -> List[int]:
+    """Parse ft_inject_victim_rank into the concrete victim list.
+
+    Accepts a single rank, a comma list, or ``random`` (one victim,
+    chosen seed-deterministically so a chaos run replays from its
+    seed).  ``random`` needs the world size — pass it, or export
+    TPUMPI_SIZE; without either the random pick degrades to rank 1.
+    """
+    s = str(_victim_rank_var.value).strip()
+    if not s:
+        return []
+    if s.lower() == "random":
+        if size is None:
+            import os
+            size = int(os.environ.get("TPUMPI_SIZE", "0")) or None
+        if not size:
+            return [1]
+        rng = random.Random(f"{_seed_var.value}:victim_rank")
+        return [rng.randrange(size)]
+    out: List[int] = []
+    for item in s.split(","):
+        item = item.strip()
+        if item:
+            out.append(int(item))
+    return out
+
+
+def rank_faults(rank: int, size: Optional[int] = None) -> List[str]:
     """Permanent rank-level scenario classes armed on THIS global
     rank (mpi_init consults this once and arms a one-shot timer;
     tpud consults it to kill the victim's child process for real)."""
-    if rank != _victim_rank_var.value:
+    if rank not in victim_ranks(size):
         return []
     p = plan()
     return [c for c in RANK_CLASSES if c in p]
 
 
 def rank_kill_victim() -> int:
-    return _victim_rank_var.value
+    """First armed victim (compat shim for single-victim callers)."""
+    v = victim_ranks()
+    return v[0] if v else -1
 
 
 def after_s() -> float:

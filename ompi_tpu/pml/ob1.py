@@ -855,6 +855,37 @@ class PmlOb1:
         self.cr_sent.clear()
         self.cr_arrived.clear()
 
+    def ft_reset_peer(self, granks, comms) -> None:
+        """Respawn rejoin (ft/respawn): a replaced rank restarts its
+        pml at zero, so BOTH directions of every channel naming it
+        must forget their sequence state — the survivor's next send
+        to it carries seq 0 again, and seq 0 from it matches instead
+        of parking in _cant_match behind the dead predecessor's
+        counters.  Narrower than ft_reset: survivor<->survivor
+        channels keep their live sequences (thread worlds never
+        reset those — there is no transport flush to cover them)."""
+        granks = set(granks)
+        for comm in comms.values():
+            if comm is None:
+                continue
+            group = list(comm.group)
+            for r, g in enumerate(group):
+                if g not in granks:
+                    continue
+                self._send_seq.pop((comm.cid, r), None)
+                self._next_seq.pop((comm.cid, r), None)
+                self._cant_match.pop((comm.cid, r), None)
+                pend = self._unexpected.get(comm.cid)
+                if pend:
+                    self._unexpected[comm.cid] = [
+                        m for m in pend if m.src != r]
+                for key in [k for k in self._mseg
+                            if k[0] == comm.cid and k[1] == r]:
+                    del self._mseg[key]
+        for g in granks:
+            self.cr_sent.pop(g, None)
+            self.cr_arrived.pop(g, None)
+
     # -- ULFM drain (ompi_tpu/ft/ulfm) ------------------------------------
     def ulfm_sweep(self, failed, revoked) -> int:
         """Complete every parked request naming a failed peer or a

@@ -93,6 +93,17 @@ def mpi_init(state: ProcState, device=None) -> ProcState:
     # only — the shm rings of a pre-failure epoch cannot be made
     # stale-byte-safe, so shm stays out of an epoch>0 world
     state.ft_epoch = int(os.environ.get("TPUMPI_FT_EPOCH", "0"))
+    # self-healing respawn (ft/respawn): a replacement PROCESS carries
+    # TPUMPI_RESPAWN=1 and the epoch its failure opened — it must run
+    # the rejoin protocol before doing real work, and it must never
+    # re-arm the fault that killed its predecessor.  Thread-world
+    # replacements get these attrs set by the driver before mpi_init
+    # (threads share the environment, so the env flag is a
+    # process-rank signal only).
+    if (not state.respawn_joining and os.environ.get("TPUMPI_RESPAWN")
+            and getattr(state.rte, "kv", None) is not None):
+        state.respawn_joining = True
+        state.respawn_epoch = max(0, state.ft_epoch - 1)
     # 2. btl modules + endpoint wiring (modex happens inside init).
     # At a recovery epoch the shm COMPONENT is skipped outright — a
     # constructed-then-dropped module would have created rings,
@@ -166,7 +177,10 @@ def mpi_init(state: ProcState, device=None) -> ProcState:
         # one-shot death timer (fires as a RankKilled interrupt out
         # of the next progress sweep)
         from ompi_tpu import ft_inject as _fi
-        if "rank_kill" in _fi.rank_faults(state.rank):
+        if ("rank_kill" in _fi.rank_faults(state.rank, state.size)
+                and not state.respawn_joining):
+            # a respawned replacement never re-arms its predecessor's
+            # death — that would be an infinite kill/respawn loop
             _ulfm.arm_rank_kill(state, _fi.after_s())
         if os.environ.get("TPUMPI_ULFM"):
             # launcher runs the ulfm errmgr policy: consume job-wide
@@ -216,6 +230,15 @@ def mpi_finalize(state: ProcState) -> None:
     # barrier, then teardown in reverse (ref: ompi_mpi_finalize.c:101)
     state.rte.fence()
     _pml_monitoring.finalize_aggregate(state)
+    if state.ulfm is not None:
+        # store hygiene: drop this job's ULFM notes and put-once
+        # tickets so looped worlds (pytest re-entry, warm pools) never
+        # replay a finished run's failure records.  After the fence —
+        # every rank is in finalize, nobody consumes notes anymore —
+        # and before rte.finalize closes the KV client.  Idempotent,
+        # so every rank calling it is fine.
+        from ompi_tpu.ft import ulfm as _fin_ulfm
+        _fin_ulfm.purge_store(state)
     for m in state.btls:
         m.finalize()
     state.rte.finalize()

@@ -238,6 +238,28 @@ class KVServer:
                         if ok:
                             self.counters[msg["key"]] = msg["expect"]
                     _send_msg(conn, {"ok": ok})
+                elif op == "purge":
+                    # prefix delete over data AND counters (including
+                    # the put_once claim tickets, which live in the
+                    # counter namespace as "claim:<key>"): store
+                    # hygiene for ULFM notes/tickets at finalize and
+                    # respawn epoch rollover
+                    pfx = msg["prefix"]
+                    with self.cv:
+                        nd = 0
+                        for k in [k for k in self.data
+                                  if isinstance(k, str)
+                                  and k.startswith(pfx)]:
+                            del self.data[k]
+                            nd += 1
+                        for k in [k for k in self.counters
+                                  if isinstance(k, str)
+                                  and (k.startswith(pfx) or
+                                       k.startswith("claim:" + pfx))]:
+                            del self.counters[k]
+                            nd += 1
+                        self.cv.notify_all()
+                    _send_msg(conn, {"ok": True, "n": nd})
                 elif op == "take":
                     # blocking get that atomically deletes the record:
                     # one-shot rendezvous consumption (dpm accept/connect)
@@ -462,6 +484,14 @@ class KVClient:
             self.put(key, value)
             return True
         return False
+
+    def purge(self, prefix: str) -> int:
+        """Delete every data key and counter (including put_once claim
+        tickets) under ``prefix``; returns the number removed.
+        Idempotent by construction — deleting twice deletes nothing."""
+        resp = self._request({"op": "purge", "prefix": prefix},
+                             idempotent=True)
+        return int(resp.get("n", 0))
 
     def uncr(self, key: str, expect: int) -> bool:
         """Roll back a ticket taken with incr() (which returned
@@ -695,6 +725,15 @@ class KVProxy:
                 elif op == "uncr":
                     _send_msg(conn, {"ok": self.up.uncr(
                         msg["key"], msg["expect"])})
+                elif op == "purge":
+                    pfx = msg["prefix"]
+                    with self._lock:
+                        for k in [k for k in self._cache
+                                  if k.startswith(pfx)]:
+                            del self._cache[k]
+                    _send_msg(conn,
+                              {"ok": True,
+                               "n": self.up.purge(pfx)})
                 elif op == "abort":
                     try:
                         self.up.abort(msg["rank"], msg["code"],

@@ -36,6 +36,11 @@ class ProcState:
         self.ulfm: Any = None
         self.finalized = False
         self.initialized = False
+        # self-healing respawn (ompi_tpu/ft/respawn): epoch counts
+        # completed in-job rank replacements; joining marks a
+        # replacement rank between its re-init and its first rejoin
+        self.respawn_epoch = 0
+        self.respawn_joining = False
         self.extra: Dict[str, Any] = {}
 
     def next_cid_local(self) -> int:

@@ -10,6 +10,7 @@ the reference tests mapping logic without a cluster via ras/simulator
 from __future__ import annotations
 
 import threading
+import time
 import traceback
 from typing import Any, Callable, List, Optional
 
@@ -27,7 +28,8 @@ class RankError(RuntimeError):
 
 def run_ranks(n: int, fn: Callable, devices: bool = False,
               timeout: float = 120.0, device_map=None,
-              allow_failures: bool = False) -> List[Any]:
+              allow_failures: bool = False,
+              respawn: bool = False) -> List[Any]:
     """Run fn(comm_world) on n thread-ranks; returns per-rank results.
 
     devices=True maps rank i to jax.devices()[i % ndev] so coll/tpu
@@ -39,6 +41,17 @@ def run_ranks(n: int, fn: Callable, devices: bool = False,
     the scenario, not an error: its failure is published ULFM-style
     (survivors get ERR_PROC_FAILED and may revoke/agree/shrink), its
     result slot stays None, and only survivor errors raise.
+
+    respawn=True is the thread-world analog of mpirun's respawn
+    policy (ft/respawn): a RankKilled death is published like
+    allow_failures, then this driver waits for the survivors' rejoin
+    decision (respawn.thread_decision) and starts a REPLACEMENT
+    thread under the same world rank — fresh ProcState flagged
+    respawn_joining at the failure's epoch.  fn runs again on the
+    replacement (applications branch on respawn.joining(state) to
+    rejoin + restore instead of starting over) and its return value
+    fills the rank's result slot.  Failures are handled one rejoin at
+    a time, matching mpirun's sequential-epoch contract.
     """
     world = InprocWorld(n)
     results: List[Any] = [None] * n
@@ -47,11 +60,21 @@ def run_ranks(n: int, fn: Callable, devices: bool = False,
     if devices or device_map is not None:
         import jax
         devs = jax.devices()
+    respawn_cv = threading.Condition()
+    respawn_q: List[int] = []  # killed ranks awaiting replacement
 
-    def runner(rank: int) -> None:
+    def runner(rank: int, joining_epoch: Optional[int] = None) -> None:
         try:
             rte = world.make_rte(rank)
             state = ProcState(rank, n, rte)
+            if joining_epoch is not None:
+                # replacement rank: mpi_init must not re-arm the fault
+                # that killed the predecessor, and the app must see
+                # respawn.joining(state) truthy (threads share the
+                # environment, so the TPUMPI_RESPAWN env signal used
+                # by process jobs cannot work here)
+                state.respawn_joining = True
+                state.respawn_epoch = joining_epoch - 1
             world.states[rank] = state
             if device_map is not None:
                 dev = device_map(rank)
@@ -72,12 +95,16 @@ def run_ranks(n: int, fn: Callable, devices: bool = False,
             # against peers that died before reaching it
             mpi_finalize(state)
         except BaseException as e:  # noqa: BLE001
-            if allow_failures:
+            if allow_failures or respawn:
                 from ompi_tpu.ft import ulfm as _ulfm
                 if isinstance(e, _ulfm.RankKilled):
                     # the injected death IS the test scenario: the
                     # rank is gone, survivors mitigate via ULFM
                     _ulfm.publish_world_failure(world, rank)
+                    if respawn:
+                        with respawn_cv:
+                            respawn_q.append(rank)
+                            respawn_cv.notify_all()
                     return
             errors[rank] = RankError(rank, e, traceback.format_exc())
             if world.aborted is None:
@@ -90,12 +117,48 @@ def run_ranks(n: int, fn: Callable, devices: bool = False,
                 if st is not None:
                     st.progress.wakeup()
 
-    threads = [threading.Thread(target=runner, args=(r,), daemon=True,
-                                name=f"mpi-rank-{r}")
-               for r in range(n)]
-    for t in threads:
+    def _spawn(rank: int,
+               joining_epoch: Optional[int] = None) -> threading.Thread:
+        t = threading.Thread(
+            target=runner, args=(rank, joining_epoch), daemon=True,
+            name=f"mpi-rank-{rank}" if joining_epoch is None
+            else f"mpi-rank-{rank}-e{joining_epoch}")
         t.start()
-    for t in threads:
+        return t
+
+    live = {r: _spawn(r) for r in range(n)}
+
+    if respawn:
+        # supervision loop (the inproc analog of mpirun's respawn
+        # branch): reap kills, wait out each epoch's rejoin decision,
+        # start the replacement, until every rank thread has finished
+        from ompi_tpu.ft import respawn as _respawn
+        deadline = time.monotonic() + timeout
+        epoch = 0
+        while True:
+            alive = any(t.is_alive() for t in live.values())
+            with respawn_cv:
+                pending, respawn_q[:] = list(respawn_q), []
+            for rank in pending:
+                epoch += 1
+                _respawn.thread_decision(
+                    world, epoch,
+                    timeout=max(1.0, deadline - time.monotonic()))
+                live[rank] = _spawn(rank, joining_epoch=epoch)
+            if not alive and not pending:
+                break
+            if world.aborted is not None and not pending:
+                # a real error (not a kill): let the join path below
+                # surface it instead of spinning to the deadline
+                break
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"respawn world did not finish within {timeout}s "
+                    f"(epoch {epoch}); errors so far: "
+                    f"{[e for e in errors if e]}")
+            time.sleep(0.002)
+
+    for t in live.values():
         t.join(timeout)
         if t.is_alive():
             raise TimeoutError(

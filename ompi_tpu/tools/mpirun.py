@@ -64,11 +64,16 @@ _errmgr_policy_var = _params.register(
          "(forward recovery, ompi_tpu/ft/ulfm: a dead rank becomes a "
          "job-wide failure record; survivors get ERR_PROC_FAILED and "
          "continue via Comm.revoke/agree/shrink — no restart, no "
-         "rollback)")
+         "rollback), or 'respawn' (self-healing, ompi_tpu/ft/respawn: "
+         "the dead rank is relaunched IN-JOB under its original world "
+         "rank at a bumped recovery epoch; survivors and the "
+         "replacement run the rejoin protocol and restore from buddy "
+         "checkpoints — the job finishes at full size)")
 _errmgr_max_restarts_var = _params.register(
     "errmgr", "base", "max_restarts", 2, int,
     help="Automatic relaunch attempts before giving up (restart "
-         "policy only)")
+         "policy: whole-job relaunches; respawn policy: per-rank "
+         "replacements)")
 
 
 def _forward(stream, out, tag: str, tag_output: bool) -> None:
@@ -91,15 +96,22 @@ def _pkg_root() -> str:
         _pkg.__file__)))
 
 
-def _ulfm_publish_failed(server: KVServer, ranks) -> None:
+def _ulfm_publish_failed(server: KVServer, ranks,
+                         epoch: Optional[int] = None) -> None:
     """Append job-wide ULFM failure records (``ulfm:note:<n>``) for
     dead ranks; every surviving rank's ulfm watcher consumes them in
     order.  Written under the server lock so getters blocked on the
-    next note wake through the server's condition variable."""
+    next note wake through the server's condition variable.  The
+    respawn policy passes ``epoch`` — the recovery epoch this failure
+    opens — so the note stream stays replayable: a late watcher (or a
+    respawned rank's own) filters recovered deaths by epoch instead of
+    re-killing a revived rank (ft/ulfm._ingest)."""
     with server.cv:
         n = server.counters.get("ulfm:nseq", 0)
         for r in ranks:
-            server.data[f"ulfm:note:{n}"] = ["fail", int(r)]
+            rec = ["fail", int(r)] if epoch is None \
+                else ["fail", int(r), int(epoch)]
+            server.data[f"ulfm:note:{n}"] = rec
             n += 1
         server.counters["ulfm:nseq"] = n
         server.cv.notify_all()
@@ -292,8 +304,9 @@ def run_multinode(opts, nodes, rpp: int, hybrid: bool) -> int:
         if _errmgr_policy_var.value == "recover" and opts.ckpt_dir:
             # ranks start the ft epoch watcher (runtime/ft.py)
             job_env["TPUMPI_FT_RECOVER"] = "1"
-        if _errmgr_policy_var.value == "ulfm":
-            # ranks start the ulfm note watcher (ompi_tpu/ft/ulfm)
+        if _errmgr_policy_var.value in ("ulfm", "respawn"):
+            # ranks start the ulfm note watcher (ompi_tpu/ft/ulfm);
+            # respawn rides the same detection plane
             job_env["TPUMPI_ULFM"] = "1"
         if hybrid:
             job_env["TPUMPI_DEVICES"] = opts.devices
@@ -456,9 +469,9 @@ def run_multinode(opts, nodes, rpp: int, hybrid: bool) -> int:
             return
         d["launched_prog"] = prog
         if opts.preload and os.path.isfile(prog) \
-                and _errmgr_policy_var.value == "recover":
-            # only the recover policy ever relaunches from d; the
-            # normal path lets HNP.launch do its own encode
+                and _errmgr_policy_var.value in ("recover", "respawn"):
+            # only the recover/respawn policies ever relaunch from d;
+            # the normal path lets HNP.launch do its own encode
             import base64 as _b64
             with open(prog, "rb") as _fh:
                 d["prog_data"] = _b64.b64encode(
@@ -467,8 +480,69 @@ def run_multinode(opts, nodes, rpp: int, hybrid: bool) -> int:
                         preload=opts.preload)
         sm.activate(smx.RUNNING)
 
+    def try_respawn_remote(info) -> bool:
+        """Respawn policy on the PLM path: relaunch the dead launch
+        unit on ITS OWN node (the daemon survived — only the rank
+        process died; daemon loss still falls through to the recover/
+        ulfm/abort ladder in ev_daemon_lost)."""
+        tag = info.get("tag", "")
+        ranks = _tag_ranks(tag)
+        if not ranks:
+            return False
+        node = None
+        unit = None
+        for m in d["maps"]:
+            for p in m.procs:
+                lo = p.rank_base
+                hi = lo + max(1, p.nlocal)
+                if lo <= ranks[0] < hi:
+                    node, unit = m.node.node_id, p
+                    break
+            if unit is not None:
+                break
+        if unit is None:
+            return False
+        tries = d.setdefault("respawns", {}).get(tag, 0)
+        max_r = int(_errmgr_max_restarts_var.value)
+        if tries >= max_r:
+            sys.stderr.write(
+                f"mpirun: {info['tag']} died again but reached "
+                f"errmgr_base_max_restarts={max_r}; giving up\n")
+            return False
+        d["respawns"][tag] = tries + 1
+        epoch = d["ft_epoch"] = d.get("ft_epoch", 0) + 1
+        # note first, replacement second (same ordering argument as
+        # the local path): survivors must see the death before the
+        # newcomer's init fences can find partners
+        _ulfm_publish_failed(d["server"], ranks, epoch)
+        env = dict(d["job_env"])
+        env["TPUMPI_FT_EPOCH"] = str(epoch)
+        env["TPUMPI_RESPAWN"] = "1"
+        try:
+            d["hnp"].send_launch(node, {
+                "op": "launch", "prog": d["launched_prog"],
+                "args": opts.args, "prog_data": d.get("prog_data"),
+                "wdir": opts.wdir, "env": env,
+                "procs": [{"rank_base": unit.rank_base,
+                           "nlocal": unit.nlocal}],
+            })
+        except (KeyError, ConnectionError, OSError) as e:
+            sys.stderr.write(
+                f"mpirun: respawn policy: relaunch of {tag} on node "
+                f"{node} failed ({e}); tearing down\n")
+            return False
+        sys.stderr.write(
+            f"mpirun: {info['tag']} exited with status "
+            f"{info['code']}; respawn policy: relaunching on node "
+            f"{node} at epoch {epoch} (attempt {tries + 1}/{max_r})\n")
+        return True
+
     def ev_proc_exit(sm, info):  # only abnormal exits are posted
         if d.get("drained"):
+            return
+        if sm.state == smx.RUNNING \
+                and _errmgr_policy_var.value == "respawn" \
+                and try_respawn_remote(info):
             return
         if sm.state == smx.RUNNING \
                 and _errmgr_policy_var.value == "ulfm":
@@ -559,8 +633,9 @@ def run_local(opts, rpp: int, hybrid: bool, ckpt_env: dict) -> int:
     })
     for key, value in opts.mca:
         env_base[f"TPUMPI_MCA_{key}"] = value
-    if _errmgr_policy_var.value == "ulfm":
-        # ranks start the ulfm note watcher (ompi_tpu/ft/ulfm)
+    if _errmgr_policy_var.value in ("ulfm", "respawn"):
+        # ranks start the ulfm note watcher (ompi_tpu/ft/ulfm);
+        # respawn rides the same detection plane
         env_base["TPUMPI_ULFM"] = "1"
 
     def _write_proctable() -> None:
@@ -590,6 +665,10 @@ def run_local(opts, rpp: int, hybrid: bool, ckpt_env: dict) -> int:
             procs.append(p)
             ptags.append(tag)
             d["outstanding"] += 1
+            # launch record per tag: the respawn policy relaunches the
+            # exact unit that died (same cmd, env rebuilt per epoch)
+            d.setdefault("launch_recs", {})[tag] = (list(cmd),
+                                                    dict(env))
         for stream, out in ((p.stdout, sys.stdout.buffer),
                             (p.stderr, sys.stderr.buffer)):
             t = threading.Thread(
@@ -681,6 +760,46 @@ def run_local(opts, rpp: int, hybrid: bool, ckpt_env: dict) -> int:
                 spawn_proc(cmd0, env, f"s{base + i}")
         _write_proctable()
 
+    def try_respawn(info) -> bool:
+        """errmgr respawn policy (ompi_tpu/ft/respawn): relaunch the
+        dead unit IN-JOB under its original world rank(s).  The
+        failure is published as an epoch-tagged ULFM note — survivors
+        detect, run the rejoin protocol and meet the replacement's
+        init fences at the bumped epoch; buddy checkpoints restore its
+        state.  One failure event = one epoch (failures are handled
+        one rejoin at a time — see ft/respawn.py)."""
+        tag = info.get("tag", "")
+        ranks = _tag_ranks(tag)
+        with lock:
+            rec = d.get("launch_recs", {}).get(tag)
+        if not ranks or rec is None:
+            return False
+        tries = d.setdefault("respawns", {}).get(tag, 0)
+        max_r = int(_errmgr_max_restarts_var.value)
+        if tries >= max_r:
+            sys.stderr.write(
+                f"mpirun: {info['who']} died again but reached "
+                f"errmgr_base_max_restarts={max_r}; giving up\n")
+            return False
+        d["respawns"][tag] = tries + 1
+        epoch = d["ft_epoch"] = d.get("ft_epoch", 0) + 1
+        # note first, replacement second: survivors must observe the
+        # death (and start rejoining) before the newcomer can exist;
+        # its init fences park on the epoch-scoped KV keys until the
+        # survivors' rejoin fences arrive
+        _ulfm_publish_failed(server, ranks, epoch)
+        cmd, env = list(rec[0]), dict(rec[1])
+        env["TPUMPI_FT_EPOCH"] = str(epoch)
+        env["TPUMPI_RESPAWN"] = "1"
+        sys.stderr.write(
+            f"mpirun: {info['who']} exited with status "
+            f"{info['code']}; respawn policy: relaunching under the "
+            f"same rank(s) at epoch {epoch} "
+            f"(attempt {tries + 1}/{max_r})\n")
+        spawn_proc(cmd, env, tag)
+        _write_proctable()
+        return True
+
     def ev_proc_exit(sm, info):
         with lock:
             d["outstanding"] -= 1
@@ -689,6 +808,10 @@ def run_local(opts, rpp: int, hybrid: bool, ckpt_env: dict) -> int:
                                             smx.TERMINATED):
             return
         if info["code"] != 0:
+            if sm.state == smx.RUNNING \
+                    and _errmgr_policy_var.value == "respawn" \
+                    and try_respawn(info):
+                return
             if sm.state == smx.RUNNING \
                     and _errmgr_policy_var.value == "ulfm":
                 ranks = _tag_ranks(info.get("tag", ""))
@@ -821,6 +944,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="Checkpoint store root exported to ranks as "
                          "TPUMPI_CKPT_DIR; mpirun records job.json "
                          "there for ompi_tpu.tools.restart")
+    ap.add_argument("--ckpt-keep", type=int, default=None,
+                    dest="ckpt_keep", metavar="N",
+                    help="Prune the checkpoint store to the newest N "
+                         "complete snapshots (exports the cr_keep MCA "
+                         "default job-wide; 0/default keeps all)")
     ap.add_argument("--restart", default=None, metavar="DIR",
                     help="Restart from the latest complete snapshot "
                          "in DIR (sets TPUMPI_RESTART; the app picks "
@@ -864,6 +992,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     # checkpoint/restart store plumbing (cr stack; orte-checkpoint /
     # orte-restart tool analogs live in ompi_tpu.tools.restart)
     ckpt_env = {}
+    if opts.ckpt_keep is not None:
+        # job-wide cr_keep default (cr.checkpoint prunes after each
+        # commit); an explicit keep= argument in the app still wins
+        ckpt_env["TPUMPI_MCA_cr_keep"] = str(opts.ckpt_keep)
     ckpt_root = opts.restart or opts.ckpt_dir
     if ckpt_root:
         ckpt_root = os.path.abspath(ckpt_root)

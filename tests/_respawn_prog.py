@@ -1,0 +1,53 @@
+"""Respawn chaos program (run via mpirun by test_respawn.py): one rank
+is killed mid-loop by ft_inject ``rank_kill``; under the ``respawn``
+errmgr policy mpirun relaunches it under the SAME world rank at a
+bumped recovery epoch, survivors + the replacement run the rejoin
+protocol (ft/respawn) and everyone rolls back to the newest buddy
+checkpoint (cr/buddy) — the job finishes at FULL size with results
+byte-identical to a fault-free run, and the replacement's state comes
+from a partner rank's memory, never the filesystem store."""
+import time
+
+import numpy as np
+
+import ompi_tpu
+from ompi_tpu.cr import buddy
+from ompi_tpu.errhandler import MPIException
+from ompi_tpu.ft import respawn
+from ompi_tpu.op import op as mpi_op
+
+ITERS = 40
+
+
+def _load(st):
+    if st is None:  # died before the first commit: start over
+        return 0, np.zeros(8)
+    return int(st["i"]), np.asarray(st["acc"])
+
+
+comm = ompi_tpu.init()
+was_joining = respawn.joining(comm.state)
+if was_joining:
+    comm = respawn.rejoin(comm)
+    i, acc = _load(buddy.restore(comm))
+else:
+    i, acc = 0, np.zeros(8)
+rejoins = 0
+while i < ITERS:
+    try:
+        buddy.checkpoint(comm, {"i": i, "acc": acc})
+        x = np.full(8, (comm.rank + 1.0) * (i + 1))
+        r = np.empty_like(x)
+        comm.Allreduce(x, r, mpi_op.SUM)
+        acc = acc + r
+        i += 1
+        time.sleep(0.05)
+    except MPIException as e:
+        assert e.code in (75, 76, 77), e.code
+        comm = respawn.rejoin(comm)
+        i, acc = _load(buddy.restore(comm))
+        rejoins += 1
+print(f"rank={comm.rank} size={comm.size} joined={int(was_joining)} "
+      f"rejoins={rejoins} digest={acc.tobytes().hex()[:24]}",
+      flush=True)
+ompi_tpu.finalize()

@@ -1,0 +1,339 @@
+"""Self-healing respawn tests (ft/respawn + cr/buddy): a killed rank
+is replaced IN-JOB under its original world rank, restores from a
+partner's in-memory buddy checkpoint, and the job finishes at full
+size byte-identical to a fault-free run."""
+
+import os
+
+import numpy as np
+import pytest
+
+from ompi_tpu import errhandler as eh
+from ompi_tpu import ft_inject
+from ompi_tpu.cr import buddy
+from ompi_tpu.errhandler import MPIException
+from ompi_tpu.ft import respawn, ulfm
+from ompi_tpu.mca.params import registry
+from ompi_tpu.op import op as mpi_op
+from ompi_tpu.testing import mpirun_run, run_ranks
+
+FT_CODES = (eh.ERR_PROC_FAILED, eh.ERR_PROC_FAILED_PENDING,
+            eh.ERR_REVOKED)
+
+
+@pytest.fixture
+def buddy_degree_1():
+    registry.set("cr_buddy_degree", "1")
+    yield
+    registry.set("cr_buddy_degree", "0")
+
+
+def _step(i, acc, comm):
+    x = np.full(4, (comm.rank + 1.0) * (i + 1))
+    r = np.empty_like(x)
+    comm.Allreduce(x, r, mpi_op.SUM)
+    return acc + r
+
+
+def _make_fn(iters=8, kill_at=None):
+    """App loop with per-iteration buddy checkpoints.  ``kill_at``
+    maps rank -> iteration at which the ORIGINAL incarnation dies
+    (replacements never re-kill; distinct iterations keep failures
+    sequential, the respawn contract)."""
+    kill_at = kill_at or {}
+
+    def fn(comm):
+        state = comm.state
+        was_joining = respawn.joining(state)
+        if was_joining:
+            comm = respawn.rejoin(comm)
+            st = buddy.restore(comm)
+            i, acc = int(st["i"]), np.asarray(st["acc"])
+        else:
+            i, acc = 0, np.zeros(4)
+        did_kill = False
+        while i < iters:
+            try:
+                buddy.checkpoint(comm, {"i": i, "acc": acc})
+                if (not was_joining and not did_kill
+                        and kill_at.get(comm.rank) == i):
+                    did_kill = True
+                    ulfm.kill_now(state)
+                acc = _step(i, acc, comm)
+                i += 1
+            except MPIException as e:
+                if e.code not in FT_CODES:
+                    raise
+                comm = respawn.rejoin(comm)
+                st = buddy.restore(comm)
+                i, acc = int(st["i"]), np.asarray(st["acc"])
+        return acc.tobytes()
+    return fn
+
+
+# ---- the tentpole: kill -> respawn -> buddy restore -----------------
+
+def test_respawn_byte_identical_full_size(buddy_degree_1):
+    """4 ranks, rank 1 killed mid-run: under respawn the job completes
+    at FULL world size with results byte-identical to a fault-free
+    run — the replacement's state came from a partner's memory (there
+    is no filesystem store in this test at all)."""
+    clean = run_ranks(4, _make_fn(), timeout=60)
+    faulty = run_ranks(4, _make_fn(kill_at={1: 5}), timeout=120,
+                       respawn=True)
+    assert faulty == clean
+    assert all(r is not None for r in faulty)  # nobody missing
+
+
+def test_respawn_chaos_victim_list(buddy_degree_1):
+    """Repeated kills across a run, victims drawn from the
+    ft_inject_victim_rank comma list (the satellite): each original
+    incarnation dies at a distinct iteration, each death is recovered
+    by a separate rejoin epoch, and the result still matches the
+    fault-free run bit-for-bit."""
+    registry.set("ft_inject_victim_rank", "1,3")
+    try:
+        victims = ft_inject.victim_ranks(4)
+        assert victims == [1, 3]
+        kill_at = {v: 2 + 3 * k for k, v in enumerate(victims)}
+        clean = run_ranks(4, _make_fn(iters=10), timeout=60)
+        faulty = run_ranks(4, _make_fn(iters=10, kill_at=kill_at),
+                           timeout=180, respawn=True)
+        assert faulty == clean
+    finally:
+        registry.set("ft_inject_victim_rank", "1")
+
+
+def test_respawn_pvars_count_rejoins(buddy_degree_1):
+    before = respawn._pv_rejoins.read()
+    run_ranks(4, _make_fn(kill_at={2: 3}), timeout=120, respawn=True)
+    # 3 survivors + 1 replacement each completed one rejoin
+    assert respawn._pv_rejoins.read() - before >= 4
+    assert respawn._pv_rejoin_us.read() > 0
+
+
+# ---- cr/buddy on its own --------------------------------------------
+
+def test_buddy_roundtrip_without_failure(buddy_degree_1):
+    def fn(comm):
+        s1 = buddy.checkpoint(comm, {"v": comm.rank * 1.0})
+        s2 = buddy.checkpoint(comm, {"v": comm.rank + 100.0})
+        assert (s1, s2) == (0, 1)
+        st = buddy.restore(comm)
+        return st["v"]
+
+    assert run_ranks(3, fn) == [100.0, 101.0, 102.0]
+
+
+def test_buddy_partner_placement(buddy_degree_1):
+    """Copy k of rank r lives on (r+k) %% size — verify the held map
+    directly."""
+    def fn(comm):
+        buddy.checkpoint(comm, {"r": comm.rank}, degree=2)
+        held = sorted(k[0] for k in
+                      comm.state.extra["cr_buddy"]["held"])
+        want = sorted({(comm.rank - 1) % comm.size,
+                       (comm.rank - 2) % comm.size})
+        assert held == want, (held, want)
+        return buddy.committed_seq(comm.state)
+
+    assert run_ranks(4, fn) == [0, 0, 0, 0]
+
+
+def test_buddy_degree_zero_is_noop():
+    """cr_buddy_degree=0 (the default): checkpoint is a single int
+    check — no quiesce, no pickle, no replica state, no traffic."""
+    def fn(comm):
+        assert buddy.checkpoint(comm, {"big": np.zeros(1 << 16)}) == -1
+        assert "cr_buddy" not in comm.state.extra
+        return True
+
+    assert run_ranks(2, fn) == [True, True]
+
+
+def test_buddy_restore_none_before_any_commit(buddy_degree_1):
+    def fn(comm):
+        return buddy.restore(comm)
+
+    assert run_ranks(2, fn) == [None, None]
+
+
+# ---- satellites ------------------------------------------------------
+
+def test_victim_ranks_parsing():
+    registry.set("ft_inject_victim_rank", "0, 2,3")
+    try:
+        assert ft_inject.victim_ranks() == [0, 2, 3]
+        registry.set("ft_inject_plan", "rank_kill")
+        assert ft_inject.rank_faults(2) == ["rank_kill"]
+        assert ft_inject.rank_faults(1) == []
+        assert ft_inject.rank_kill_victim() == 0  # compat: first victim
+    finally:
+        registry.set("ft_inject_plan", "")
+        registry.set("ft_inject_victim_rank", "1")
+
+
+def test_victim_ranks_random_is_seed_deterministic():
+    registry.set("ft_inject_victim_rank", "random")
+    try:
+        a = ft_inject.victim_ranks(8)
+        assert a == ft_inject.victim_ranks(8)  # same seed, same pick
+        assert 0 <= a[0] < 8
+        registry.set("ft_inject_seed", "12345")
+        b = ft_inject.victim_ranks(8)
+        assert b == ft_inject.victim_ranks(8)
+    finally:
+        registry.set("ft_inject_seed", "0")
+        registry.set("ft_inject_victim_rank", "1")
+
+
+def test_cr_keep_mca_default(tmp_path):
+    """cr_keep (the --ckpt-keep satellite) sets the job-wide default
+    for checkpoint(..., keep=): the store is pruned to the newest N
+    complete snapshots without any per-call argument."""
+    from ompi_tpu import cr
+    root = str(tmp_path / "store")
+    registry.set("cr_keep", "1")
+    try:
+        def fn(comm):
+            for i in range(3):
+                cr.checkpoint(comm, {"i": i}, store_dir=root)
+            return True
+
+        assert run_ranks(2, fn) == [True, True]
+        done = [d for d in os.listdir(root)
+                if os.path.isfile(os.path.join(root, d, "meta.json"))]
+        assert len(done) == 1, sorted(os.listdir(root))
+    finally:
+        registry.set("cr_keep", "0")
+
+
+def test_kv_purge_op():
+    """The kvstore 'purge' op (ticket/note hygiene): prefix-delete of
+    data keys AND counters, including put-once claim counters."""
+    from ompi_tpu.runtime.kvstore import KVClient, KVServer
+    os.environ.setdefault("TPUMPI_JOB_SECRET", "purge-test-secret")
+    srv = KVServer(1)
+    try:
+        cli = KVClient(srv.addr)
+        cli.put("ulfm:note:0", ["fail", 1])
+        cli.put("ulfm:agree:5:d", True)
+        cli.put("keepme", 7)
+        cli.incr("ulfm:nseq")
+        assert cli.put_once("ulfm:agree:5:c", 1)  # claim counter too
+        n = cli.purge("ulfm:")
+        assert n >= 2
+        assert cli.get("keepme") == 7
+        assert srv.data.get("ulfm:note:0") is None
+        assert all(not k.startswith(("ulfm:", "claim:ulfm:"))
+                   for k in srv.counters)
+        # the claim counter is gone: put_once works again
+        assert cli.put_once("ulfm:agree:5:c", 2)
+        cli.close()
+    finally:
+        srv.close()
+
+
+def test_purge_tickets_keeps_notes():
+    """Epoch rollover purges consumed agreement tickets but KEEPS
+    failure notes (a late watcher relies on the epoch filter, not on
+    deletion); finalize's purge_store drops everything."""
+    import threading
+    from types import SimpleNamespace
+
+    world = SimpleNamespace(shared={}, shared_lock=threading.Lock())
+    state = SimpleNamespace(rte=SimpleNamespace(world=world, kv=None))
+    world.shared[("agree", 7, "d")] = True
+    world.shared[("shrink", 3, "c", 0)] = [1]
+    world.shared[("respawn", 1, "d")] = {"failed": [1]}
+    world.shared[("ulfm", "cid")] = 4097
+    world.shared[("other", "app")] = "untouched"
+    ulfm.purge_tickets(state)
+    assert ("agree", 7, "d") not in world.shared
+    assert ("shrink", 3, "c", 0) not in world.shared
+    assert ("respawn", 1, "d") in world.shared  # live until finalize
+    ulfm.purge_store(state)
+    assert ("respawn", 1, "d") not in world.shared
+    assert ("ulfm", "cid") not in world.shared
+    assert world.shared == {("other", "app"): "untouched"}
+
+
+def test_epoch_cid_banding():
+    """After a recovery epoch, new cids come from the epoch's band
+    (epoch * EPOCH_CID_STRIDE) so a replacement can never collide with
+    a pre-failure cid it never saw."""
+    from ompi_tpu.comm.communicator import EPOCH_CID_STRIDE
+
+    def fn(comm):
+        comm.state.respawn_epoch = 2
+        sub = comm.dup()
+        assert sub.cid >= 2 * EPOCH_CID_STRIDE
+        return sub.cid
+
+    cids = run_ranks(2, fn)
+    assert cids[0] == cids[1]
+
+
+def test_ulfm_unfail_allows_re_kill_detection():
+    """unfail() clears the delivery dedup: a rank that was replaced
+    and later dies AGAIN is detected a second time."""
+    def fn(comm):
+        u = comm.state.ulfm
+        u.deliver(("fail", 1))
+        assert u.poll() == 1
+        assert 1 in u.failed
+        u.unfail(1)
+        assert 1 not in u.failed
+        u.deliver(("fail", 1))
+        assert u.poll() == 1  # seen-set was cleared: detected again
+        return True
+
+    assert run_ranks(1, fn) == [True]
+
+
+def test_ingest_filters_recovered_epochs():
+    """Epoch-tagged failure notes at or below the rank's recovery
+    epoch are stale replays and must not re-kill a revived rank."""
+    def fn(comm):
+        u = comm.state.ulfm
+        comm.state.respawn_epoch = 2
+        u.deliver(("fail", 1, 1))   # epoch 1 <= 2: recovered, dropped
+        u.deliver(("fail", 1, 2))   # epoch 2 <= 2: recovered, dropped
+        assert u.poll() == 0
+        assert 1 not in u.failed
+        u.deliver(("fail", 1, 3))   # epoch 3 > 2: a NEW death
+        assert u.poll() == 1
+        assert 1 in u.failed
+        u.deliver(("fail", comm.rank, 3))  # own rank: alive, dropped
+        assert u.poll() == 0
+        return True
+
+    assert run_ranks(1, fn) == [True]
+
+
+# ---- end-to-end over real processes ---------------------------------
+
+@pytest.mark.slow
+def test_mpirun_respawn_policy_process_ranks():
+    """ft_inject kills rank 1; the 'respawn' errmgr policy relaunches
+    it under the same world rank, the replacement restores from a
+    buddy copy and the job EXITS 0 at FULL size with every rank
+    reporting the same digest."""
+    r = mpirun_run(
+        4, "tests/_respawn_prog.py",
+        mca=(("errmgr_base_policy", "respawn"),
+             ("ft_inject_plan", "rank_kill"),
+             ("ft_inject_victim_rank", "1"),
+             ("ft_inject_after", "0.8"),
+             ("cr_buddy_degree", "1")),
+        timeout=240, job_timeout=180)
+    out = r.stdout.decode()
+    err = r.stderr.decode()
+    assert r.returncode == 0, (r.returncode, out[-800:], err[-2000:])
+    lines = [ln for ln in out.splitlines() if ln.startswith("rank=")]
+    assert len(lines) == 4, out[-800:]          # FULL size at the end
+    assert all("size=4" in ln for ln in lines), lines
+    digests = {ln.split("digest=")[1].strip() for ln in lines}
+    assert len(digests) == 1, lines             # byte-identical state
+    assert sum("joined=1" in ln for ln in lines) == 1, lines
+    assert "respawn policy" in err
