@@ -136,6 +136,17 @@ def main() -> None:
                          "persist under 'trace_overhead' in "
                          "BENCH_DETAIL.json, and FAIL (exit 1) if the "
                          "traced path costs more than 5%%")
+    ap.add_argument("--probe-pipeline", action="store_true",
+                    help="Measure the large-message busbw curve per "
+                         "device algorithm (fused / segmented ring / "
+                         "recursive doubling / hierarchical); persist "
+                         "under 'probe_pipeline' in BENCH_DETAIL.json "
+                         "and refresh the coll/calibrate profile's "
+                         "segmented/hierarchical crossovers")
+    ap.add_argument("--pipeline-max-bytes", type=int, default=None,
+                    help="Cap the --probe-pipeline size ladder (the "
+                         "full 256 MiB curve needs real accelerator "
+                         "memory; the default fits a CI box)")
     ap.add_argument("--probe-recovery", action="store_true",
                     help="Measure the ULFM forward-recovery pipeline "
                          "(kill -> ERR_PROC_FAILED detect -> shrink -> "
@@ -208,6 +219,29 @@ def main() -> None:
                 f"FAIL: tracing overhead {probe['overhead_pct']}% "
                 f"exceeds the {probe['budget_pct']}% budget\n")
             sys.exit(1)
+        return
+
+    if opts.probe_pipeline:
+        from benchmarks.probe_pipeline import (DEFAULT_MAX_BYTES,
+                                               persist, run_probe)
+
+        probe = run_probe(
+            max_bytes=opts.pipeline_max_bytes or DEFAULT_MAX_BYTES)
+        notes = persist(probe, detail_path)
+        top = str(probe["sizes"][-1])
+        line = {
+            "metric": f"probe_pipeline allreduce busbw, "
+                      f"{probe['nranks']} ranks, {top} B top size",
+            "value": {a: probe["busbw_gbs"][a].get(top)
+                      for a in probe["busbw_gbs"]},
+            "unit": "GB/s_busbw",
+            "seg_crossover_bytes": probe["seg_crossover_bytes"],
+            "hier_min_bytes": probe["hier_min_bytes"],
+            "segments_rank0": probe["segments_rank0"],
+        }
+        line.update({k: v for k, v in notes.items() if "error" in k})
+        sys.stderr.write(json.dumps(probe, indent=1) + "\n")
+        print(json.dumps(line))
         return
 
     if opts.probe_recovery:
@@ -385,7 +419,8 @@ def main() -> None:
         with open(detail_path, "w") as f:
             json.dump({**{k: prior[k]
                           for k in ("probe_dispatch", "trace_overhead",
-                                    "probe_recovery", "probe_respawn")
+                                    "probe_recovery", "probe_respawn",
+                                    "probe_pipeline")
                           if isinstance(prior, dict) and k in prior},
                        "device_us": dev, "software_us": sw,
                        "software_tuned_tcp_us": sw_tcp,

@@ -210,7 +210,26 @@ def measure_profile() -> Dict:
         prof["dispatch_error"] = str(e)[:120]
     prof["crossover_bytes"] = {
         kind: _solve_crossover(prof, kind) for kind in _KIND_TRAFFIC}
+    prof["seg_bytes"] = _solve_segment_bytes(prof)
+    prof["seg_crossover_bytes"] = {
+        kind: max(2 * prof["seg_bytes"], 1 << 20)
+        for kind in _KIND_TRAFFIC}
+    prof["hier_min_bytes"] = prof["seg_bytes"]
     return prof
+
+
+def _solve_segment_bytes(prof: Dict) -> int:
+    """Per-host segment size for the pipelined large-message tier:
+    the smallest segment whose transfer time keeps the per-segment
+    dispatch constant under ~10% overhead (larger segments waste
+    overlap; smaller ones re-pay the dispatch constant per chunk).
+    bench.py --probe-pipeline replaces this analytic guess with the
+    argmax of a real busbw sweep."""
+    disp = prof.get("dispatch_us")
+    if disp is None:
+        return 1 << 20
+    n = 10.0 * disp * prof["host_gbs"] * 1e3  # us * bytes/us
+    return int(min(max(n, 256 << 10), _CROSSOVER_CAP))
 
 
 def _solve_crossover(prof: Dict, kind: str) -> int:
@@ -300,6 +319,39 @@ def crossover_bytes(kind: str, comm_size: int) -> int:
         return 0
     cx = (prof.get("crossover_bytes") or {}).get(kind)
     return int(cx) if cx else 0
+
+
+def segment_bytes(comm_size: int, static: int) -> int:
+    """Segment size for the pipelined large-message tier
+    (DESIGN.md §12): the calibrated per-host value under measured
+    rules, else the ``coll_seg_size`` static."""
+    if not use_measured_rules():
+        return static
+    prof = get_profile()
+    sb = (prof or {}).get("seg_bytes")
+    return int(sb) if sb else static
+
+
+def segmented_crossover(kind: str, comm_size: int, static: int) -> int:
+    """Payload size where the segmented pipeline overtakes the fused
+    single-dispatch device path for ``kind``; ``static`` (the
+    ``coll_pipeline_min_bytes`` knob) when measured rules are off or
+    the profile has no swept value."""
+    if not use_measured_rules():
+        return static
+    prof = get_profile()
+    cx = ((prof or {}).get("seg_crossover_bytes") or {}).get(kind)
+    return int(cx) if cx else static
+
+
+def hier_min_bytes(comm_size: int, static: int) -> int:
+    """Minimum payload for the hierarchical tier (the leader hop's
+    host-path latency must amortize)."""
+    if not use_measured_rules():
+        return static
+    prof = get_profile()
+    hm = (prof or {}).get("hier_min_bytes")
+    return int(hm) if hm else static
 
 
 def _ladder():

@@ -289,12 +289,10 @@ class Rendezvous:
         self.readers: Dict[int, int] = {}
         self._progs: Dict[int, Any] = {}  # rank -> Progress (wake targets)
 
-    def run(self, rank: int, value: Any, fn: Callable[[List[Any]], List[Any]],
-            abort_check: Optional[Callable[[], None]] = None,
-            progress: Any = None) -> Any:
-        """Deposit `value`; last arriver runs fn(slots) -> outputs.
-        Waits poll at ``coll_device_rendezvous_poll`` (abort flags are
-        checked each tick, bounding abort latency) and fail after
+    def _wait_for(self, cond, what: str, abort_check, progress) -> None:
+        """Wait (cv held on entry and exit) until cond() holds.  Polls
+        at ``coll_device_rendezvous_poll`` (abort flags are checked
+        each tick, bounding abort latency) and fails after
         ``coll_device_rendezvous_timeout`` of no progress — a stuck
         peer must become a diagnosable error, not a silent hang.
 
@@ -311,10 +309,8 @@ class Rendezvous:
 
         poll = _rv_poll_var.value
         stall = _rv_timeout_var.value
-        if progress is not None:
-            self._progs[rank] = progress
 
-        def tick(t_start: float, what: str) -> None:
+        def tick(t_start: float) -> None:
             if abort_check:
                 abort_check()
             if time.monotonic() - t_start > stall:
@@ -323,52 +319,74 @@ class Rendezvous:
                     f"({what}; peers dead or diverged? tune "
                     f"coll_device_rendezvous_timeout)")
 
-        def wait_for(cond, what: str) -> None:
-            # cv held on entry and exit
-            t0 = time.monotonic()
-            if progress is None:
-                while not cond():
-                    if not self.cv.wait(timeout=poll):
-                        tick(t0, what)
-                return
-            park = min(poll, 0.05)
-            first = True
+        t0 = time.monotonic()
+        if progress is None:
             while not cond():
-                if first:
-                    # fast path: park straight on the condvar — in the
-                    # common meeting (all peers arrive within a couple
-                    # ms) the last arriver's notify wakes us with ZERO
-                    # progress sweeps.  A sweep costs 10-50x a condvar
-                    # wake and used to run once per waiter per op,
-                    # dominating the small-collective floor; background
-                    # service (passive-target RMA at this rank) keeps
-                    # its <=2 ms latency via the timeout below.
-                    first = False
-                    if self.cv.wait(timeout=0.002):
-                        continue
-                # progress outside the cv: handlers may send replies
-                # (osc acks) and must never run under the meeting lock
-                self.cv.release()
-                try:
-                    events = progress.progress()
-                    if events == 0 and progress.has_idle_fds:
-                        # park in the idle selector: woken by frag
-                        # arrival AND by rendezvous completion
-                        progress.idle_wait(park)
-                finally:
-                    self.cv.acquire()
-                if events == 0 and not progress.has_idle_fds:
-                    # no kernel-wakeable fds: park on the condvar (a
-                    # GIL-holding spin here is measured strictly worse
-                    # on shared cores) with a short timeout so the pml
-                    # still gets swept every few ms
-                    self.cv.wait(timeout=0.002)
-                tick(t0, what)
+                if not self.cv.wait(timeout=poll):
+                    tick(t0)
+            return
+        park = min(poll, 0.05)
+        first = True
+        while not cond():
+            if first:
+                # fast path: park straight on the condvar — in the
+                # common meeting (all peers arrive within a couple
+                # ms) the last arriver's notify wakes us with ZERO
+                # progress sweeps.  A sweep costs 10-50x a condvar
+                # wake and used to run once per waiter per op,
+                # dominating the small-collective floor; background
+                # service (passive-target RMA at this rank) keeps
+                # its <=2 ms latency via the timeout below.
+                first = False
+                if self.cv.wait(timeout=0.002):
+                    continue
+            # progress outside the cv: handlers may send replies
+            # (osc acks) and must never run under the meeting lock
+            self.cv.release()
+            try:
+                events = progress.progress()
+                if events == 0 and progress.has_idle_fds:
+                    # park in the idle selector: woken by frag
+                    # arrival AND by rendezvous completion
+                    progress.idle_wait(park)
+            finally:
+                self.cv.acquire()
+            if events == 0 and not progress.has_idle_fds:
+                # no kernel-wakeable fds: park on the condvar (a
+                # GIL-holding spin here is measured strictly worse
+                # on shared cores) with a short timeout so the pml
+                # still gets swept every few ms
+                self.cv.wait(timeout=0.002)
+            tick(t0)
 
+    def begin(self, rank: int, value: Any,
+              fn: Callable[[List[Any]], List[Any]],
+              abort_check: Optional[Callable[[], None]] = None,
+              progress: Any = None,
+              dispatch_async: Optional[bool] = None) -> int:
+        """Deposit `value` for the next generation; the last arriver
+        triggers fn(slots) -> outputs.  Returns the generation token
+        to collect with ``finish``.
+
+        ``dispatch_async=None`` follows the coll_device_dispatcher
+        knob (the classic blocking behavior); ``True`` forces the last
+        arriver to hand fn to the process-wide dispatcher thread so
+        begin() returns while the device computes — the hook the
+        segmented pipeline uses to overlap host packing of segment
+        k+1 with device dispatch of segment k (docs/DESIGN.md §12).
+        Slots recycle as soon as the meeting is full, so generation
+        g+1 deposits may land while g still computes — pipelining
+        depth is bounded only by how far a caller runs ahead of its
+        own finish() calls."""
+        if progress is not None:
+            self._progs[rank] = progress
+        if dispatch_async is None:
+            dispatch_async = _dispatcher_var.value
         with self.cv:
             # wait until my slot from the previous generation is consumed
-            wait_for(lambda: self.slots[rank] is self._SENTINEL,
-                     "previous generation unconsumed")
+            self._wait_for(lambda: self.slots[rank] is self._SENTINEL,
+                           "previous generation unconsumed",
+                           abort_check, progress)
             gen = self.gen
             self.slots[rank] = value
             self.count += 1
@@ -377,12 +395,10 @@ class Rendezvous:
                 self.count = 0
                 self.slots = [self._SENTINEL] * self.size
                 self.gen += 1
-                if _dispatcher_var.value:
-                    # optional: hand the computation to the process-
-                    # wide dispatcher thread and park with everyone
-                    # else.  Slots are recycled above either way, so
-                    # generation g+1 deposits may land while g still
-                    # computes.
+                if dispatch_async:
+                    # hand the computation to the process-wide
+                    # dispatcher thread; members park (or pipeline)
+                    # until it publishes the generation's results
                     rv = self
 
                     def work() -> None:
@@ -418,8 +434,19 @@ class Rendezvous:
                     for r, prog in self._progs.items():
                         if r != rank:
                             prog.wakeup()
-            wait_for(lambda: gen in self.results,
-                     f"waiting for {self.size - self.count} peers")
+        return gen
+
+    def finish(self, rank: int, gen: int,
+               abort_check: Optional[Callable[[], None]] = None,
+               progress: Any = None) -> Any:
+        """Collect this rank's output of generation ``gen`` (a token
+        from ``begin``).  Each member must finish every generation it
+        begins, exactly once — results are refcounted away after the
+        last reader."""
+        with self.cv:
+            self._wait_for(lambda: gen in self.results,
+                           f"waiting for peers (gen {gen})",
+                           abort_check, progress)
             err = self.errors.get(gen)
             out = self.results[gen][rank]
             self.readers[gen] -= 1
@@ -430,6 +457,14 @@ class Rendezvous:
                 raise RuntimeError(
                     f"device collective failed on a peer: {err}") from err
             return out
+
+    def run(self, rank: int, value: Any, fn: Callable[[List[Any]], List[Any]],
+            abort_check: Optional[Callable[[], None]] = None,
+            progress: Any = None) -> Any:
+        """Deposit `value`; last arriver runs fn(slots) -> outputs;
+        block until this rank's output is ready (begin + finish)."""
+        gen = self.begin(rank, value, fn, abort_check, progress)
+        return self.finish(rank, gen, abort_check, progress)
 
 
 def meet(comm, value, fn, abort_check) -> Any:
@@ -460,6 +495,47 @@ def meet(comm, value, fn, abort_check) -> Any:
                  progress=comm.state.progress)
     tr.end(t0, "meet", "coll_dispatch", cid=comm.cid, seq=seq,
            nbytes=nbytes)
+    return out
+
+
+def meet_begin(comm, value, fn, abort_check):
+    """Asynchronous rendezvous entry: deposit and return a handle
+    without waiting for the result.  The last arriver's computation
+    always runs on the dispatcher thread, so the caller's thread is
+    free to pack the NEXT segment while the device computes this one
+    — the overlap the segmented pipeline is built on.  Collect with
+    ``meet_finish``; every begun handle MUST be finished (results are
+    refcounted per generation)."""
+    rv = _get_rendezvous(comm)
+    track_state(comm.state)
+    inj = _coll_delay_injector(comm.state)
+    if inj:
+        d = inj.maybe_delay()
+        if d:
+            time.sleep(d)
+    nbytes = int(getattr(value, "nbytes", 0) or 0)
+    count_offload(comm, nbytes)
+    tr = comm.state.tracer
+    t0 = tr.start() if tr is not None else None
+    gen = rv.begin(comm.rank, value, fn, abort_check,
+                   progress=comm.state.progress, dispatch_async=True)
+    return (rv, gen, t0, nbytes)
+
+
+def meet_finish(comm, handle, abort_check) -> Any:
+    """Collect one ``meet_begin`` handle.  The deposit→collect span is
+    recorded under cat ``coll_segment`` (its own latency histogram —
+    per-segment latency, unlike coll_dispatch's whole-op latency)."""
+    rv, gen, t0, nbytes = handle
+    out = rv.finish(comm.rank, gen, abort_check,
+                    progress=comm.state.progress)
+    if t0 is not None:
+        tr = comm.state.tracer
+        if tr is not None:
+            seq = comm.__dict__.get("_dev_seq", 0)
+            comm.__dict__["_dev_seq"] = seq + 1
+            tr.end(t0, "seg_meet", "coll_segment", cid=comm.cid,
+                   seq=seq, nbytes=nbytes)
     return out
 
 
@@ -715,6 +791,20 @@ def _scatter_out(out, mesh, size: int) -> List:
     return [out] * size
 
 
+_pipeline_mod = None
+
+
+def _pipeline():
+    """Lazy large-message tier (coll/pipeline) — resolved once; the
+    4-byte-floor hot path must not pay an import-machinery dict walk
+    per collective."""
+    global _pipeline_mod
+    if _pipeline_mod is None:
+        from ompi_tpu.coll import pipeline as _p
+        _pipeline_mod = _p
+    return _pipeline_mod
+
+
 def _measured_host_wins(comm, kind: str, nbytes: int) -> bool:
     """Measured-crossover reroute (--mca coll_tuned_use_measured_rules):
     below the calibrated device-vs-host crossover the host seg path
@@ -795,6 +885,11 @@ class TpuCollModule(CollModule):
                 or _measured_host_wins(comm, "allreduce",
                                        int(getattr(x, "nbytes", 0) or 0)):
             return self.fallback.allreduce_arr(comm, x, op)
+        pl = _pipeline()
+        out = pl.maybe_device_coll(self, comm, "allreduce", x, op=op)
+        if out is not pl.UNHANDLED:
+            self.pvar_offload.add(1)
+            return out
         mesh = comm.mesh()
         x, was_scalar = self._norm(x)
 
@@ -844,6 +939,11 @@ class TpuCollModule(CollModule):
                 or _measured_host_wins(comm, "alltoall",
                                        int(getattr(x, "nbytes", 0) or 0)):
             return self.fallback.alltoall_arr(comm, x)
+        pl = _pipeline()
+        out = pl.maybe_device_coll(self, comm, "alltoall", x)
+        if out is not pl.UNHANDLED:
+            self.pvar_offload.add(1)
+            return out
         mesh = comm.mesh()
 
         def fn(shards):
@@ -858,6 +958,11 @@ class TpuCollModule(CollModule):
                 or _measured_host_wins(comm, "bcast",
                                        int(getattr(x, "nbytes", 0) or 0)):
             return self.fallback.bcast_arr(comm, x, root)
+        pl = _pipeline()
+        out = pl.maybe_device_coll(self, comm, "bcast", x, root=root)
+        if out is not pl.UNHANDLED:
+            self.pvar_offload.add(1)
+            return out
         mesh = comm.mesh()
         x, was_scalar = self._norm(x)
 
@@ -1036,6 +1141,10 @@ class HbmCollModule(CollModule):
         if not self._eligible(comm, x) or (
                 op.name not in _XLA_REDUCERS and op.name not in _GATHER_FOLD):
             return self.fallback.allreduce_arr(comm, x, op)
+        pl = _pipeline()
+        out = pl.maybe_device_coll(self, comm, "allreduce", x, op=op)
+        if out is not pl.UNHANDLED:
+            return out
         x, was_scalar = self._norm(x)
         out = self._run(comm, "allreduce", op.name, x)
         return out.reshape(()) if was_scalar else out
@@ -1061,6 +1170,10 @@ class HbmCollModule(CollModule):
         if not self._eligible(comm, x) or _ndim_of(x) == 0 \
                 or x.shape[0] % comm.size != 0:
             return self.fallback.alltoall_arr(comm, x)
+        pl = _pipeline()
+        out = pl.maybe_device_coll(self, comm, "alltoall", x)
+        if out is not pl.UNHANDLED:
+            return out
         return self._run(comm, "alltoall", "", x)
 
     def bcast_arr(self, comm, x, root: int):

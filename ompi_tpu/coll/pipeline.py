@@ -1,0 +1,607 @@
+"""coll/pipeline: the large-message tier of the device collective
+engine — segmented, pipelined, topology-aware algorithms.
+
+The fused fast path (docs/DESIGN.md §8) owns the small-message regime:
+ONE assembled shard_map per collective, dispatch constant amortized by
+batching.  Large messages invert the trade — the payload dominates and
+the single monolithic dispatch serializes host packing, device compute
+and unpacking end to end.  This module is the re-design of the
+reference's segmented algorithms (ref: coll_tuned_decision_fixed.c:72
+segmented ring above 1 MiB; coll_base_allreduce.c:343 ring
+reduce-scatter + allgather; Rabenseifner's decomposition) on the
+rendezvous machinery:
+
+* **segring** — chunked ``ppermute`` ring allreduce: inside one
+  compiled kernel per segment, P-1 reduce-scatter steps (each rank
+  accumulates one stripe per hop) then P-1 allgather steps.  Per-chunk
+  accumulation is a rank-ordered left fold computed by exactly ONE
+  rank and circulated verbatim, so every rank's output is byte
+  identical by construction.
+* **segrd** — per-segment recursive doubling (power-of-two comms):
+  log2(P) exchange rounds; both operand orders are computed and
+  selected by rank parity (the MPICH operand-order discipline), so
+  all ranks evaluate the identical expression tree.
+* **ring bcast / pairwise alltoall** — segmented data movement on the
+  same machinery (bit-exact by construction).
+
+**Pipelining**: segments run through the asynchronous rendezvous
+(``device.meet_begin``/``meet_finish``): a rank deposits segment k and
+immediately starts packing (slice + pad) segment k+1 on its own thread
+while the dispatcher thread drives the device through segment k — the
+pack → dispatch → unpack stages of consecutive segments overlap, depth
+bounded by ``coll_pipeline_depth``.
+
+**Segment-size discipline**: every segment of every message is padded
+to ONE fixed per-host segment shape (op identity elements; sliced off
+at unpack), so the CompiledLRU holds exactly one executable per
+(algorithm, mesh, segment shape, dtype, op) — segment-size variants
+cannot blow the bounded cache no matter how many distinct message
+sizes a workload sweeps.
+
+**Hierarchy** (``coll_hier_enable``): multi-slice meshes stop
+serializing through one link — intra-slice XLA ``psum`` (the device
+tier), inter-slice reduction by the slice leaders over the tcp/OOB
+host path, then an intra-slice device bcast.  Slice membership comes
+from ``topo.slice_groups`` (device slice_index / modex node_id, or
+``coll_hier_slice_size`` for explicit shaping).
+
+Selection rides the measured-rules machinery: ``tuned.device_algorithm``
+consults ``calibrate`` (per-host segment size, small/segmented and
+hierarchical crossovers, refreshed by ``bench.py --probe-pipeline``)
+and the decision is cached per communicator — the per-comm module
+binding discipline of the reference's comm_select.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Callable, List, Optional, Tuple
+
+import numpy as np
+
+from ompi_tpu.mca.params import registry
+
+_seg_size_var = registry.register(
+    "coll", "seg", "size", 1 << 20, int,
+    help="Segment size (bytes) for the segmented/pipelined large-"
+         "message device algorithms (ref: "
+         "coll_tuned_decision_fixed.c:72).  Rounded up so ring "
+         "stripes stay equal; coll_tuned_use_measured_rules replaces "
+         "this with the calibrated per-host segment size")
+_depth_var = registry.register(
+    "coll", "pipeline", "depth", 2, int,
+    help="Outstanding segments in the pipelined rendezvous: host "
+         "packing of segment k+1 overlaps device dispatch of segment "
+         "k up to this depth.  1 = fully synchronous")
+_enable_var = registry.register(
+    "coll", "pipeline", "enable", True, bool,
+    help="Enable the segmented/pipelined large-message device tier "
+         "(messages below coll_pipeline_min_bytes keep the fused "
+         "single-dispatch path either way)")
+_min_bytes_var = registry.register(
+    "coll", "pipeline", "min_bytes", 4 << 20, int,
+    help="Static fused-vs-segmented crossover: messages at least this "
+         "large take the segmented pipeline.  "
+         "coll_tuned_use_measured_rules replaces it with the "
+         "calibrated per-host crossover")
+_rd_max_var = registry.register(
+    "coll", "pipeline", "rd_max_bytes", 8 << 20, int,
+    help="Upper bound of the per-segment recursive-doubling window "
+         "(power-of-two comms): above it the ring's lower bytes-on-"
+         "the-wire wins (2(P-1)/P x n vs log2(P) x n)")
+_hier_var = registry.register(
+    "coll", "hier", "enable", False, bool,
+    help="Enable the hierarchical allreduce tier: intra-slice XLA "
+         "psum + inter-slice reduction over the tcp/OOB host path + "
+         "intra-slice bcast.  Needs >= 2 slices (topo.slice_groups)")
+_hier_slice_var = registry.register(
+    "coll", "hier", "slice_size", 0, int,
+    help="Force hierarchical slices of this many consecutive ranks "
+         "(0 = auto: group by device slice_index, else modex node)")
+_hier_min_var = registry.register(
+    "coll", "hier", "min_bytes", 1 << 20, int,
+    help="Static minimum payload for the hierarchical tier (the "
+         "leader hop adds host-path latency that small messages "
+         "cannot amortize)")
+
+pv_segments = registry.register_pvar(
+    "coll", "pipeline", "segments",
+    help="Segments dispatched through the pipelined rendezvous")
+pv_ops = registry.register_pvar(
+    "coll", "pipeline", "ops",
+    help="Collectives routed to the segmented large-message tier")
+pv_hier = registry.register_pvar(
+    "coll", "hier", "ops",
+    help="Collectives routed to the hierarchical tier")
+
+#: returned by maybe_device_coll when the large-message tier does not
+#: apply and the caller should keep its fused single-dispatch path
+UNHANDLED = object()
+
+# ops with a pairwise accumulation step (segring/segrd); every XLA-
+# lowerable reducer and gather-fold op has one
+_BINOPS = {
+    "MPI_SUM": "add", "MPI_MAX": "maximum", "MPI_MIN": "minimum",
+    "MPI_PROD": "multiply", "MPI_BAND": "bitwise_and",
+    "MPI_BOR": "bitwise_or", "MPI_BXOR": "bitwise_xor",
+    "MPI_LAND": None, "MPI_LOR": None, "MPI_LXOR": None,
+}
+
+
+def _binop(opname: str) -> Callable:
+    import jax.numpy as jnp
+    name = _BINOPS[opname]
+    if name is not None:
+        return getattr(jnp, name)
+    # logical ops: normalize to 0/1 in the input dtype at every step
+    if opname == "MPI_LAND":
+        return lambda a, b: ((a != 0) & (b != 0)).astype(a.dtype)
+    if opname == "MPI_LOR":
+        return lambda a, b: ((a != 0) | (b != 0)).astype(a.dtype)
+    return lambda a, b: ((a != 0) ^ (b != 0)).astype(a.dtype)
+
+
+def _pad_value(opname: Optional[str], dtype) -> Any:
+    """Identity element of the op — tail segments are padded with it
+    so EVERY segment hits one compiled shape and the padding cannot
+    perturb real elements."""
+    dt = np.dtype(dtype)
+    if opname in ("MPI_MAX",):
+        return dt.type(np.iinfo(dt).min) if dt.kind in "iu" \
+            else dt.type(-np.inf)
+    if opname in ("MPI_MIN",):
+        return dt.type(np.iinfo(dt).max) if dt.kind in "iu" \
+            else dt.type(np.inf)
+    if opname in ("MPI_PROD", "MPI_LAND"):
+        return dt.type(1)
+    if opname == "MPI_BAND":
+        return dt.type(~dt.type(0)) if dt.kind in "iu" else dt.type(1)
+    # SUM, OR/XOR families, and data-movement kinds (bcast/alltoall)
+    return dt.type(0)
+
+
+# ---------------------------------------------------------------------------
+# per-segment compiled kernels (one executable per (alg, mesh, segment
+# shape, dtype, op) in the shared CompiledLRU)
+# ---------------------------------------------------------------------------
+
+def _seg_kernel(kind: str, mesh, seg_elems: int, dtype, extra) -> Callable:
+    from ompi_tpu.coll import device
+    dev_key = tuple(d.id for d in mesh.devices.reshape(-1))
+    key = (kind, dev_key, (seg_elems,), np.dtype(dtype).str, extra)
+    return device.compile_cache.get(
+        key, lambda: _build_seg_kernel(kind, mesh, seg_elems, dtype, extra))
+
+
+def _build_seg_kernel(kind: str, mesh, seg_elems: int, dtype,
+                      extra) -> Callable:
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from ompi_tpu.coll import device
+
+    size = mesh.devices.size
+    ring = [(j, (j + 1) % size) for j in range(size)]
+
+    if kind == "segring":
+        # Rabenseifner on a ring: P-1 reduce-scatter hops (rank i ends
+        # holding the fully reduced stripe (i+1)%P), then P-1 allgather
+        # hops writing each circulating stripe into place.  Chunk c's
+        # fold is the rank-ordered left fold starting at rank c,
+        # computed once and circulated verbatim — all ranks byte equal.
+        opname = extra
+        binop = _binop(opname)
+        assert seg_elems % size == 0
+        m = seg_elems // size
+
+        def body(x):
+            i = lax.axis_index("r")
+            stripes = x.reshape(size, m)
+
+            def stripe(idx):
+                return lax.dynamic_slice_in_dim(stripes, idx, 1, 0)[0]
+
+            acc = stripe(i)
+            for t in range(size - 1):
+                acc = lax.ppermute(acc, "r", perm=ring)
+                acc = binop(acc, stripe((i - t - 1) % size))
+            out = jnp.zeros_like(stripes)
+            out = lax.dynamic_update_slice_in_dim(
+                out, acc[None], (i + 1) % size, 0)
+            cur = acc
+            for t in range(size - 1):
+                cur = lax.ppermute(cur, "r", perm=ring)
+                out = lax.dynamic_update_slice_in_dim(
+                    out, cur[None], (i - t) % size, 0)
+            return out.reshape(-1)
+
+        in_specs, out_specs = P("r"), P(None)
+    elif kind == "segrd":
+        # recursive doubling (power-of-two comms): both operand orders
+        # are computed and rank parity selects — every rank evaluates
+        # the identical balanced expression tree, so cross-rank
+        # byte-identity holds even for order-sensitive float folds
+        opname = extra
+        binop = _binop(opname)
+
+        def body(x):
+            i = lax.axis_index("r")
+            acc = x
+            s = 1
+            while s < size:
+                perm = [(j, j ^ s) for j in range(size)]
+                other = lax.ppermute(acc, "r", perm=perm)
+                low = (i & s) == 0
+                acc = jnp.where(low, binop(acc, other), binop(other, acc))
+                s <<= 1
+            return acc
+
+        in_specs, out_specs = P("r"), P(None)
+    elif kind == "segbcast":
+        # neighbor-only ring circulation: the payload hops rank to
+        # rank; each rank latches the copy arriving at hop
+        # (rank - root) % P.  Bit-exact (pure data movement).
+        root = extra
+
+        def body(x):
+            i = lax.axis_index("r")
+            dist = (i - root) % size
+            cur = x
+            acc = x
+            for t in range(1, size):
+                cur = lax.ppermute(cur, "r", perm=ring)
+                acc = jnp.where(dist == t, cur, acc)
+            return acc
+
+        in_specs, out_specs = P("r"), P(None)
+    elif kind == "sega2a":
+        # pairwise exchange (ref: coll_base_alltoall.c pairwise): at
+        # step t every rank sends its block (i+t)%P via a shift-t
+        # permutation and files the received block under its source row
+        assert seg_elems % size == 0
+        m = seg_elems // size
+
+        def body(x):
+            i = lax.axis_index("r")
+            blocks = x.reshape(size, m)
+
+            def block(idx):
+                return lax.dynamic_slice_in_dim(blocks, idx, 1, 0)[0]
+
+            out = jnp.zeros_like(blocks)
+            out = lax.dynamic_update_slice_in_dim(out, block(i)[None], i, 0)
+            for t in range(1, size):
+                shifted = [(j, (j + t) % size) for j in range(size)]
+                recv = lax.ppermute(block((i + t) % size), "r",
+                                    perm=shifted)
+                out = lax.dynamic_update_slice_in_dim(
+                    out, recv[None], (i - t) % size, 0)
+            return out.reshape(-1)
+
+        in_specs, out_specs = P("r"), P("r")
+    else:
+        raise KeyError(kind)
+
+    return jax.jit(device.shard_map_compat(body, mesh, in_specs, out_specs))
+
+
+# ---------------------------------------------------------------------------
+# the pipelined executor
+# ---------------------------------------------------------------------------
+
+def segment_elems(comm, itemsize: int) -> int:
+    """Per-host segment size in elements, rounded UP to a multiple of
+    the comm size so ring stripes and alltoall blocks stay equal."""
+    from ompi_tpu.coll import calibrate
+    seg_bytes = calibrate.segment_bytes(comm.size, _seg_size_var.value)
+    elems = max(comm.size, seg_bytes // max(1, itemsize))
+    rem = elems % comm.size
+    return elems + (comm.size - rem) if rem else elems
+
+
+def _run_pipelined(module, comm, jobs) -> List[Any]:
+    """Drive (value, fn) segment jobs through the async rendezvous
+    with bounded depth.  Every begun handle is finished even on error
+    — peers park on the generation's refcounted results."""
+    from ompi_tpu.coll import device
+    depth = max(1, _depth_var.value)
+    check = module._abort_check(comm)
+    handles: deque = deque()
+    outs: List[Any] = []
+    try:
+        for value, fn in jobs:
+            handles.append(device.meet_begin(comm, value, fn, check))
+            pv_segments.add(1)
+            if len(handles) > depth:
+                outs.append(device.meet_finish(comm, handles.popleft(),
+                                               check))
+        while handles:
+            outs.append(device.meet_finish(comm, handles.popleft(), check))
+    except BaseException:
+        while handles:  # drain: results are refcounted per generation
+            try:
+                device.meet_finish(comm, handles.popleft(), check)
+            except BaseException:  # noqa: BLE001 — already failing
+                pass
+        raise
+    return outs
+
+
+def _flat_segments(flat, n: int, seg: int, pad):
+    """Slice ``flat`` into fixed-size segments, padding the tail with
+    the op identity — the pack stage (host-side slicing of segment k+1
+    overlaps device dispatch of segment k through the async meet)."""
+    import jax.numpy as jnp
+    for lo in range(0, n, seg):
+        piece = flat[lo:lo + seg]
+        if piece.shape[0] < seg:
+            piece = jnp.concatenate(
+                [piece, jnp.full((seg - piece.shape[0],), pad,
+                                 piece.dtype)])
+        yield piece
+
+
+def _concat_trim(outs: List[Any], n: int, seg: int):
+    import jax.numpy as jnp
+    tail = n - (len(outs) - 1) * seg
+    if tail != seg:
+        outs = outs[:-1] + [outs[-1][:tail]]
+    return outs[0] if len(outs) == 1 else jnp.concatenate(outs)
+
+
+# -- mesh (coll/tpu) algorithms ---------------------------------------------
+
+def _mesh_seg_reduce(module, comm, x, op, alg: str):
+    """Segmented allreduce over the mesh: segring or segrd kernels,
+    pipelined."""
+    import jax.numpy as jnp
+    from ompi_tpu.coll import device
+    mesh = comm.mesh()
+    shape = x.shape
+    flat = jnp.asarray(x).reshape(-1)
+    n = flat.shape[0]
+    dtype = flat.dtype
+    seg = segment_elems(comm, dtype.itemsize)
+    opname = op.name
+    size = comm.size
+    kind = "segring" if alg == "segring" else "segrd"
+
+    def fn(shards):
+        g = device._assemble(mesh, shards)
+        jfn = _seg_kernel(kind, mesh, seg, dtype, opname)
+        return device._scatter_out(jfn(g), mesh, size)
+
+    pad = _pad_value(opname, dtype)
+    outs = _run_pipelined(module, comm,
+                          ((p, fn) for p in _flat_segments(flat, n, seg,
+                                                           pad)))
+    return _concat_trim(outs, n, seg).reshape(shape)
+
+
+def _mesh_seg_bcast(module, comm, x, root: int):
+    import jax.numpy as jnp
+    from ompi_tpu.coll import device
+    mesh = comm.mesh()
+    shape = x.shape
+    flat = jnp.asarray(x).reshape(-1)
+    n = flat.shape[0]
+    dtype = flat.dtype
+    seg = segment_elems(comm, dtype.itemsize)
+    size = comm.size
+
+    def fn(shards):
+        g = device._assemble(mesh, shards)
+        jfn = _seg_kernel("segbcast", mesh, seg, dtype, root)
+        return device._scatter_out(jfn(g), mesh, size)
+
+    outs = _run_pipelined(module, comm,
+                          ((p, fn) for p in _flat_segments(flat, n, seg,
+                                                           dtype.type(0))))
+    return _concat_trim(outs, n, seg).reshape(shape)
+
+
+def _mesh_seg_alltoall(module, comm, x):
+    """Segmented pairwise alltoall: segment k covers columns
+    [k*m, (k+1)*m) of EVERY destination block, so each segment is a
+    (P, m) exchange hitting one compiled shape."""
+    import jax.numpy as jnp
+    from ompi_tpu.coll import device
+    mesh = comm.mesh()
+    size = comm.size
+    shape = x.shape
+    rows = jnp.asarray(x).reshape(size, -1)  # row p = block for rank p
+    cols = rows.shape[1]
+    seg = segment_elems(comm, rows.dtype.itemsize)
+    m = max(1, seg // size)
+    seg = m * size
+    dtype = rows.dtype
+
+    def fn(shards):
+        g = device._assemble(mesh, shards)
+        jfn = _seg_kernel("sega2a", mesh, seg, dtype, None)
+        return device._scatter_out(jfn(g), mesh, size)
+
+    def jobs():
+        for lo in range(0, cols, m):
+            sub = rows[:, lo:lo + m]
+            if sub.shape[1] < m:
+                sub = jnp.concatenate(
+                    [sub, jnp.zeros((size, m - sub.shape[1]), dtype)],
+                    axis=1)
+            yield sub.reshape(-1), fn
+
+    outs = _run_pipelined(module, comm, jobs())
+    pieces = [o.reshape(size, m) for o in outs]
+    tail = cols - (len(pieces) - 1) * m
+    if tail != m:
+        pieces = pieces[:-1] + [pieces[-1][:, :tail]]
+    full = pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces,
+                                                              axis=1)
+    return full.reshape(shape)
+
+
+# -- hbm (intra-chip) segmentation ------------------------------------------
+
+def _hbm_seg_reduce(module, comm, x, op):
+    """Segmented intra-chip allreduce: per-segment stacked kernels
+    (elementwise over the rank axis — bit-exact vs the monolithic
+    stacked reduce at ANY dtype), pipelined through the async meet."""
+    import jax.numpy as jnp
+    x = module._deposit(comm, x)
+    shape = x.shape
+    flat = x.reshape(-1)
+    n = flat.shape[0]
+    dtype = flat.dtype
+    seg = segment_elems(comm, dtype.itemsize)
+    size = comm.size
+    opname = op.name
+    jbody, out_map = module._stacked("allreduce", opname, size, (seg,),
+                                     dtype)
+
+    def fn(shards):
+        return out_map(jbody(*shards), size)
+
+    pad = _pad_value(opname, dtype)
+    outs = _run_pipelined(module, comm,
+                          ((p, fn) for p in _flat_segments(flat, n, seg,
+                                                           pad)))
+    return _concat_trim(outs, n, seg).reshape(shape)
+
+
+def _hbm_seg_alltoall(module, comm, x):
+    import jax.numpy as jnp
+    x = module._deposit(comm, x)
+    size = comm.size
+    shape = x.shape
+    rows = x.reshape(size, -1)
+    cols = rows.shape[1]
+    dtype = rows.dtype
+    seg = segment_elems(comm, dtype.itemsize)
+    m = max(1, seg // size)
+    seg = m * size
+    jbody, out_map = module._stacked("alltoall", "", size, (seg,), dtype)
+
+    def fn(shards):
+        return out_map(jbody(*shards), size)
+
+    def jobs():
+        for lo in range(0, cols, m):
+            sub = rows[:, lo:lo + m]
+            if sub.shape[1] < m:
+                sub = jnp.concatenate(
+                    [sub, jnp.zeros((size, m - sub.shape[1]), dtype)],
+                    axis=1)
+            yield sub.reshape(-1), fn
+
+    outs = _run_pipelined(module, comm, jobs())
+    pieces = [o.reshape(size, m) for o in outs]
+    tail = cols - (len(pieces) - 1) * m
+    if tail != m:
+        pieces = pieces[:-1] + [pieces[-1][:, :tail]]
+    full = pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces,
+                                                              axis=1)
+    return full.reshape(shape)
+
+
+# ---------------------------------------------------------------------------
+# hierarchical tier
+# ---------------------------------------------------------------------------
+
+def hier_eligible(comm) -> bool:
+    """Comm-consistent: slice grouping depends only on modex/device
+    data every member shares.  Cached — consulted per large message."""
+    cached = comm.__dict__.get("_hier_eligible")
+    if cached is not None:
+        return cached
+    ok = False
+    if _hier_var.value and comm.size >= 4 and comm.mesh() is not None:
+        from ompi_tpu.topo import topo as topomod
+        groups = topomod.slice_groups(comm, _hier_slice_var.value)
+        # need >= 2 slices of >= 2 ranks each: a 1-rank slice would
+        # make the intra tier a no-op and the leader hop pure overhead
+        ok = len(groups) >= 2 and all(len(g) >= 2 for g in groups)
+    comm.__dict__["_hier_eligible"] = ok
+    return ok
+
+
+def _hier_plan(comm) -> Tuple[Any, Optional[Any]]:
+    """(intra_slice_comm, leader_comm_or_None) — built collectively at
+    first use (the pick is comm-consistent, so every member arrives
+    together) and cached; ULFM shrink/respawn epochs invalidate it
+    with the other per-comm plans (_COMM_CACHE_KEYS)."""
+    plan = comm.__dict__.get("_hier_plan")
+    if plan is None:
+        from ompi_tpu.comm.communicator import UNDEFINED
+        from ompi_tpu.topo import topo as topomod
+        groups = topomod.slice_groups(comm, _hier_slice_var.value)
+        mine = next(i for i, g in enumerate(groups) if comm.rank in g)
+        intra = comm.split(mine, key=comm.rank)
+        lead = comm.split(0 if intra.rank == 0 else UNDEFINED,
+                          key=comm.rank)
+        plan = (intra, lead)
+        comm.__dict__["_hier_plan"] = plan
+    return plan
+
+
+def _hier_allreduce(module, comm, x, op):
+    """Reduce inside each slice on-device, combine slice results over
+    the leaders' tcp/OOB host path, fan the total back out on-device.
+    The inter-slice hop moves ONE slice-reduced payload per slice
+    instead of serializing the whole comm through one link."""
+    from ompi_tpu.coll import device
+    intra, lead = _hier_plan(comm)
+    y = intra.allreduce_arr(x, op)
+    if lead is not None:
+        # leaders reduce across slices over the host/OOB path (the
+        # reference's inter-node tier; tcp btl between processes)
+        y = device._host_arr_fallback().allreduce_arr(lead, y, op)
+    pv_hier.add(1)
+    return intra.bcast_arr(y, 0)
+
+
+# ---------------------------------------------------------------------------
+# the entry consulted by coll/device (TpuCollModule / HbmCollModule)
+# ---------------------------------------------------------------------------
+
+def maybe_device_coll(module, comm, kind: str, x, op=None, root=None):
+    """Route one *_arr call to the large-message tier, or return
+    ``UNHANDLED`` (the caller keeps its fused single-dispatch path).
+    Must be comm-consistent: the pick depends only on knobs, the
+    process-wide calibration profile, comm properties and the
+    MPI-matched payload size."""
+    if not _enable_var.value:
+        return UNHANDLED
+    nbytes = int(getattr(x, "nbytes", 0) or 0)
+    if nbytes <= 0 or comm.size < 2:
+        return UNHANDLED
+    from ompi_tpu.coll import tuned
+    alg = tuned.device_algorithm(comm, kind, nbytes,
+                                 op.name if op is not None else None)
+    if alg is None:
+        return UNHANDLED
+    tr = comm.state.tracer
+    t0 = tr.start() if tr is not None else None
+    if module.name == "hbm":
+        if kind == "allreduce":
+            out = _hbm_seg_reduce(module, comm, x, op)
+        elif kind == "alltoall":
+            out = _hbm_seg_alltoall(module, comm, x)
+        else:
+            return UNHANDLED  # hbm bcast: one shared-HBM handoff already
+    elif alg == "hier":
+        out = _hier_allreduce(module, comm, x, op)
+    elif kind == "allreduce":
+        out = _mesh_seg_reduce(module, comm, x, op, alg)
+    elif kind == "bcast":
+        out = _mesh_seg_bcast(module, comm, x, root)
+    elif kind == "alltoall":
+        out = _mesh_seg_alltoall(module, comm, x)
+    else:
+        return UNHANDLED
+    pv_ops.add(1)
+    if t0 is not None:
+        tr.end(t0, f"pipeline_{kind}", "coll_dispatch", cid=comm.cid,
+               nbytes=nbytes, alg=alg)
+    return out

@@ -689,15 +689,24 @@ class SegCollModule(TunedModule):
         for lo in range(0, flat_in.size, per):
             hi = min(lo + per, flat_in.size)
             n = hi - lo
-            piece_in = np.ascontiguousarray(flat_in[lo:hi])
-            piece_out = contig_out[lo:hi]
-            if codes is not None and n % P == 0:
-                stripe = np.empty(n // P, flat_in.dtype)
+            # tail audit (count % segsize != 0, any dtype): only the
+            # ragged REMAINDER (< P elements) may take the every-rank-
+            # folds round — a non-divisible tail piece still runs its
+            # P-divisible head as rs+ag.  head/n depend only on
+            # (count, slot, P): identical on every rank, so the round
+            # structure stays comm-consistent.
+            head = n // P * P
+            if codes is not None and head >= P:
+                piece_in = np.ascontiguousarray(flat_in[lo:lo + head])
+                stripe = np.empty(head // P, flat_in.dtype)
                 self._rs_round(comm, piece_in, stripe, op, codes)
-                self._ag_round(comm, stripe, piece_out)
-            else:
-                self._allreduce_round(comm, piece_in, piece_out, op,
-                                      codes)
+                self._ag_round(comm, stripe, contig_out[lo:lo + head])
+                lo += head
+                n -= head
+            if n:
+                self._allreduce_round(
+                    comm, np.ascontiguousarray(flat_in[lo:hi]),
+                    contig_out[lo:hi], op, codes)
         rb.flush()
         return True
 

@@ -99,6 +99,47 @@ def _oversubscribed(comm) -> bool:
     return verdict
 
 
+def device_algorithm(comm, kind: str, nbytes: int,
+                     opname: Optional[str] = None) -> Optional[str]:
+    """Large-message device-tier pick, the per-communicator analog of
+    the reference's comm-bound module selection: None keeps the fused
+    single-dispatch path (DESIGN.md §8); "hier" routes to the
+    hierarchical tier; "segring"/"segrd"/"segbcast"/"sega2a" route to
+    the segmented pipeline (DESIGN.md §12).
+
+    Comm-consistent by construction — thresholds come from knobs and
+    the process-wide calibration profile, and nbytes is MPI-matched —
+    and cached per comm (a large message should pay one dict hit, not
+    a profile walk, to be routed)."""
+    from ompi_tpu.coll import pipeline
+    tbl = comm.__dict__.get("_pipeline_pick")
+    if tbl is None:
+        tbl = comm.__dict__["_pipeline_pick"] = {}
+    th = tbl.get(kind)
+    if th is None:
+        th = tbl[kind] = (
+            calibrate.segmented_crossover(
+                kind, comm.size, pipeline._min_bytes_var.value),
+            calibrate.hier_min_bytes(
+                comm.size, pipeline._hier_min_var.value),
+        )
+    seg_min, hier_min = th
+    if kind == "allreduce":
+        if nbytes >= hier_min and pipeline.hier_eligible(comm):
+            return "hier"
+        if nbytes >= seg_min:
+            if _is_pow2(comm.size) and \
+                    nbytes < pipeline._rd_max_var.value:
+                return "segrd"
+            return "segring"
+        return None
+    if kind == "bcast" and nbytes >= seg_min:
+        return "segbcast"
+    if kind == "alltoall" and nbytes >= seg_min:
+        return "sega2a"
+    return None
+
+
 class TunedModule(P2PCollModule):
     name = "tuned"
 

@@ -1048,6 +1048,12 @@ class Communicator:
         return self.coll.ppermute_arr(
             self, x, self._require_topo(1).shift_perm(dim, disp, self.size))
 
+    def neighbor_allgather_arr(self, x):
+        """Device-tier halo gather: per-dim ppermute shifts in MPI
+        neighbor order (see topo.neighbor.neighbor_allgather_arr)."""
+        from ompi_tpu.topo import neighbor as nb
+        return nb.neighbor_allgather_arr(self, x)
+
     # -- management shorthands -----------------------------------------
     def Get_rank(self) -> int:
         return self.rank

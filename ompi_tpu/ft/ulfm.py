@@ -544,6 +544,12 @@ _COMM_CACHE_KEYS = (
     "_hbm_plans", "_device_rv", "_device_abort_check",
     "_oversub_verdict", "_mesh_none", "_mesh", "_fusion_engine",
     "_dev_seq",
+    # large-message tier (coll/pipeline + topo): routing thresholds,
+    # hierarchy plans and the cart device mesh all key on the old
+    # group/mesh — segment state must not leak across shrink/respawn
+    # epochs
+    "_pipeline_pick", "_hier_eligible", "_hier_plan",
+    "_cart_device_mesh",
 )
 
 

@@ -155,6 +155,37 @@ def neighbor_alltoallv(comm, sbuf, scounts, sdispls, sdt, rbuf, rcounts,
     rb.flush()
 
 
+# -- device-resident halo exchange (array altitude) -------------------------
+
+def neighbor_allgather_arr(comm, x):
+    """Device-tier MPI-3 neighbor allgather for cartesian comms: one
+    whole-comm ``ppermute`` shift per (dim, direction) over the comm's
+    mesh instead of 2*ndims host-staged p2p messages per rank — the
+    halo-exchange pattern the mesh was built for (DESIGN.md §12).
+
+    Returns an array stacked along a leading axis of length 2*ndims in
+    MPI neighbor order (per dim: the coord-1 source, then the coord+1
+    source).  PROC_NULL neighbors (non-periodic edges) yield a zero
+    block — the array-altitude analog of the untouched recv block.
+    Falls back to host-staged p2p transparently when the comm has no
+    mesh (comm.ppermute_arr routes per shard residency)."""
+    from ompi_tpu.topo.topo import CART
+    topo = _topo(comm)
+    if topo.kind != CART:
+        raise ValueError("neighbor_allgather_arr needs a cartesian "
+                         "topology (MPI_ERR_TOPOLOGY)")
+    import jax.numpy as jnp
+    parts = []
+    for d in range(topo.ndims):
+        # the block "from my coord-1 neighbor" travels via a +1 shift
+        # (its owner sends toward higher coords), and vice versa
+        parts.append(comm.ppermute_arr(x, topo.shift_perm(d, 1,
+                                                          comm.size)))
+        parts.append(comm.ppermute_arr(x, topo.shift_perm(d, -1,
+                                                          comm.size)))
+    return jnp.stack([jnp.asarray(p) for p in parts])
+
+
 # -- nonblocking (single-round nbc schedules) -------------------------------
 
 def _ineighbor(comm, reqs_fn, *finish):

@@ -146,6 +146,37 @@ class CartTopo:
                 perm.append((r, dst))
         return perm
 
+    def device_mesh(self, comm):
+        """N-D jax Mesh whose axes mirror the cart dims — the real
+        cart → device-mesh mapping: rank r's device sits at
+        mesh position rank_to_coords(r), so XLA sees the grid the
+        program's halo pattern assumes (with ``reorder=True`` the
+        ranks were already device-id-sorted, so row-major grid walks
+        the ICI chain).  Axes are named d0..d{n-1}; None when members
+        don't own distinct devices or the grid doesn't cover the
+        comm.  Cached on the comm (ULFM shrink/respawn epochs
+        invalidate it with the other per-comm plans)."""
+        cached = comm.__dict__.get("_cart_device_mesh")
+        if cached is not None:
+            return cached or None
+        mesh = None
+        if self.nnodes == comm.size:
+            devs: Optional[list] = []
+            for g in comm.group:
+                st = comm._peer_state(g)
+                if st is None or st.device is None:
+                    devs = None
+                    break
+                devs.append(st.device)
+            if devs is not None and len({d.id for d in devs}) == len(devs):
+                import numpy as np
+                from jax.sharding import Mesh
+                arr = np.array(devs).reshape(tuple(self.dims))
+                mesh = Mesh(arr, tuple(f"d{i}" for i in range(self.ndims)))
+        comm.__dict__["_cart_device_mesh"] = mesh if mesh is not None \
+            else False
+        return mesh
+
 
 class GraphTopo:
     """MPI-1 graph topology: cumulative index + flat edge list
@@ -234,13 +265,64 @@ def cart_create(comm, dims: Sequence[int], periods=None,
     return sub
 
 
+def _graph_bfs_order(n: int, index: Sequence[int],
+                     edges: Sequence[int]) -> List[int]:
+    """Deterministic BFS linearization of the graph: order[p] is the
+    vertex placed at chain position p.  Neighbors in the graph land at
+    nearby positions, so when positions follow device ids the hot
+    edges ride adjacent chips.  Covers disconnected components by
+    restarting from the lowest unvisited vertex."""
+    adj: List[List[int]] = []
+    prev = 0
+    for v in range(n):
+        adj.append(sorted(int(e) for e in edges[prev:index[v]]))
+        prev = index[v]
+    order: List[int] = []
+    seen = [False] * n
+    for start in range(n):
+        if seen[start]:
+            continue
+        seen[start] = True
+        queue = [start]
+        while queue:
+            v = queue.pop(0)
+            order.append(v)
+            for w in adj[v]:
+                if 0 <= w < n and not seen[w]:
+                    seen[w] = True
+                    queue.append(w)
+    return order
+
+
 def graph_create(comm, index: Sequence[int], edges: Sequence[int],
                  reorder: bool = False):
-    """MPI_Graph_create: nnodes = len(index) participating ranks."""
+    """MPI_Graph_create: nnodes = len(index) participating ranks.
+
+    With ``reorder=True`` the graph is embedded onto the device chain
+    (same treematch analog as cart_create): a BFS linearization
+    assigns each vertex a chain position, and the member owning the
+    p-th device (by id) becomes vertex order[p], so graph-adjacent
+    vertices sit on id-adjacent chips.  The split key IS the vertex
+    id — keys are a permutation of 0..n-1, so the member with key v
+    gets new rank v."""
     n = len(index)
     if n > comm.size:
         raise ValueError("graph larger than communicator")
-    sub = comm.split(0 if comm.rank < n else UNDEFINED_TOPO, comm.rank)
+    key = comm.rank
+    if reorder and n == comm.size:
+        devs = []
+        for g in comm.group:
+            st = comm._peer_state(g)
+            if st is None or st.device is None:
+                devs = None
+                break
+            devs.append(int(st.device.id))
+        if devs is not None and len(set(devs)) == len(devs):
+            order = _graph_bfs_order(n, index, edges)
+            devpos = sorted(range(comm.size),
+                            key=lambda r: devs[r]).index(comm.rank)
+            key = order[devpos]
+    sub = comm.split(0 if comm.rank < n else UNDEFINED_TOPO, key)
     if sub is None:
         return None
     sub.topo = GraphTopo(index, edges)
@@ -316,3 +398,41 @@ def cart_sub(comm, remain_dims: Sequence[bool]):
         new_dims, new_periods = [1], [False]
     sub.topo = CartTopo(new_dims, new_periods, sub.rank)
     return sub
+
+
+def slice_groups(comm, slice_size: int = 0) -> List[List[int]]:
+    """Partition comm ranks into hardware 'slices' for the
+    hierarchical collective tier (DESIGN.md §12): ranks inside a
+    group share fast device interconnect (intra-slice XLA psum);
+    groups talk over the tcp/OOB path.
+
+    ``slice_size > 0`` forces consecutive-rank blocks of that size
+    (explicit shaping for tests and odd deployments).  Auto mode
+    groups by the device's ``slice_index`` attribute when the runtime
+    exposes one, else by the modex ``node_id`` each rank published at
+    init, else a single group (no hierarchy).  Keys feed a
+    first-appearance ordering, so every member — walking the same
+    group list against the same modex data — derives the identical
+    partition: divergence here would split the comm across different
+    algorithm tiers, i.e. deadlock."""
+    if slice_size and slice_size > 0:
+        return [list(range(lo, min(lo + slice_size, comm.size)))
+                for lo in range(0, comm.size, slice_size)]
+    keys: List[object] = []
+    for g in comm.group:
+        k: object = None
+        st = comm._peer_state(g)
+        if st is not None and st.device is not None:
+            k = getattr(st.device, "slice_index", None)
+        if k is None:
+            try:
+                k = comm.state.rte.modex_get(g, "node_id")
+            except (KeyError, LookupError, AttributeError):
+                k = None
+        keys.append(k)
+    if any(k is None for k in keys):
+        return [list(range(comm.size))]
+    groups: dict = {}
+    for r, k in enumerate(keys):
+        groups.setdefault(k, []).append(r)
+    return list(groups.values())

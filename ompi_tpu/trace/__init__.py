@@ -73,10 +73,13 @@ BUCKET_BOUNDS_US = tuple(1 << i for i in range(N_BUCKETS - 1))
 HIST_PROGRESS_TICK = 0
 HIST_COLL_DISPATCH = 1
 HIST_P2P_COMPLETE = 2
-HIST_NAMES = ("progress_tick", "coll_dispatch", "p2p_complete")
+HIST_COLL_SEGMENT = 3  # per-segment rendezvous latency (pipeline tier)
+HIST_NAMES = ("progress_tick", "coll_dispatch", "p2p_complete",
+              "coll_segment")
 
 # span category -> histogram fed automatically by Tracer.end()
-_CAT_HIST = {"coll_dispatch": HIST_COLL_DISPATCH, "p2p": HIST_P2P_COMPLETE}
+_CAT_HIST = {"coll_dispatch": HIST_COLL_DISPATCH, "p2p": HIST_P2P_COMPLETE,
+             "coll_segment": HIST_COLL_SEGMENT}
 
 
 class Tracer:
@@ -322,6 +325,11 @@ registry.register_pvar(
     "trace", "", "hist_p2p_complete", var_class="size",
     help="Point-to-point activate-to-complete latency histogram",
     getter=_tr_hist(HIST_P2P_COMPLETE))
+registry.register_pvar(
+    "trace", "", "hist_coll_segment", var_class="size",
+    help="Per-segment rendezvous latency histogram of the pipelined "
+         "large-message tier (log2 us buckets)",
+    getter=_tr_hist(HIST_COLL_SEGMENT))
 
 
 # -- shared collective/nbc instrumentation points ---------------------------

@@ -41,6 +41,23 @@ def test_collseg_two_ranks():
     _run(2)
 
 
+@pytest.mark.parametrize("rem", [0, 1, -1],
+                         ids=["exact", "plus1", "piece-minus1"])
+def test_collseg_chunked_tail_matrix(rem):
+    """Tail-segment audit (DESIGN.md §12 satellite): chunked
+    allreduce/bcast counts with count % piece in {0, 1, piece-1}
+    across int8/float16/float32/float64, on a non-power-of-two comm —
+    the ragged remainder must round-trip exactly and the P-divisible
+    head must still take the split rs+ag rounds."""
+    prog = os.path.join(REPO, "tests", "_collseg_tails_prog.py")
+    r = mpirun_run(5, prog, str(rem),
+                   mca=(("coll_seg_slot_bytes", "16384"),),
+                   timeout=240, job_timeout=200)
+    assert r.returncode == 0, r.stderr.decode()[-2000:]
+    assert b"collseg tails ok" in r.stdout, \
+        r.stdout.decode()[-500:] + r.stderr.decode()[-1500:]
+
+
 def test_native_path_engages_under_mpirun():
     """The C segment hot path must actually serve mpirun process
     ranks — asserted via the coll_seg_native_ops pvar (a silent
