@@ -202,22 +202,30 @@ def main() -> None:
         line = {
             "metric": f"trace overhead, {probe['nranks']} ranks x "
                       f"{probe['payload_bytes']} B allreduce "
-                      f"(best-of-{probe['reps']} interleaved)",
+                      f"(median-of-{probe['blocks_per_side']} "
+                      f"interleaved in-world blocks)",
             "value": probe["overhead_pct"],
             "unit": "pct_vs_untraced",
+            "overhead_pct_best": probe["overhead_pct_best"],
+            "off_us_median": probe["off_us_median"],
+            "on_us_median": probe["on_us_median"],
             "off_us_per_op": probe["off_us_per_op"],
             "on_us_per_op": probe["on_us_per_op"],
+            "host_cores": probe["host_cores"],
+            "gil_enabled": probe["gil_enabled"],
             "within_budget": probe["within_budget"],
         }
         line.update({k: v for k, v in notes.items() if "error" in k})
         sys.stderr.write(json.dumps(probe, indent=1) + "\n")
         print(json.dumps(line))
         if not probe["within_budget"]:
-            # the acceptance contract: >5% tracing overhead is a
-            # regression, and it fails LOUDLY, never as a footnote
+            # the acceptance contract: >5% MEDIAN tracing overhead is
+            # a regression, and it fails LOUDLY, never as a footnote
+            # (best-of is reported for context but never gates)
             sys.stderr.write(
-                f"FAIL: tracing overhead {probe['overhead_pct']}% "
-                f"exceeds the {probe['budget_pct']}% budget\n")
+                f"FAIL: median tracing overhead "
+                f"{probe['overhead_pct']}% exceeds the "
+                f"{probe['budget_pct']}% budget\n")
             sys.exit(1)
         return
 

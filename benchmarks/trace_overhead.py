@@ -5,124 +5,177 @@ cost when ``trace_enable`` is off — a single attribute-is-None check
 on each instrumented hot path — and bounded, never-blocking cost when
 on.  This probe quantifies both sides on the small-message path where
 per-op overhead is largest relative to the work: a 4-rank thread-rank
-world looping small host Allreduces (coll shim + pml p2p + progress
-ticks all traced).
+world looping small host Allreduces (coll shim + device dispatch +
+progress ticks all traced).
 
-Methodology: tracing off and on are measured in INTERLEAVED reps
-(off, on, off, on, ...) so slow drift on a noisy box hits both sides
-equally, and each side reports its best (minimum) per-op time — the
-contamination-free floor is what the overhead delta means, not the
-scheduler-noise mean.  Inside the traced world, rank 0 snapshots the
-latency-histogram pvars and span counts, which land in
-BENCH_DETAIL.json under ``trace_overhead``.
+Methodology: ONE world, tracing flipped between INTERLEAVED blocks
+(off, on, off, on, ...) inside it.  Separate worlds land in different
+scheduler/placement modes on a small box — the mode spread (±15%%
+observed on a 1-core host) buries a 5%% effect; paired blocks inside
+one world share the mode and cancel it.  The acceptance bound is
+judged on the MEDIAN over block pairs: a best-of comparison rewards
+one lucky quiet block, while the median is what a user actually
+pays (best-of is still reported for context).  Before the measured
+blocks the adaptive sampler is ramped to steady state over
+``RAMP_OPS`` traced ops (disclosed in the JSON) — the budget is the
+long-run cost of always-on tracing, with the transient's length
+reported honestly rather than averaged invisibly into it.
+
+The JSON also records the host core count and whether the GIL is
+active, because thread-rank worlds on a GIL build serialize every
+rank through one interpreter lock — the harshest (most honest)
+setting for per-op bookkeeping overhead.  Rank 0 snapshots the
+latency-histogram, sampling-rate, and per-category dropped pvars,
+which land in BENCH_DETAIL.json under ``trace_overhead``.
 
 The 5%% budget is enforced LOUDLY: ``bench.py --trace-overhead``
-exits nonzero when the measured ON-overhead exceeds it.
+exits nonzero when the MEDIAN overhead exceeds it.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import statistics
+import sys
 import time
 from typing import Dict
 
 NRANKS = 4
-OPS = 400          # allreduces per measured rep
-WARMUP = 20
-REPS = 5           # interleaved off/on pairs
-BUDGET_PCT = 5.0   # acceptance bound for the ON path
+WARMUP = 50        # untimed JIT/cache warm ops before anything else
+RAMP_OPS = 8000    # traced ops to carry the adaptive sampler to its
+                   # steady state (period doubles every
+                   # trace_sample_auto seen, to trace_sample_max)
+BLOCK_OPS = 2000   # allreduces per measured block
+BLOCKS = 5         # interleaved off/on block pairs
+BUDGET_PCT = 5.0   # acceptance bound for the ON path (median)
 
 
-def _measure_world(traced: bool) -> Dict:
-    """One thread-rank world; returns rank 0's timing (every rank
-    loops — the collective synchronizes each op) plus, when traced,
-    the histogram/span snapshot taken INSIDE the world (pvar getters
-    resolve through the current rank's state)."""
+def _probe_world() -> Dict:
+    """One thread-rank world alternating untraced/traced blocks;
+    returns rank 0's per-block timings (every rank loops — the
+    collective synchronizes each op) plus the histogram/span/sampling
+    snapshot taken INSIDE the world (pvar getters resolve through the
+    current rank's state)."""
     import numpy as np
 
-    from ompi_tpu.mca.params import registry
     from ompi_tpu.op.op import SUM
     from ompi_tpu.testing import run_ranks
-
-    registry.set("trace_enable", "1" if traced else "0")
-    if traced:
-        # big enough that the measured loop never wraps: a drop-heavy
-        # ring would under-report the recording cost
-        registry.set("trace_buffer_events", str(max(8192, OPS * 8)))
 
     def fn(comm):
         sbuf = np.ones(8, dtype=np.float32)
         rbuf = np.zeros(8, dtype=np.float32)
+        tr = comm.state.tracer
+        assert tr is not None  # world starts traced (trace_enable=1)
         for _ in range(WARMUP):
             comm.Allreduce(sbuf, rbuf, SUM)
-        comm.Barrier()
-        t0 = time.perf_counter()
-        for _ in range(OPS):
+        for _ in range(RAMP_OPS):
             comm.Allreduce(sbuf, rbuf, SUM)
-        dt = time.perf_counter() - t0
-        out: Dict = {"us_per_op": dt / OPS * 1e6}
+        off_blocks, on_blocks = [], []
+        for b in range(BLOCKS * 2):
+            traced = bool(b & 1)
+            comm.Barrier()
+            # every rank flips ITS OWN state: the shim and the device
+            # dispatch read state.tracer per call, so None here is
+            # exactly the trace-off contract (one is-None check)
+            comm.state.tracer = tr if traced else None
+            comm.Barrier()
+            t0 = time.perf_counter()
+            for _ in range(BLOCK_OPS):
+                comm.Allreduce(sbuf, rbuf, SUM)
+            dt = time.perf_counter() - t0
+            (on_blocks if traced else off_blocks).append(
+                dt / BLOCK_OPS * 1e6)
+        comm.state.tracer = tr
+        comm.Barrier()
+        out: Dict = {"off_us_blocks": off_blocks,
+                     "on_us_blocks": on_blocks}
         if comm.rank != 0:
             return out
-        if traced:
-            from ompi_tpu import mpit, trace
-            tr = comm.state.tracer
-            out["spans"] = {cat: tr.span_count(cat)
-                            for cat in ("coll", "p2p")}
-            out["recorded"] = tr.recorded
-            out["dropped"] = tr.dropped
-            # snapshot through MPI_T itself (not the Tracer object):
-            # the pvar surface is what bench consumers get
-            mpit.init_thread()
-            try:
-                sess = mpit.pvar_session_create()
-                out["hists"] = {}
-                for name in trace.HIST_NAMES:
-                    ph = mpit.pvar_handle_alloc(
-                        sess, f"trace_hist_{name}")
-                    out["hists"][name] = mpit.pvar_read(ph)
-                mpit.pvar_session_free(sess)
-            finally:
-                mpit.finalize()
-        else:
-            # the off-side contract, asserted where it is measured
-            assert comm.state.tracer is None
+        from ompi_tpu import mpit, trace
+        out["spans"] = {cat: tr.span_count(cat)
+                        for cat in ("coll", "coll_dispatch", "p2p")}
+        out["recorded"] = tr.recorded
+        out["dropped"] = tr.dropped
+        # snapshot through MPI_T itself (not the Tracer object): the
+        # pvar surface is what bench consumers get
+        mpit.init_thread()
+        try:
+            sess = mpit.pvar_session_create()
+            out["hists"] = {}
+            for name in trace.HIST_NAMES:
+                ph = mpit.pvar_handle_alloc(
+                    sess, f"trace_hist_{name}")
+                out["hists"][name] = mpit.pvar_read(ph)
+            out["sampling"] = mpit.pvar_read(
+                mpit.pvar_handle_alloc(sess, "trace_sampling_rate"))
+            out["dropped_by_cat"] = {
+                cat: mpit.pvar_read(mpit.pvar_handle_alloc(
+                    sess, f"trace_dropped_{cat}"))
+                for cat in trace.SPAN_CATS}
+            mpit.pvar_session_free(sess)
+        finally:
+            mpit.finalize()
         return out
 
-    return run_ranks(NRANKS, fn, timeout=300)[0]
+    return run_ranks(NRANKS, fn, timeout=600)[0]
 
 
 def run_probe() -> Dict:
     from ompi_tpu.mca.params import registry
 
-    off_times, on_times = [], []
-    snap: Dict = {}
+    registry.set("trace_enable", "1")
+    # big enough that KEPT spans never wrap (the sampler caps kept
+    # volume at ~2k per category): a drop-heavy ring would
+    # under-report the recording cost
+    registry.set("trace_buffer_events", "16384")
+    # the probe measures tracing alone: the autotune loop (its own lp
+    # callback + periodic folds) must not ride along on either side
+    registry.set("coll_autotune_enable", "0")
     try:
-        for _ in range(REPS):
-            off_times.append(_measure_world(False)["us_per_op"])
-            on = _measure_world(True)
-            on_times.append(on["us_per_op"])
-            snap = on  # keep the freshest traced snapshot
+        snap = _probe_world()
     finally:
         registry.set("trace_enable", "0")
+    off_times = snap["off_us_blocks"]
+    on_times = snap["on_us_blocks"]
     off_us = min(off_times)
     on_us = min(on_times)
-    overhead = (on_us - off_us) / off_us * 100.0
+    off_med = statistics.median(off_times)
+    on_med = statistics.median(on_times)
+    overhead_best = (on_us - off_us) / off_us * 100.0
+    overhead_med = (on_med - off_med) / off_med * 100.0
+    gil = getattr(sys, "_is_gil_enabled", lambda: True)()
     return {
         "nranks": NRANKS,
-        "ops_per_rep": OPS,
-        "reps": REPS,
+        "ops_per_block": BLOCK_OPS,
+        "blocks_per_side": BLOCKS,
+        "ramp_ops": RAMP_OPS,
         "payload_bytes": 32,
+        "host_cores": os.cpu_count(),
+        "gil_enabled": bool(gil),
+        "gil_note": ("thread ranks share one GIL: per-op bookkeeping "
+                     "is fully serialized (worst case for overhead)"
+                     if gil else
+                     "free-threaded build: ranks overlap, overhead "
+                     "partially hides"),
         "off_us_per_op": round(off_us, 2),
         "on_us_per_op": round(on_us, 2),
+        "off_us_median": round(off_med, 2),
+        "on_us_median": round(on_med, 2),
         "off_us_all": [round(x, 2) for x in off_times],
         "on_us_all": [round(x, 2) for x in on_times],
-        "overhead_pct": round(overhead, 2),
+        "overhead_pct_best": round(overhead_best, 2),
+        # the acceptance number: median vs median (overhead_pct keeps
+        # its historical name so BENCH_DETAIL consumers stay working,
+        # but it now carries the median — the honest figure)
+        "overhead_pct": round(overhead_med, 2),
         "budget_pct": BUDGET_PCT,
-        "within_budget": bool(overhead <= BUDGET_PCT),
+        "within_budget": bool(overhead_med <= BUDGET_PCT),
         "traced_spans": snap.get("spans", {}),
         "traced_recorded": snap.get("recorded", 0),
         "traced_dropped": snap.get("dropped", 0),
+        "sampling_pvars": snap.get("sampling", {}),
+        "dropped_by_cat_pvars": snap.get("dropped_by_cat", {}),
         "hist_pvars": snap.get("hists", {}),
     }
 

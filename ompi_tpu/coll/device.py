@@ -39,11 +39,19 @@ from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
+from ompi_tpu import trace as _trace
 from ompi_tpu.coll.framework import CollComponent, CollModule, coll_framework
 from ompi_tpu.pml.monitoring import count_offload
 from ompi_tpu.coll.tuned import TunedModule
 from ompi_tpu.mca.params import registry
 from ompi_tpu.op.op import MAX, MIN, PROD, SUM, Op
+
+# trace ids as module constants: meet() runs once per device
+# collective and must not pay module-attribute lookups for them
+_CAT_DISP = _trace.CAT_COLL_DISPATCH
+_CAT_SEG = _trace.CAT_COLL_SEGMENT
+_NAME_MEET = _trace.NAME_MEET
+_NAME_SEG_MEET = _trace.NAME_SEG_MEET
 
 _prio_tpu = registry.register(
     "coll", "tpu", "priority", 80, int,
@@ -488,13 +496,23 @@ def meet(comm, value, fn, abort_check) -> Any:
     # dispatch span: entry->rendezvous-release of the device fast path
     # (cat coll_dispatch feeds the dispatch-latency histogram); the
     # per-comm sequence number is the straggler correlation key
-    seq = comm.__dict__.get("_dev_seq", 0)
-    comm.__dict__["_dev_seq"] = seq + 1
-    t0 = tr.start()
+    seq = comm._dev_seq
+    comm._dev_seq = seq + 1
+    # inlined start_sampled skip branch (the steady-state common case;
+    # see trace.coll_begin) — the sampled-out cost of the dispatch
+    # span is two list ops, no method call, no clock read
+    ctr = tr._ctr
+    c = ctr[_CAT_DISP]
+    if c:
+        ctr[_CAT_DISP] = c - 1
+        tr._skipped[_CAT_DISP] += 1
+        t0 = 0
+    else:
+        t0 = tr.start_sampled(_CAT_DISP)
     out = rv.run(comm.rank, value, fn, abort_check,
                  progress=comm.state.progress)
-    tr.end(t0, "meet", "coll_dispatch", cid=comm.cid, seq=seq,
-           nbytes=nbytes)
+    if t0:
+        tr.end(t0, _NAME_MEET, _CAT_DISP, comm.cid, seq, nbytes)
     return out
 
 
@@ -516,7 +534,7 @@ def meet_begin(comm, value, fn, abort_check):
     nbytes = int(getattr(value, "nbytes", 0) or 0)
     count_offload(comm, nbytes)
     tr = comm.state.tracer
-    t0 = tr.start() if tr is not None else None
+    t0 = tr.start_sampled(_CAT_SEG) if tr is not None else 0
     gen = rv.begin(comm.rank, value, fn, abort_check,
                    progress=comm.state.progress, dispatch_async=True)
     return (rv, gen, t0, nbytes)
@@ -529,13 +547,14 @@ def meet_finish(comm, handle, abort_check) -> Any:
     rv, gen, t0, nbytes = handle
     out = rv.finish(comm.rank, gen, abort_check,
                     progress=comm.state.progress)
-    if t0 is not None:
-        tr = comm.state.tracer
-        if tr is not None:
-            seq = comm.__dict__.get("_dev_seq", 0)
-            comm.__dict__["_dev_seq"] = seq + 1
-            tr.end(t0, "seg_meet", "coll_segment", cid=comm.cid,
-                   seq=seq, nbytes=nbytes)
+    tr = comm.state.tracer
+    if tr is not None:
+        # the seq ticks on EVERY traced segment (sampled out or not)
+        # so surviving spans keep cross-rank-aligned correlation keys
+        seq = comm._dev_seq
+        comm._dev_seq = seq + 1
+        if t0:
+            tr.end(t0, _NAME_SEG_MEET, _CAT_SEG, comm.cid, seq, nbytes)
     return out
 
 
@@ -641,14 +660,14 @@ class CompiledLRU:
                 return fn
         self.pv_misses.add(1)
         self.builds += 1
-        from ompi_tpu import trace as _trace
         tr = _trace.current_tracer()
         if tr is None:
             fn = builder()
         else:
             t0 = tr.start()
             fn = builder()
-            tr.end(t0, "xla_compile", "compile", key=str(key[0]))
+            tr.end(t0, _trace.NAME_XLA_COMPILE, _trace.CAT_COMPILE,
+                   _trace.intern_name(str(key[0])))
         with self._lock:
             self._d[key] = fn
             self._d.move_to_end(key)

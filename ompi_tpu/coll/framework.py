@@ -86,6 +86,13 @@ def _instrumented(fname: str, fn):
     shimmed (their lifecycle is observed by the nbc hooks instead)."""
     from ompi_tpu import trace
 
+    # intern once at wrap time: the shim passes a small int on the
+    # hot path, never a string; the hook functions bind into the
+    # closure so each call skips the module attribute lookups
+    fid = trace.intern_name(fname, ("cid", "seq"))
+    _begin = trace.coll_begin
+    _end = trace.coll_end
+
     def shim(comm, *args, **kwargs):
         pr = comm.state.progress
         if pr.interrupt is not None:
@@ -104,11 +111,15 @@ def _instrumented(fname: str, fn):
             # once a failure record has actually arrived.
             u.poll()
             u.check_comm(comm)
-        tok = trace.coll_begin(comm, fname)
+        tok = _begin(comm, fid)
         if tok is None:
             return fn(comm, *args, **kwargs)
         out = fn(comm, *args, **kwargs)
-        trace.coll_end(comm, fname, tok)
+        if tok:
+            # falsy tok == sampled out: nothing to close, skip the
+            # coll_end call itself (kept-span tokens and peruse tuples
+            # are always truthy)
+            _end(comm, fid, tok)
         return out
 
     shim._coll_inner = fn  # the unwrapped provider, for introspection

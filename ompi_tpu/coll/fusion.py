@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from ompi_tpu import trace as _trace
 from ompi_tpu.mca.params import registry
 from ompi_tpu.op.op import Op
 from ompi_tpu.pml.request import Request
@@ -281,16 +282,16 @@ class _FusionEngine:
             return
         batch, self.pending = self.pending, []
         tr = self.comm.state.tracer
-        t0 = tr.start() if tr is not None else None
+        t0 = tr.start_sampled(_trace.CAT_COLL) if tr is not None else 0
         try:
             outs = self._run(batch)
         except BaseException as e:  # noqa: BLE001
             for p in batch:
                 p.req._fail(e)
             raise
-        if tr is not None:
-            tr.end(t0, "fused_flush", "coll", cid=self.comm.cid,
-                   ops=len(batch))
+        if t0:
+            tr.end(t0, _trace.NAME_FUSED_FLUSH, _trace.CAT_COLL,
+                   self.comm.cid, len(batch))
         nbytes = 0
         for p, out in zip(batch, outs):
             nbytes += p.nbytes
@@ -312,7 +313,7 @@ class _FusionEngine:
 
         comm = self.comm
         tr = comm.state.tracer
-        t0 = tr.start() if tr is not None else None
+        t0 = tr.start_sampled(_trace.CAT_COLL) if tr is not None else 0
         mesh = comm.mesh()
         my_dev = mesh.devices.reshape(-1)[comm.rank]
         groups, folds = _group_plan(sig)
@@ -333,9 +334,9 @@ class _FusionEngine:
                 deposit.append(packfn(*[jax.device_put(a, my_dev)
                                         for a in args]))
         deposit.extend(batch[i].x for i in folds)
-        if tr is not None:
-            tr.end(t0, "fused_pack", "coll", cid=comm.cid,
-                   groups=len(groups), slots=len(sig))
+        if t0:
+            tr.end(t0, _trace.NAME_FUSED_PACK, _trace.CAT_COLL,
+                   comm.cid, len(groups), len(sig))
         return deposit
 
     def _run(self, batch):

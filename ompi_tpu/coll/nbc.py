@@ -95,7 +95,7 @@ class NBCRequest(Request):
         # activate->complete span + PERUSE nbc events (shared hook;
         # None after one flag check when both systems are off)
         from ompi_tpu import trace as _tracemod
-        self._trace_tok = _tracemod.nbc_begin(comm, "nbc")
+        self._trace_tok = _tracemod.nbc_begin(comm)
         self._start_next_round()
         if not self.complete:
             _nbc_state(comm.state).add(self)

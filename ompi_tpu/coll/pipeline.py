@@ -59,7 +59,16 @@ from typing import Any, Callable, List, Optional, Tuple
 
 import numpy as np
 
+from ompi_tpu import trace as _trace
 from ompi_tpu.mca.params import registry
+
+# interned span names for the per-kind dispatch spans (args: cid,
+# payload bytes, interned algorithm tag)
+_PIPE_NAME = {
+    kind: _trace.intern_name(f"pipeline_{kind}",
+                             ("cid", "nbytes", "alg$"))
+    for kind in ("allreduce", "bcast", "alltoall")
+}
 
 _seg_size_var = registry.register(
     "coll", "seg", "size", 1 << 20, int,
@@ -582,7 +591,8 @@ def maybe_device_coll(module, comm, kind: str, x, op=None, root=None):
     if alg is None:
         return UNHANDLED
     tr = comm.state.tracer
-    t0 = tr.start() if tr is not None else None
+    t0 = tr.start_sampled(_trace.CAT_COLL_DISPATCH) \
+        if tr is not None else 0
     if module.name == "hbm":
         if kind == "allreduce":
             out = _hbm_seg_reduce(module, comm, x, op)
@@ -601,7 +611,7 @@ def maybe_device_coll(module, comm, kind: str, x, op=None, root=None):
     else:
         return UNHANDLED
     pv_ops.add(1)
-    if t0 is not None:
-        tr.end(t0, f"pipeline_{kind}", "coll_dispatch", cid=comm.cid,
-               nbytes=nbytes, alg=alg)
+    if t0:
+        tr.end(t0, _PIPE_NAME[kind], _trace.CAT_COLL_DISPATCH,
+               comm.cid, nbytes, _trace.intern_name(alg))
     return out

@@ -84,6 +84,13 @@ class Group:
 
 
 class Communicator:
+    # per-comm monotone span-correlation counters (ompi_tpu/trace).
+    # Class-level defaults so the hot paths read/write them as plain
+    # attributes — no dict.get() call — while the ULFM epoch purge can
+    # still pop the instance entries and fall back to zero.
+    _coll_seq = 0
+    _dev_seq = 0
+
     def __init__(self, state, cid: int, group: Group, name: str = "") -> None:
         self.state = state
         self.cid = cid

@@ -552,6 +552,21 @@ _COMM_CACHE_KEYS = (
     "_cart_device_mesh",
 )
 
+# the subset safe to purge while a comm stays LIVE: pure routing
+# thresholds whose recompute is rank-local (coll/autotune re-resolves
+# them online when the calibrate profile moves).  _hier_plan and the
+# rendezvous caches are NOT here — their rebuild is collective
+# (subcomm construction) and may only happen at epoch boundaries.
+SELECTION_CACHE_KEYS = ("_pipeline_pick",)
+
+
+def purge_comm_caches(comm, keys=_COMM_CACHE_KEYS) -> None:
+    """Drop per-comm cached plans/verdicts.  The full key list is the
+    shrink/respawn epoch boundary; callers on a live comm must pass
+    SELECTION_CACHE_KEYS (see above)."""
+    for k in keys:
+        comm.__dict__.pop(k, None)
+
 
 def _invalidate(comm) -> None:
     """Drop everything keyed on the dying comm's group/mesh: cached
@@ -567,8 +582,7 @@ def _invalidate(comm) -> None:
             device.compile_cache.drop_mesh(dev_key)
         except Exception:  # noqa: BLE001 — cache hygiene, never fatal
             pass
-    for k in _COMM_CACHE_KEYS:
-        comm.__dict__.pop(k, None)
+    purge_comm_caches(comm)
     world = getattr(comm.state.rte, "world", None)
     if world is not None and hasattr(world, "shared"):
         with world.shared_lock:

@@ -32,6 +32,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from ompi_tpu import memchecker, peruse
+from ompi_tpu import trace as _trace
 from ompi_tpu.datatype.convertor import Convertor, make_convertor
 from ompi_tpu.mca.base import Component, frameworks
 from ompi_tpu.mca.params import registry
@@ -39,6 +40,12 @@ from .request import (ANY_SOURCE, ANY_TAG, PROC_NULL, ERR_TRUNCATE,
                       CompletedRequest, Request, Status)
 
 pml_framework = frameworks.create("ompi", "pml")
+
+# interned trace ids as module constants: the span call sites pass
+# small ints, never strings, on the hot path
+_CAT_P2P = _trace.CAT_P2P
+_NAME_SEND = _trace.NAME_SEND
+_NAME_RECV = _trace.NAME_RECV
 
 registry.register(
     "pml", "ob1", "rsend_is_standard", True, bool,
@@ -80,7 +87,7 @@ class SendRequest(Request):
         self.total = conv.packed_size
         self.dst = dst           # GLOBAL rank (failure matching)
         self.cid = cid           # communicator id (revoke matching)
-        self.tr = None  # (t0, mid) while a span tracer is attached
+        self.tr = None  # (t0_ns, cid, src, tag, seq) while traced
 
 
 class RecvRequest(Request):
@@ -91,7 +98,7 @@ class RecvRequest(Request):
     def __init__(self, progress, conv, req_id, src, tag, cid):
         super().__init__(progress)
         self._canceller = None
-        self.tr = None  # [t0, mid] while a span tracer is attached
+        self.tr = None  # [t0_ns, cid, src, tag, seq] while traced
         self.conv = conv
         self.req_id = req_id
         self.src = src
@@ -223,9 +230,12 @@ class PmlOb1:
             peruse.fire("req_activate", kind="send", cid=cid, peer=dst,
                         tag=tag, bytes=conv.packed_size)
         if self._tracer is not None:
-            # mid = the match id: identical on the receiver's span, so
-            # traceview can stitch the two ranks' timelines together
-            req.tr = (self._tracer.start(), f"{cid}:{src}:{tag}:{seq}")
+            # the match-id components (identical on the receiver's
+            # span) ride as ints; the mid string traceview stitches
+            # on is synthesized at snapshot time, off the hot path
+            t0 = self._tracer.start_sampled(_CAT_P2P)
+            if t0:
+                req.tr = (t0, cid, src, tag, seq)
 
         gsrc = self.state.rank  # global sender id (C/R bookkeeping)
         if conv.packed_size <= btl.eager_limit and mode != MODE_SYNC:
@@ -238,7 +248,7 @@ class PmlOb1:
                 peruse.fire("req_complete", kind="send",
                             bytes=req.total)
             if req.tr is not None:
-                self._trace_p2p_end(req, "send", req.total)
+                self._trace_p2p_end(req, _NAME_SEND, req.total)
         elif conv.packed_size <= btl.eager_limit:  # sync eager
             payload = conv.pack_bytes()
             self._send_reqs[req_id] = req
@@ -325,8 +335,11 @@ class PmlOb1:
             peruse.fire("req_activate", kind="recv", cid=comm.cid,
                         peer=src, tag=tag, bytes=conv.packed_size)
         if self._tracer is not None:
-            # mid filled at match time (_bind) once src/seq are known
-            req.tr = [self._tracer.start(), None]
+            # match-id ints filled at match time (_bind) once the
+            # sender's src/seq are known
+            t0 = self._tracer.start_sampled(_CAT_P2P)
+            if t0:
+                req.tr = [t0, 0, 0, 0, 0]
         if memchecker.enabled() and buf is not None:
             memchecker.poison_recv(conv)
         # match against buffered unexpected messages first
@@ -448,7 +461,11 @@ class PmlOb1:
         req.status.source = msg.src
         req.status.tag = msg.tag
         if req.tr is not None:
-            req.tr[1] = f"{msg.cid}:{msg.src}:{msg.tag}:{msg.seq}"
+            rt = req.tr
+            rt[1] = msg.cid
+            rt[2] = msg.src
+            rt[3] = msg.tag
+            rt[4] = msg.seq
         capacity = req.conv.packed_size
         req.expected = min(msg.total, capacity)
         if msg.total > capacity:
@@ -471,12 +488,13 @@ class PmlOb1:
             req.status.count = min(msg.total, capacity)
             self._finish_recv(req)
 
-    def _trace_p2p_end(self, req, name: str, nbytes: int) -> None:
+    def _trace_p2p_end(self, req, name_id: int, nbytes: int) -> None:
         """Close a p2p span (activate → complete); feeds the
         p2p_complete latency histogram through the tracer."""
-        t0, mid = req.tr
+        t0, cid, src, tag, seq = req.tr
         req.tr = None
-        self._tracer.end(t0, name, "p2p", mid=mid, bytes=nbytes)
+        self._tracer.end(t0, name_id, _CAT_P2P, cid, src, tag, seq,
+                         nbytes)
 
     def _finish_recv(self, req: RecvRequest) -> None:
         self._recv_reqs.pop(req.req_id, None)
@@ -485,7 +503,7 @@ class PmlOb1:
             peruse.fire("req_complete", kind="recv",
                         bytes=req.status.count)
         if req.tr is not None:
-            self._trace_p2p_end(req, "recv", req.status.count)
+            self._trace_p2p_end(req, _NAME_RECV, req.status.count)
 
     def state_comm_peer(self, cid: int, comm_rank: int) -> int:
         comm = self.state.comms.get(cid)
@@ -537,7 +555,7 @@ class PmlOb1:
                     peruse.fire("req_complete", kind="send",
                                 bytes=req.total)
                 if req.tr is not None:
-                    self._trace_p2p_end(req, "send", req.total)
+                    self._trace_p2p_end(req, _NAME_SEND, req.total)
         elif kind == FRAG:
             _, rreq_id, pos, payload = frag
             self._recv_segment(rreq_id, pos, payload)
@@ -671,7 +689,7 @@ class PmlOb1:
         if peruse.enabled:
             peruse.fire("req_complete", kind="send", bytes=req.total)
         if req.tr is not None:
-            self._trace_p2p_end(req, "send", req.total)
+            self._trace_p2p_end(req, _NAME_SEND, req.total)
 
     def _recv_segment(self, rreq_id: int, pos: int, payload: bytes) -> None:
         req = self._recv_reqs.get(rreq_id)
@@ -907,7 +925,7 @@ class PmlOb1:
                 req.status.error = err
                 req._complete()
                 if req.tr is not None:
-                    self._trace_p2p_end(req, "send", 0)
+                    self._trace_p2p_end(req, _NAME_SEND, 0)
                 n += 1
         for req in list(self._recv_reqs.values()):
             err = 0
@@ -933,7 +951,7 @@ class PmlOb1:
                 req.status.error = err
                 req._complete()
                 if req.tr is not None:
-                    self._trace_p2p_end(req, "recv", 0)
+                    self._trace_p2p_end(req, _NAME_RECV, 0)
                 n += 1
         return n
 

@@ -31,6 +31,11 @@ def mpi_init(state: ProcState, device=None) -> ProcState:
     # trace_enable is off — the whole hot-path cost)
     from ompi_tpu import trace as _trace
     _trace.attach(state)
+    # online autotune attach rides DIRECTLY on the trace attach (it
+    # force-attaches a tracer when trace_enable is off) so the pml/
+    # coll layers below still cache a non-None state.tracer
+    from ompi_tpu.coll import autotune as _autotune
+    _autotune.attach(state)
     # debugger attach support (MPIR analog, ref: ompi/debuggers):
     # SIGUSR1 dumps every thread's stack to stderr so
     # ompi_tpu.tools.attach --stacks can show where a hung job is
@@ -241,6 +246,10 @@ def mpi_finalize(state: ProcState) -> None:
         _fin_ulfm.purge_store(state)
     for m in state.btls:
         m.finalize()
+    # autotune deregistration before the tracer dump: the process
+    # tuner must stop reading this world's histograms
+    from ompi_tpu.coll import autotune as _autotune
+    _autotune.detach(state)
     state.rte.finalize()
     # trace dump LAST: teardown spans (flush rendezvous, btl close)
     # are part of the timeline

@@ -270,8 +270,8 @@ class Progress:
             # per sweep measurably slow every other rank on a shared
             # core.  The histogram stays representative; the sweeps it
             # skips are statistically identical to the ones it keeps.
-            _t0 = time.perf_counter() if (self._counter & 15) == 0 \
-                else 0.0
+            _t0 = time.perf_counter_ns() if (self._counter & 15) == 0 \
+                else 0
         self._counter += 1
         events = 0
         for cb in list(self._callbacks):
@@ -280,7 +280,7 @@ class Progress:
             for cb in list(self._lp_callbacks):
                 events += cb()
         if tr is not None and _t0:
-            tr.tick(time.perf_counter() - _t0)
+            tr.tick_ns(time.perf_counter_ns() - _t0)
         return events
 
     def idle_tick(self, timeout: float = 0.002) -> None:

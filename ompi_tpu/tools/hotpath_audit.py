@@ -1,0 +1,188 @@
+"""hotpath_audit: AST lint holding the trace hot path to its budget.
+
+The always-on tracing budget (DESIGN.md §9) is enforced structurally:
+the functions that run once per message / per collective may not
+allocate container objects, build strings, or read the wall clock.
+Reviewing that by eye does not survive refactors, so tier-1 tests run
+this audit and fail when a hot function regresses.
+
+Banned inside a declared hot function:
+
+  * tuple / list displays in Load context (allocation per call) —
+    Store-context targets (``a, b = req.tr``) are unpacking, not
+    allocation, and stay legal
+  * dict / set displays and every comprehension flavor
+  * f-strings and string concatenation via ``%`` / ``.format`` calls
+  * calls to the ``dict`` / ``list`` / ``set`` / ``tuple`` /
+    ``frozenset`` builtins
+  * any reference to ``time.time`` (including sneaking it in via a
+    default argument) — hot timestamps are ``perf_counter_ns`` only
+
+Usage: ``python -m ompi_tpu.tools.hotpath_audit`` exits nonzero and
+prints one line per violation; ``audit()`` returns them as a list for
+the tier-1 test.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from typing import Dict, List, Tuple
+
+# (module path relative to the package root, {qualified function: ...})
+# Qualified names are "Class.method" or bare "function".
+HOT_FUNCTIONS: Dict[str, Tuple[str, ...]] = {
+    "ompi_tpu/trace/__init__.py": (
+        "Tracer.start",
+        "Tracer.start_sampled",
+        "Tracer.end",
+        "Tracer.tick_ns",
+        "Tracer.hist_add",
+        "coll_begin",
+        "coll_end",
+    ),
+    "ompi_tpu/pml/ob1.py": (
+        "PmlOb1._trace_p2p_end",
+    ),
+}
+
+_BANNED_BUILTIN_CALLS = ("dict", "list", "set", "tuple", "frozenset")
+
+
+class _HotVisitor(ast.NodeVisitor):
+    def __init__(self, fname: str, func: str) -> None:
+        self.fname = fname
+        self.func = func
+        self.violations: List[str] = []
+
+    def _flag(self, node: ast.AST, what: str) -> None:
+        self.violations.append(
+            f"{self.fname}:{node.lineno}: {self.func}: {what}")
+
+    # -- container allocations ------------------------------------------
+    def visit_Tuple(self, node: ast.Tuple) -> None:
+        if isinstance(node.ctx, ast.Load):
+            self._flag(node, "tuple allocation")
+        self.generic_visit(node)
+
+    def visit_List(self, node: ast.List) -> None:
+        if isinstance(node.ctx, ast.Load):
+            self._flag(node, "list allocation")
+        self.generic_visit(node)
+
+    def visit_Dict(self, node: ast.Dict) -> None:
+        self._flag(node, "dict allocation")
+        self.generic_visit(node)
+
+    def visit_Set(self, node: ast.Set) -> None:
+        self._flag(node, "set allocation")
+        self.generic_visit(node)
+
+    def visit_ListComp(self, node: ast.ListComp) -> None:
+        self._flag(node, "list comprehension")
+        self.generic_visit(node)
+
+    def visit_SetComp(self, node: ast.SetComp) -> None:
+        self._flag(node, "set comprehension")
+        self.generic_visit(node)
+
+    def visit_DictComp(self, node: ast.DictComp) -> None:
+        self._flag(node, "dict comprehension")
+        self.generic_visit(node)
+
+    def visit_GeneratorExp(self, node: ast.GeneratorExp) -> None:
+        self._flag(node, "generator expression")
+        self.generic_visit(node)
+
+    # -- string building ------------------------------------------------
+    def visit_JoinedStr(self, node: ast.JoinedStr) -> None:
+        self._flag(node, "f-string")
+        self.generic_visit(node)
+
+    # -- calls ----------------------------------------------------------
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        if isinstance(fn, ast.Name) and fn.id in _BANNED_BUILTIN_CALLS:
+            self._flag(node, f"call to {fn.id}()")
+        if isinstance(fn, ast.Attribute) and fn.attr == "format":
+            self._flag(node, "str.format call")
+        self.generic_visit(node)
+
+    # -- wall clock ------------------------------------------------------
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if (node.attr == "time"
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "time"):
+            self._flag(node, "time.time reference")
+        self.generic_visit(node)
+
+
+def _iter_functions(tree: ast.Module):
+    """Yield (qualified_name, node) for module-level functions and
+    class methods (one nesting level — the audit scope)."""
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node.name, node
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                    yield f"{node.name}.{sub.name}", sub
+
+
+def audit_source(src: str, funcnames: Tuple[str, ...],
+                 fname: str = "<source>") -> List[str]:
+    """Audit the given source text; returns violation strings and a
+    line per declared hot function that was not found (a renamed hot
+    function silently escaping the audit is itself a failure)."""
+    tree = ast.parse(src, filename=fname)
+    found = {}
+    for qual, node in _iter_functions(tree):
+        if qual in funcnames:
+            found[qual] = node
+    out: List[str] = []
+    for qual in funcnames:
+        node = found.get(qual)
+        if node is None:
+            out.append(f"{fname}: hot function {qual} not found "
+                       f"(renamed? update HOT_FUNCTIONS)")
+            continue
+        v = _HotVisitor(fname, qual)
+        # visit body + defaults (a mutable/allocating default is read
+        # at def time, but a time.time default smuggles the banned
+        # clock into the call path)
+        v.visit(node)
+        out.extend(v.violations)
+    return out
+
+
+def audit() -> List[str]:
+    """Audit every declared hot function in the live source tree."""
+    import ompi_tpu
+    import os
+    root = os.path.dirname(os.path.dirname(
+        os.path.abspath(ompi_tpu.__file__)))
+    out: List[str] = []
+    for rel, funcs in HOT_FUNCTIONS.items():
+        path = os.path.join(root, rel)
+        with open(path) as fh:
+            src = fh.read()
+        out.extend(audit_source(src, funcs, fname=rel))
+    return out
+
+
+def main(argv=None) -> int:
+    violations = audit()
+    for v in violations:
+        sys.stdout.write(v + "\n")
+    if violations:
+        sys.stdout.write(f"hotpath_audit: {len(violations)} "
+                         f"violation(s)\n")
+        return 1
+    n = sum(len(f) for f in HOT_FUNCTIONS.values())
+    sys.stdout.write(f"hotpath_audit: {n} hot functions clean\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

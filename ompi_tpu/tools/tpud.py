@@ -309,12 +309,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                 continue
             chan_box[0] = ch
             if tr is not None:
-                tr.end(t0, "oob_reconnect", "oob", node=opts.node,
-                       attempts=attempt + 1, ok=1)
+                tr.end_slow(t0, "oob_reconnect", "oob",
+                            node=opts.node, attempts=attempt + 1,
+                            ok=1)
             return
         if tr is not None:
-            tr.end(t0, "oob_reconnect", "oob", node=opts.node,
-                   attempts=oob.retry_max_var.value, ok=0)
+            tr.end_slow(t0, "oob_reconnect", "oob", node=opts.node,
+                        attempts=oob.retry_max_var.value, ok=0)
         sys.stderr.write(f"tpud[{opts.name}]: HNP unreachable after "
                          f"{oob.retry_max_var.value} reconnect "
                          f"attempts; killing local procs\n")

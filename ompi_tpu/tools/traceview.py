@@ -174,6 +174,25 @@ def summary(dumps: List[dict], offsets_us: List[float],
     dropped = sum(d.get("dropped", 0) for d in dumps)
     lines.append(f"{len(dumps)} rank dump(s), {len(events)} events "
                  f"merged ({total} recorded, {dropped} dropped)")
+    # sampling honesty: surface per-category drop accounting and any
+    # rank whose adaptive sampler backed off to 1-in-N (N > 1), so a
+    # sparse-looking merged timeline is never mistaken for a quiet run
+    by_cat: Dict[str, int] = {}
+    for d in dumps:
+        for cat, n in (d.get("dropped_by_cat") or {}).items():
+            by_cat[cat] = by_cat.get(cat, 0) + n
+    if any(by_cat.values()):
+        lines.append("dropped by category (sampled out or evicted): "
+                     + " ".join(f"{c}={n}"
+                                for c, n in sorted(by_cat.items())
+                                if n))
+    for d in dumps:
+        rates = {c: p for c, p in (d.get("sampling") or {}).items()
+                 if p > 1}
+        if rates:
+            lines.append(f"  rank {d['rank']} sampling 1-in-N: "
+                         + " ".join(f"{c}:{p}"
+                                    for c, p in sorted(rates.items())))
     spans = [e for e in events if e["ph"] == "X"]
     for cat in sorted({e["cat"] for e in spans}):
         lines.append(f"slowest {cat}:")

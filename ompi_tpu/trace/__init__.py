@@ -6,30 +6,58 @@ operations with a wall-clock start and a perf-counter duration) and
 layers that matter — pml send/recv activate→complete, collective
 entry→rendezvous→dispatch (including the fused device path's
 pack/compile/execute phases), progress-loop tick latency, OOB
-heartbeat/reconnect.  ``tools/traceview.py`` merges per-rank dumps,
-applies mpisync clock offsets, and emits Chrome trace-event JSON.
+heartbeat/reconnect.  ``ompi_tpu/tools/traceview.py`` merges per-rank
+dumps, applies mpisync clock offsets, and emits Chrome trace-event
+JSON.
 
 The cost contract mirrors ``peruse``: when ``trace_enable`` is off
 (the default) every instrumented hot path pays exactly one
 attribute-is-None check — no payload is ever built, no timestamp is
 ever taken (guarded by ``tests/test_trace.py`` the same way
 ``test_peruse_disabled_costs_nothing`` guards the peruse flag).  When
-on, recording a span is a dict build plus a ring-slot store; when the
-ring is full the oldest event is overwritten and ``dropped`` counts
-the loss — tracing never blocks and never grows without bound.
+on, the recording hot path ALLOCATES NOTHING: the ring is a set of
+preallocated parallel typed-array columns (``array('q')`` nanosecond
+timestamps/durations/args, ``array('i')`` interned name/category ids)
+indexed by one cursor, timestamps are single ``perf_counter_ns``
+integer reads against a wall-clock anchor captured once at tracer
+creation, and strings only exist in the module-level intern tables —
+decoding back to span dicts happens at snapshot/dump time, off the
+hot path.  ``ompi_tpu/tools/hotpath_audit.py`` lints the hot
+functions so tuple/dict builds and ``time.time`` calls cannot
+silently return.
+
+On a GIL-bound box every nanosecond on the hot path is multiplied by
+the rank count, so recording is additionally *sampled per category*:
+``Tracer.start_sampled`` keeps 1-in-N spans (N starts at 1, doubles
+each time a category banks ``trace_sample_auto`` kept events, capped
+at ``trace_sample_max``) and the skip path is a counter decrement —
+no clock read, no ring write.  The unsampled remainder is counted
+EXACTLY per category (``trace_dropped_<cat>`` pvars, ``sampling`` /
+``dropped_by_cat`` dump sections), so sampled traces stay honest:
+``recorded == kept + sampled_out`` always holds.
 
 Correlation keys stitch ranks together in the merger:
 
   * p2p spans carry ``mid`` = ``cid:src:tag:seq`` — identical on the
     sender's and the matching receiver's span (the ob1 match id).
+    The components are stored as four integer columns; the string is
+    synthesized at snapshot time.
   * collective spans carry ``cid`` + a per-comm ``seq`` drawn from one
     shared counter (``coll_seq``), so rank 0's allreduce #7 lines up
     with rank 3's allreduce #7.
 
+Under sampling each rank keeps its own 1-in-N subset, so cross-rank
+correlation is complete only while every category still runs at
+period 1 (small traces never adapt: the default ``trace_sample_auto``
+threshold is far above what a test emits).
+
 On top of the same ring, fixed log2-bucket latency histograms
-(progress tick, collective dispatch, p2p completion) are maintained
-per rank and exposed as MPI_T pvars — ``bench.py --trace-overhead``
-snapshots them into BENCH_DETAIL.json.
+(progress tick, collective dispatch, p2p completion, per-segment
+rendezvous) are maintained per rank and exposed as MPI_T pvars —
+``bench.py --trace-overhead`` snapshots them into BENCH_DETAIL.json,
+and ``ompi_tpu/coll/autotune.py`` folds them back into the calibrate
+profile online.  Histograms count KEPT spans only, so histogram
+totals always equal ring span counts per category.
 
 The collective/nbc hooks here (``coll_begin``/``coll_end``,
 ``nbc_begin``/``nbc_end``) also fire the extended PERUSE events, so
@@ -43,8 +71,8 @@ import json
 import os
 import threading
 import time
-from collections import deque
-from typing import Any, Dict, List, Optional
+from array import array
+from typing import Any, Dict, List, Optional, Tuple
 
 from ompi_tpu import peruse
 from ompi_tpu.mca.params import registry
@@ -62,6 +90,22 @@ dump_var = registry.register(
     help="Per-rank trace dump destination at MPI_Finalize: a "
          "directory, a prefix, or a template containing %r (replaced "
          "by the rank).  Empty = no dump")
+sample_spec_var = registry.register(
+    "trace", "", "sample_spec", "", str,
+    help="Initial per-category sampling periods as 'cat:N,cat:N' "
+         "(e.g. 'p2p:8,coll:4'); unlisted categories start at 1 "
+         "(keep everything).  Skipped spans are counted exactly")
+sample_auto_var = registry.register(
+    "trace", "", "sample_auto", 1024, int,
+    help="Adaptive sampling: double a category's period each time it "
+         "SEES this many more events, kept or skipped (busy "
+         "categories back off geometrically to trace_sample_max "
+         "within a few thousand ops; quiet ones never leave full "
+         "fidelity).  0 disables adaptation")
+sample_max_var = registry.register(
+    "trace", "", "sample_max", 64, int,
+    help="Ceiling for adaptive per-category sampling periods "
+         "(keep at least 1-in-N)")
 
 # Fixed log2 latency buckets in microseconds: bucket i holds durations
 # in [2^(i-1), 2^i) us (bucket 0 = sub-microsecond), plus one overflow
@@ -77,103 +121,412 @@ HIST_COLL_SEGMENT = 3  # per-segment rendezvous latency (pipeline tier)
 HIST_NAMES = ("progress_tick", "coll_dispatch", "p2p_complete",
               "coll_segment")
 
-# span category -> histogram fed automatically by Tracer.end()
-_CAT_HIST = {"coll_dispatch": HIST_COLL_DISPATCH, "p2p": HIST_P2P_COMPLETE,
-             "coll_segment": HIST_COLL_SEGMENT}
+
+# -- intern tables ----------------------------------------------------------
+# Category and span-name strings live HERE, once per process; the ring
+# stores small integer ids.  The tables are append-only (ids never
+# move), so lock-free reads on the hot path are safe; interning itself
+# is cold and takes the lock.
+
+_intern_lock = threading.Lock()
+_names: List[str] = []
+_name_ids: Dict[str, int] = {}
+_name_fields: List[Tuple[str, ...]] = []   # arg-column schema per name
+_cats: List[str] = []
+_cat_ids: Dict[str, int] = {}
+_cat_hist: List[int] = []                  # hist index or -1 per cat
+
+
+def intern_name(name: str, fields: Tuple[str, ...] = ()) -> int:
+    """Id for a span name, registering its arg-column schema on first
+    sight (columns a0..a4 decode to dict keys at snapshot time; a
+    field spelled 'key$' decodes its column as an interned-name id).
+    Re-interning keeps the first schema."""
+    nid = _name_ids.get(name)
+    if nid is not None:
+        return nid
+    with _intern_lock:
+        nid = _name_ids.get(name)
+        if nid is None:
+            nid = len(_names)
+            _names.append(name)
+            _name_fields.append(tuple(fields))
+            _name_ids[name] = nid
+    return nid
+
+
+def intern_cat(cat: str, hist: int = -1) -> int:
+    """Id for a span category, optionally bound to the latency
+    histogram Tracer.end feeds for it."""
+    cid = _cat_ids.get(cat)
+    if cid is not None:
+        return cid
+    with _intern_lock:
+        cid = _cat_ids.get(cat)
+        if cid is None:
+            cid = len(_cats)
+            _cats.append(cat)
+            _cat_hist.append(hist)
+            _cat_ids[cat] = cid
+    return cid
+
+
+# The hot categories and names, interned at import so ids are module
+# constants every call site can close over.
+CAT_P2P = intern_cat("p2p", HIST_P2P_COMPLETE)
+CAT_COLL = intern_cat("coll")
+CAT_NBC = intern_cat("nbc")
+CAT_COLL_DISPATCH = intern_cat("coll_dispatch", HIST_COLL_DISPATCH)
+CAT_COLL_SEGMENT = intern_cat("coll_segment", HIST_COLL_SEGMENT)
+CAT_COMPILE = intern_cat("compile")
+CAT_FT = intern_cat("ft")
+CAT_OOB = intern_cat("oob")
+CAT_FAULT = intern_cat("fault")
+
+# categories whose spans are sampled / drop-accounted (pvar surface)
+SPAN_CATS = ("p2p", "coll", "nbc", "coll_dispatch", "coll_segment",
+             "compile")
+
+NAME_SEND = intern_name("send", ("cid", "src", "tag", "seq", "bytes"))
+NAME_RECV = intern_name("recv", ("cid", "src", "tag", "seq", "bytes"))
+NAME_NBC = intern_name("nbc", ("cid", "seq"))
+NAME_MEET = intern_name("meet", ("cid", "seq", "nbytes"))
+NAME_SEG_MEET = intern_name("seg_meet", ("cid", "seq", "nbytes"))
+NAME_FUSED_FLUSH = intern_name("fused_flush", ("cid", "ops"))
+NAME_FUSED_PACK = intern_name("fused_pack", ("cid", "groups", "slots"))
+NAME_XLA_COMPILE = intern_name("xla_compile", ("key$",))
+
+_NO_ADAPT = 1 << 62  # _nxt sentinel when adaptation is disabled
+
+
+def _parse_sample_spec(spec: str) -> Dict[int, int]:
+    """'p2p:8,coll:4' -> {cat_id: period}; malformed entries ignored
+    (diagnostics never take a rank down)."""
+    out: Dict[int, int] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part or ":" not in part:
+            continue
+        cat, _, per = part.partition(":")
+        try:
+            p = int(per)
+        except ValueError:
+            continue
+        if p >= 1:
+            out[intern_cat(cat.strip())] = p
+    return out
 
 
 class Tracer:
     """One rank's ring buffer + histograms.
 
-    The ring is a ``deque(maxlen=capacity)`` of plain tuples: append
-    is one C-level call that atomically discards the oldest entry when
-    full, so the recording hot path takes NO lock — on the 1-core
-    bench box every GIL-held nanosecond here is multiplied by the rank
-    count, and the --trace-overhead budget is single-digit us.  Drop
-    accounting falls out for free: ``dropped = recorded - len(ring)``.
-    Events are materialized into span dicts only at snapshot/dump
-    time, off the hot path.
+    The ring is a fixed set of parallel typed-array columns
+    (preallocated at construction) indexed by ``cursor % capacity``:
+    nanosecond start/duration (``'q'``), interned name/cat ids
+    (``'i'``), phase code (``'b'``: 0=span, 1=instant), and five
+    generic ``'q'`` arg columns whose meaning comes from the name's
+    interned field schema.  Recording a span is pure column stores +
+    counter bumps — no object leaves the nursery, no lock is taken;
+    on the 1-core bench box every GIL-held nanosecond here is
+    multiplied by the rank count, and the --trace-overhead budget is
+    single-digit percent.  Overwrite accounting is exact: the slot
+    being reused charges its old category's overwritten counter.
+
+    Wall-clock anchoring: ``time.time`` is read ONCE at construction
+    next to one ``perf_counter_ns`` read; every stored timestamp is a
+    raw ``perf_counter_ns`` and converts to epoch seconds affinely at
+    snapshot time — one clock read per span, and mpisync offset
+    correction in traceview still yields monotonic merged timelines
+    because within a rank all timestamps share one monotonic clock.
+
+    Cold paths (``instant``, ``end_slow``) may carry real dicts in a
+    parallel object column; the hot path stores None there.
 
     A rank's tracer is written almost exclusively by its own thread;
-    the GIL makes the deque append safe for the rare cross-thread
+    the GIL makes the column stores safe for the rare cross-thread
     completion path and the process-global daemon tracer (worst case
-    under a true race is an off-by-a-few ``recorded``, never a torn
+    under a true race is an off-by-a-few counter, never a torn
     event)."""
 
-    __slots__ = ("rank", "capacity", "events", "recorded", "hists")
+    __slots__ = (
+        "rank", "capacity", "cursor", "hists",
+        "anchor_wall", "anchor_ns",
+        "_ts", "_dur", "_name", "_cat", "_ph",
+        "_a0", "_a1", "_a2", "_a3", "_a4", "_argobj",
+        "_nrec", "_period", "_ctr", "_skipped", "_cnt", "_nxt",
+        "_over", "_auto", "_max_period",
+    )
 
     def __init__(self, rank: int, capacity: int = 8192) -> None:
         self.rank = rank
-        self.capacity = max(1, int(capacity))
-        # tuples: (name, cat, ph, ts, dur_or_None, args)
-        self.events: deque = deque(maxlen=self.capacity)
-        self.recorded = 0      # total record calls (kept + dropped)
+        cap = self.capacity = max(1, int(capacity))
+        self.cursor = 0
         self.hists = [[0] * N_BUCKETS for _ in HIST_NAMES]
+        self.anchor_wall = time.time()
+        self.anchor_ns = time.perf_counter_ns()
+        zq = array("q", [0]) * cap
+        self._ts = array("q", zq)
+        self._dur = array("q", zq)
+        self._a0 = array("q", zq)
+        self._a1 = array("q", zq)
+        self._a2 = array("q", zq)
+        self._a3 = array("q", zq)
+        self._a4 = array("q", zq)
+        self._name = array("i", [0]) * cap
+        self._cat = array("i", [0]) * cap
+        self._ph = array("b", [0]) * cap
+        self._argobj: List[Any] = [None] * cap
+        self._nrec = 0          # events stored in the ring (kept)
+        ncat = len(_cats)
+        self._period = [1] * ncat    # current 1-in-N period per cat
+        self._ctr = [0] * ncat       # skips remaining in this period
+        self._skipped = [0] * ncat   # exact sampled-out count per cat
+        self._cnt = [0] * ncat       # exact kept count per cat
+        self._over = [0] * ncat      # exact overwrite count per cat
+        self._auto = max(0, int(sample_auto_var.value))
+        self._max_period = max(1, int(sample_max_var.value))
+        nxt = self._auto if self._auto else _NO_ADAPT
+        self._nxt = [nxt] * ncat     # seen-count at next period double
+        for cid, per in _parse_sample_spec(sample_spec_var.value).items():
+            self._ensure_cat(cid)
+            self._period[cid] = min(per, self._max_period)
+
+    def _ensure_cat(self, cat_id: int) -> None:
+        """Grow the per-category tables to cover a cat interned after
+        this tracer was built (cold: instants / end_slow only — hot
+        call sites use the module-constant ids interned at import)."""
+        grow = cat_id + 1 - len(self._period)
+        if grow > 0:
+            nxt = self._auto if self._auto else _NO_ADAPT
+            self._period.extend([1] * grow)
+            self._ctr.extend([0] * grow)
+            self._skipped.extend([0] * grow)
+            self._cnt.extend([0] * grow)
+            self._over.extend([0] * grow)
+            self._nxt.extend([nxt] * grow)
+
+    @property
+    def recorded(self) -> int:
+        """Total events seen (kept + sampled-out); instants and spans."""
+        return self._nrec + sum(self._skipped)
 
     @property
     def dropped(self) -> int:
-        """Events lost to ring wraparound."""
-        return self.recorded - len(self.events)
+        """Events not in the ring: sampled-out + lost to wraparound."""
+        live = self.cursor if self.cursor < self.capacity else self.capacity
+        return self.recorded - live
 
     # -- recording -------------------------------------------------------
-    # The default-arg bindings (_time/_pc) skip the module+attribute
-    # lookups per call on the hot path.
-    def start(self, _time=time.time, _pc=time.perf_counter):
-        """Span-start token: (wall clock for the merger, perf counter
-        for the duration).  time.time() is what mpisync offsets
-        correct; perf_counter() is monotonic for honest durations."""
-        return (_time(), _pc())
+    # The default-arg binding (_pcns) skips the module+attribute
+    # lookup per call on the hot path.
+    def start(self, _pcns=time.perf_counter_ns) -> int:
+        """Unconditional span-start token: one integer nanosecond
+        perf-counter read (always truthy — perf_counter_ns is
+        monotonic from a nonzero epoch)."""
+        return _pcns()
 
-    def end(self, t0, name: str, cat: str, _pc=time.perf_counter,
-            **args) -> float:
-        """Close a span opened with start(); returns the duration (s).
-        Categories in _CAT_HIST also feed their latency histogram.
-        This is THE recording hot path: one tuple, one deque append,
-        one counter, one histogram bump."""
-        dur = _pc() - t0[1]
-        self.events.append((name, cat, "X", t0[0], dur, args))
-        self.recorded += 1
-        h = _CAT_HIST.get(cat)
-        if h is not None:
-            us = int(dur * 1e6)
-            b = us.bit_length() if us > 0 else 0
+    def start_sampled(self, cat_id: int, _pcns=time.perf_counter_ns) -> int:
+        """Sampling span-start: 1-in-period spans get a start token,
+        the rest return 0 after a counter decrement — the skip path
+        takes NO clock read and writes NO ring slot, which is what
+        makes always-on tracing affordable under the GIL.  Callers
+        skip their end() call (and any arg gathering) on 0.
+
+        Adaptation lives in the KEEP branch (so the skip branch stays
+        two list ops) and is driven by the category's total SEEN count
+        (kept + skipped): every ``trace_sample_auto`` more sightings,
+        the period doubles up to ``trace_sample_max``.  A hot category
+        therefore backs off geometrically within ~6 x auto events,
+        checked at worst one kept-event late — the exact counters make
+        any sampling error visible, never silent."""
+        c = self._ctr[cat_id]
+        if c:
+            self._ctr[cat_id] = c - 1
+            self._skipped[cat_id] += 1
+            return 0
+        p = self._period[cat_id]
+        seen = self._cnt[cat_id] + self._skipped[cat_id]
+        if seen >= self._nxt[cat_id]:
+            self._nxt[cat_id] = seen + self._auto
+            if p < self._max_period:
+                p += p
+                self._period[cat_id] = p
+        self._ctr[cat_id] = p - 1
+        return _pcns()
+
+    def end(self, t0: int, name_id: int, cat_id: int,
+            a0: int = 0, a1: int = 0, a2: int = 0, a3: int = 0,
+            a4: int = 0, _pcns=time.perf_counter_ns,
+            _hist=_cat_hist) -> int:
+        """Close a span opened with start()/start_sampled(); returns
+        the duration in ns.  Categories bound to a histogram feed it
+        here (kept spans only — histogram totals equal ring span
+        counts).  This is THE recording hot path: column stores and
+        integer bumps, zero allocation."""
+        dur = _pcns() - t0
+        h = _hist[cat_id]
+        if h >= 0:
+            b = (dur // 1000).bit_length()
             self.hists[h][b if b < N_BUCKETS else N_BUCKETS - 1] += 1
+        cur = self.cursor
+        cap = self.capacity
+        i = cur % cap
+        if cur >= cap:
+            self._over[self._cat[i]] += 1
+        self._ts[i] = t0
+        self._dur[i] = dur
+        self._name[i] = name_id
+        self._cat[i] = cat_id
+        self._ph[i] = 0
+        self._a0[i] = a0
+        self._a1[i] = a1
+        self._a2[i] = a2
+        self._a3[i] = a3
+        self._a4[i] = a4
+        self._argobj[i] = None
+        self.cursor = cur + 1
+        self._nrec += 1
+        self._cnt[cat_id] += 1
         return dur
 
-    def instant(self, name: str, cat: str, **args) -> None:
-        self.events.append((name, cat, "i", time.time(), None, args))
-        self.recorded += 1
+    def _store_slot(self, ts: int, dur: int, name_id: int, cat_id: int,
+                    ph: int, argobj: Optional[dict]) -> None:
+        """Cold-path slot store (instants, end_slow)."""
+        cur = self.cursor
+        i = cur % self.capacity
+        if cur >= self.capacity:
+            self._over[self._cat[i]] += 1
+        self._ts[i] = ts
+        self._dur[i] = dur
+        self._name[i] = name_id
+        self._cat[i] = cat_id
+        self._ph[i] = ph
+        self._argobj[i] = argobj
+        self.cursor = cur + 1
+        self._nrec += 1
 
-    def tick(self, dur_s: float) -> None:
+    def end_slow(self, t0: int, name: str, cat: str, **args) -> float:
+        """String-keyed compat span close for COLD call sites (daemon
+        OOB reconnects, tests): interns on the fly, carries args as a
+        real dict, still feeds the category's histogram.  Returns the
+        duration in seconds (legacy contract)."""
+        dur = time.perf_counter_ns() - t0
+        cid = intern_cat(cat)
+        self._ensure_cat(cid)
+        h = _cat_hist[cid]
+        if h >= 0:
+            b = (dur // 1000).bit_length()
+            self.hists[h][b if b < N_BUCKETS else N_BUCKETS - 1] += 1
+        self._store_slot(t0, dur, intern_name(name), cid, 0,
+                         dict(args) if args else None)
+        self._cnt[cid] += 1
+        return dur * 1e-9
+
+    def instant(self, name: str, cat: str, **args) -> None:
+        """Point annotation (cold path: faults, heartbeats, ULFM)."""
+        cid = intern_cat(cat)
+        self._ensure_cat(cid)
+        self._store_slot(time.perf_counter_ns(), 0, intern_name(name),
+                         cid, 1, dict(args) if args else None)
+
+    def tick_ns(self, dur_ns: int) -> None:
         """Progress-sweep latency: histogram only, never a ring event
         (a sweep runs thousands of times per second and would flood
         the ring into pure tick noise)."""
-        self.hist_add(HIST_PROGRESS_TICK, dur_s)
+        b = (dur_ns // 1000).bit_length()
+        self.hists[HIST_PROGRESS_TICK][
+            b if b < N_BUCKETS else N_BUCKETS - 1] += 1
+
+    def tick(self, dur_s: float) -> None:
+        self.tick_ns(int(dur_s * 1e9))
 
     def hist_add(self, which: int, dur_s: float) -> None:
         us = int(dur_s * 1e6)
         # log2 bucket: us in [2^(i-1), 2^i) -> bucket i; 0 us -> 0
-        b = us.bit_length() if us > 0 else 0
+        b = us.bit_length()
         if b >= N_BUCKETS:
             b = N_BUCKETS - 1
         self.hists[which][b] += 1
 
+    # -- sampling accounting --------------------------------------------
+    def sampling_rates(self) -> Dict[str, int]:
+        """Current 1-in-N period per span category."""
+        return {cat: self._period[cid]
+                for cat, cid in ((c, _cat_ids[c]) for c in SPAN_CATS)
+                if cid < len(self._period)}
+
+    def dropped_by_cat(self) -> Dict[str, int]:
+        """Exact per-category loss: sampled-out + overwritten."""
+        out = {}
+        for cat in SPAN_CATS:
+            cid = _cat_ids[cat]
+            if cid < len(self._skipped):
+                out[cat] = self._skipped[cid] + self._over[cid]
+        return out
+
+    def cat_seen(self, cat: str) -> int:
+        """Exact total spans observed for a category (kept + sampled
+        out) — what the autotuner paces its fold interval on."""
+        cid = _cat_ids.get(cat)
+        if cid is None or cid >= len(self._cnt):
+            return 0
+        return self._cnt[cid] + self._skipped[cid]
+
     # -- reading ---------------------------------------------------------
+    def _wall(self, ts_ns: int) -> float:
+        return self.anchor_wall + (ts_ns - self.anchor_ns) * 1e-9
+
+    def _live_range(self):
+        cur, cap = self.cursor, self.capacity
+        if cur <= cap:
+            return range(cur)
+        first = cur % cap
+        return (i % cap for i in range(first, first + cap))
+
+    def _decode_args(self, i: int) -> dict:
+        argobj = self._argobj[i]
+        if argobj is not None:
+            return argobj
+        nid = self._name[i]
+        cid = self._cat[i]
+        vals = (self._a0[i], self._a1[i], self._a2[i], self._a3[i],
+                self._a4[i])
+        if cid == CAT_P2P:
+            # synthesize the cross-rank match id traceview keys on
+            return {"mid": f"{vals[0]}:{vals[1]}:{vals[2]}:{vals[3]}",
+                    "bytes": vals[4]}
+        fields = _name_fields[nid] if nid < len(_name_fields) else ()
+        out = {}
+        for k, v in zip(fields, vals):
+            if k.endswith("$"):
+                out[k[:-1]] = _names[v] if 0 <= v < len(_names) else v
+            else:
+                out[k] = v
+        return out
+
     def snapshot(self) -> List[dict]:
         """Events oldest-first, materialized as span dicts (the dump
-        schema — tuple unpacking happens here, off the hot path)."""
+        schema — id decode and string synthesis happen here, off the
+        hot path).  Timestamps become epoch seconds via the anchor."""
         out = []
-        for name, cat, ph, ts, dur, args in list(self.events):
-            e = {"name": name, "cat": cat, "ph": ph, "ts": ts,
-                 "args": args}
-            if dur is not None:
-                e["dur"] = dur
+        for i in self._live_range():
+            e = {"name": _names[self._name[i]],
+                 "cat": _cats[self._cat[i]],
+                 "ph": "X" if self._ph[i] == 0 else "i",
+                 "ts": self._wall(self._ts[i]),
+                 "args": self._decode_args(i)}
+            if self._ph[i] == 0:
+                e["dur"] = self._dur[i] * 1e-9
             out.append(e)
         return out
 
-    def span_count(self, cat: str) -> int:
-        return sum(1 for e in list(self.events)
-                   if e[1] == cat and e[2] == "X")
+    def span_count(self, cat) -> int:
+        cid = _cat_ids.get(cat, -1) if isinstance(cat, str) else cat
+        n = 0
+        for i in self._live_range():
+            if self._cat[i] == cid and self._ph[i] == 0:
+                n += 1
+        return n
 
     def hist_total(self, which: int) -> int:
         return sum(self.hists[which])
@@ -181,12 +534,18 @@ class Tracer:
     def dump(self, path: str) -> None:
         """One self-describing per-rank JSON file — the traceview
         input.  Timestamps are epoch seconds (floats); traceview
-        converts to microseconds after clock correction."""
+        converts to microseconds after clock correction.  The
+        sampling/drop accounting rides along so a merged view can say
+        exactly what fraction of each category it is looking at."""
         doc = {
             "rank": self.rank,
             "recorded": self.recorded,
             "dropped": self.dropped,
             "capacity": self.capacity,
+            "anchor": {"wall_s": self.anchor_wall,
+                       "perf_ns": self.anchor_ns},
+            "sampling": self.sampling_rates(),
+            "dropped_by_cat": self.dropped_by_cat(),
             "buckets_us": list(BUCKET_BOUNDS_US),
             "hists": {n: list(h) for n, h in zip(HIST_NAMES, self.hists)},
             "events": self.snapshot(),
@@ -197,6 +556,15 @@ class Tracer:
 
 # -- per-rank attach / dump -------------------------------------------------
 
+def force_attach(state) -> Tracer:
+    """Attach a tracer regardless of trace_enable (the autotuner runs
+    on trace histograms, so enabling it implies a tracer)."""
+    tr = Tracer(state.rank, buffer_var.value)
+    state.tracer = tr
+    state.progress.tracer = tr
+    return tr
+
+
 def attach(state) -> Optional[Tracer]:
     """Called by mpi_init before pml selection: when trace_enable is
     set, hang a Tracer off the ProcState (and the progress engine so
@@ -205,10 +573,7 @@ def attach(state) -> Optional[Tracer]:
     if not enable_var.value:
         state.tracer = None
         return None
-    tr = Tracer(state.rank, buffer_var.value)
-    state.tracer = tr
-    state.progress.tracer = tr
-    return tr
+    return force_attach(state)
 
 
 def _resolve_dump_path(base: str, tag: str) -> str:
@@ -299,15 +664,38 @@ def _tr_hist(which: int):
     return getter
 
 
+def _tr_dropped_cat(cat: str):
+    cid = _cat_ids[cat]
+
+    def getter():
+        tr = current_tracer()
+        if tr is None or cid >= len(tr._skipped):
+            return 0
+        return tr._skipped[cid] + tr._over[cid]
+    return getter
+
+
 registry.register_pvar(
     "trace", "", "events_recorded",
     help="Trace events recorded by this rank (kept + dropped)",
     getter=_tr_attr("recorded"))
 registry.register_pvar(
     "trace", "", "events_dropped",
-    help="Trace events lost to ring-buffer wraparound "
-         "(raise trace_buffer_events)",
+    help="Trace events not retained: sampled out + lost to "
+         "ring-buffer wraparound (raise trace_buffer_events)",
     getter=_tr_attr("dropped"))
+registry.register_pvar(
+    "trace", "", "sampling_rate",
+    help="Current per-category 1-in-N sampling periods (dict cat -> "
+         "N; N=1 means every span is kept)",
+    getter=lambda: (current_tracer().sampling_rates()
+                    if current_tracer() is not None else {}))
+for _cat in SPAN_CATS:
+    registry.register_pvar(
+        "trace", "", f"dropped_{_cat}",
+        help=f"Exact count of '{_cat}' spans not in the ring "
+             "(sampled out + overwritten)",
+        getter=_tr_dropped_cat(_cat))
 registry.register_pvar(
     "trace", "", "hist_bucket_bounds_us", var_class="size",
     help="Upper bounds (us) of the fixed log2 latency buckets shared "
@@ -342,36 +730,73 @@ def coll_seq(comm) -> int:
     """Next per-comm collective sequence number — the cross-rank
     correlation key (MPI collective-ordering semantics make every
     member's counter agree)."""
-    s = comm.__dict__.get("_coll_seq", 0) + 1
-    comm.__dict__["_coll_seq"] = s
+    s = comm._coll_seq + 1
+    comm._coll_seq = s
     return s
 
 
-def coll_begin(comm, coll: str, _time=time.time,
-               _pc=time.perf_counter):
-    """Blocking-collective entry.  Returns an opaque token for
-    coll_end, or None when both observability systems are off (the
-    merged-vtable shim passes straight through on None)."""
+def coll_begin(comm, name_id: int, _peruse=peruse, _CAT=CAT_COLL):
+    """Blocking-collective entry.  ``name_id`` is the collective's
+    interned span name (the merged-vtable shim interns once at wrap
+    time).  Returns an opaque token for coll_end: None when both
+    observability systems are off (the shim passes straight through),
+    0 when the span was sampled out (the seq still advanced — the
+    cross-rank counter must tick identically on every member, and the
+    shim skips coll_end entirely), a positive ns start otherwise, or
+    a tuple on the cold peruse path.
+
+    The default-arg bindings turn module-global lookups into local
+    loads, and the sampled-out branch of start_sampled is inlined: in
+    steady state (63-in-64 once a category is hot) this path is the
+    whole per-op cost of tracing, and on the 1-core bench box every
+    GIL-held instruction here is multiplied by the rank count."""
+    if _peruse.enabled:
+        return _coll_begin_slow(comm, name_id)
     tr = comm.state.tracer
-    if tr is None and not peruse.enabled:
+    if tr is None:
         return None
-    seq = coll_seq(comm)
-    if peruse.enabled:
-        peruse.fire("coll_begin", cid=comm.cid, coll=coll, seq=seq)
-    return (seq, _time(), _pc(), tr)
+    comm._coll_seq = comm._coll_seq + 1
+    ctr = tr._ctr
+    c = ctr[_CAT]
+    if c:
+        ctr[_CAT] = c - 1
+        tr._skipped[_CAT] += 1
+        return 0
+    return tr.start_sampled(_CAT)
 
 
-def coll_end(comm, coll: str, token) -> None:
-    if token is None:
+def coll_end(comm, name_id: int, token) -> None:
+    if type(token) is int:
+        if token:
+            tr = comm.state.tracer
+            if tr is not None:
+                tr.end(token, name_id, CAT_COLL, comm.cid,
+                       comm._coll_seq)
         return
-    seq, ts, tp, tr = token
-    if tr is not None:
-        tr.end((ts, tp), coll, "coll", cid=comm.cid, seq=seq)
-    if peruse.enabled:
-        peruse.fire("coll_end", cid=comm.cid, coll=coll, seq=seq)
+    if token is not None:
+        _coll_end_slow(comm, name_id, token)
 
 
-def nbc_begin(comm, coll: str):
+def _coll_begin_slow(comm, name_id: int):
+    seq = coll_seq(comm)
+    peruse.fire("coll_begin", cid=comm.cid, coll=_names[name_id],
+                seq=seq)
+    tr = comm.state.tracer
+    t0 = tr.start_sampled(CAT_COLL) if tr is not None else 0
+    return (seq, t0)
+
+
+def _coll_end_slow(comm, name_id: int, token) -> None:
+    seq, t0 = token
+    if t0:
+        tr = comm.state.tracer
+        if tr is not None:
+            tr.end(t0, name_id, CAT_COLL, comm.cid, seq)
+    peruse.fire("coll_end", cid=comm.cid, coll=_names[name_id],
+                seq=seq)
+
+
+def nbc_begin(comm, name_id: int = NAME_NBC):
     """Nonblocking-collective activation (NBCRequest construction).
     Returns the token the request stashes until completion."""
     tr = comm.state.tracer
@@ -379,15 +804,18 @@ def nbc_begin(comm, coll: str):
         return None
     seq = coll_seq(comm)
     if peruse.enabled:
-        peruse.fire("nbc_activate", cid=comm.cid, coll=coll, seq=seq)
-    return (seq, time.time(), time.perf_counter(), tr, comm.cid, coll)
+        peruse.fire("nbc_activate", cid=comm.cid, coll=_names[name_id],
+                    seq=seq)
+    t0 = tr.start_sampled(CAT_NBC) if tr is not None else 0
+    return (seq, t0, tr, comm.cid, name_id)
 
 
 def nbc_end(token) -> None:
     if token is None:
         return
-    seq, ts, tp, tr, cid, coll = token
-    if tr is not None:
-        tr.end((ts, tp), coll, "nbc", cid=cid, seq=seq)
+    seq, t0, tr, cid, name_id = token
+    if tr is not None and t0:
+        tr.end(t0, name_id, CAT_NBC, cid, seq)
     if peruse.enabled:
-        peruse.fire("nbc_complete", cid=cid, coll=coll, seq=seq)
+        peruse.fire("nbc_complete", cid=cid, coll=_names[name_id],
+                    seq=seq)

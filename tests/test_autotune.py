@@ -1,0 +1,207 @@
+"""coll/autotune: the online loop folding the coll_dispatch /
+coll_segment trace histograms back into the calibrate profile
+(DESIGN.md §13).
+
+The round-trip gate: a skewed histogram MOVES seg_crossover_bytes,
+the per-comm _pipeline_pick caches re-resolve at a collective-seq
+window boundary through the put-once shared snapshot, the formerly
+fused payload routes to the segmented tier — and the result stays
+byte-identical across the repick (the repo's segmented-tier
+discipline: algorithm changes must be invisible in the bytes)."""
+
+import types
+
+import numpy as np
+import pytest
+
+from ompi_tpu import trace
+from ompi_tpu.coll import autotune, calibrate
+from ompi_tpu.mca.params import registry
+from ompi_tpu.op import op as mpi_op
+from ompi_tpu.testing import run_ranks
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+# register every knob the snapshots below touch before snapshotting
+import ompi_tpu.coll.fusion    # noqa: E402,F401
+import ompi_tpu.coll.pipeline  # noqa: E402,F401
+
+KNOBS = (
+    "coll_autotune_enable", "coll_autotune_interval_ops",
+    "coll_autotune_ewma", "coll_autotune_min_samples",
+    "coll_autotune_window_ops", "coll_autotune_persist",
+    "coll_autotune_fusion",
+    "coll_tuned_use_measured_rules", "coll_tuned_profile_path",
+    "coll_pipeline_enable", "coll_hier_enable",
+    "coll_device_fusion_max_ops", "trace_enable",
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    saved = {k: registry.get(k) for k in KNOBS}
+    yield
+    for k, v in saved.items():
+        registry.set(k, v)
+    autotune.reset()
+    calibrate.reset_cache()
+
+
+def _seed_profile(tmp_path, name="prof.json", **over):
+    """Point the process at a crafted profile: crossovers parked at
+    1 GiB so nothing routes segmented until a fold moves them."""
+    path = str(tmp_path / name)
+    registry.set("coll_tuned_profile_path", path)
+    calibrate.reset_cache()
+    prof = {
+        "host": "test", "backend": "crafted", "source": "crafted",
+        "host_alpha_us": 5.0, "host_gbs": 10.0, "dispatch_us": 200.0,
+        "seg_bytes": 1 << 20,
+        "seg_crossover_bytes": {"allreduce": 1 << 30, "bcast": 1 << 30,
+                                "alltoall": 1 << 30},
+        "hier_min_bytes": 1 << 30,
+    }
+    prof.update(over)
+    calibrate.save_profile(prof, path)
+    return path
+
+
+def _fake_state(tr):
+    """Registration target for unit-level folds: a tracer to read and
+    no shared world (the fold skips the purge loop for it)."""
+    return types.SimpleNamespace(
+        tracer=tr, rte=types.SimpleNamespace(world=None), comms={})
+
+
+# -- the round trip ---------------------------------------------------------
+
+def test_fold_moves_crossover_and_repicks_byte_identical(tmp_path):
+    """Skewed histograms (slow whole-op dispatch, fast per-segment
+    meets) pull seg_crossover_bytes from 1 GiB down to 256 KiB =
+    2 * seg_bytes * (seg_med/disp_med) — the 640 KB allreduce that ran
+    fused before the fold runs segmented after the window boundary,
+    byte-for-byte identical.  Without the skew (ratio 1) the candidate
+    would be 2 MiB and the payload would stay fused: the histogram
+    CONTENT, not just the fold, drives the move."""
+    _seed_profile(tmp_path)
+    registry.set("coll_tuned_use_measured_rules", "1")
+    registry.set("coll_autotune_enable", "1")
+    registry.set("coll_autotune_interval_ops", "1000000000")  # manual fold
+    registry.set("coll_autotune_ewma", "1.0")
+    registry.set("coll_autotune_min_samples", "8")
+    registry.set("coll_autotune_window_ops", "4")
+    registry.set("coll_autotune_fusion", "0")
+    registry.set("coll_pipeline_enable", "1")
+    registry.set("coll_hier_enable", "0")
+    registry.set("trace_enable", "1")
+    autotune.reset()
+
+    from ompi_tpu.coll import pipeline
+
+    def fn(comm):
+        x = jax.device_put(
+            (jnp.arange(160000, dtype=jnp.float32) % 11) + comm.rank,
+            comm.device)  # 640 KB, exact-representable values
+        ops0 = pipeline.pv_ops.read()
+        pre = np.asarray(comm.allreduce_arr(x, mpi_op.SUM)).tobytes()
+        pre2 = np.asarray(comm.allreduce_arr(x, mpi_op.SUM)).tobytes()
+        ops_pre = pipeline.pv_ops.read() - ops0
+        tr = comm.state.tracer
+        assert tr is not None      # autotune implies a tracer
+        tuner = autotune.active()
+        assert tuner is not None
+        if comm.rank == 0:
+            # the skew: whole-op dispatch ~768 us (bucket 10), per-
+            # segment meet ~96 us (bucket 7) -> ratio exactly 1/8
+            tr.hists[trace.HIST_COLL_DISPATCH][10] += 200
+            tr.hists[trace.HIST_COLL_SEGMENT][7] += 200
+        comm.Barrier()
+        if comm.rank == 0:
+            assert tuner.fold() is True
+        comm.Barrier()
+        prof = calibrate.get_profile(create=False)
+        assert prof["seg_crossover_bytes"]["allreduce"] == 262144
+        # cross a window boundary so the purged picks re-resolve
+        # against the folded profile (pre-fold snapshots are put-once
+        # per window and must not leak forward)
+        for _ in range(2 * tuner.window_ops()):
+            comm.Barrier()
+        ops1 = pipeline.pv_ops.read()
+        post = np.asarray(comm.allreduce_arr(x, mpi_op.SUM)).tobytes()
+        ops_post = pipeline.pv_ops.read() - ops1
+        # put-once snapshot: every re-ask in one window is the same
+        # object, so members can never see different thresholds
+        win = comm._coll_seq // tuner.window_ops()
+        tbl = tuner.thresholds_for(comm, win)
+        assert tbl is not None and tuner.thresholds_for(comm, win) is tbl
+        # ...and the pvar surface reports the applied fold
+        from ompi_tpu import mpit
+        mpit.init_thread()
+        try:
+            sess = mpit.pvar_session_create()
+            folds = mpit.pvar_read(
+                mpit.pvar_handle_alloc(sess, "coll_autotune_folds"))
+            cx = mpit.pvar_read(mpit.pvar_handle_alloc(
+                sess, "coll_autotune_seg_crossover_allreduce"))
+        finally:
+            mpit.finalize()
+        assert folds == 1 and cx == 262144
+        return pre, pre2, post, ops_pre, ops_post
+
+    res = run_ranks(4, fn, devices=True)
+    assert len({pre for pre, _, _, _, _ in res}) == 1  # ranks agree
+    for pre, pre2, post, ops_pre, ops_post in res:
+        assert ops_pre == 0       # fused while crossover sat at 1 GiB
+        assert ops_post > 0       # segmented after the fold + window
+        assert pre == pre2 == post  # the repick is invisible in bytes
+
+
+# -- fold mechanics (no world) ----------------------------------------------
+
+def test_fold_accumulates_below_min_samples(tmp_path):
+    """An under-threshold window must not advance the histogram
+    baselines: samples keep accumulating until min_samples is met in
+    one delta, and an immediate refold with nothing new is a no-op."""
+    _seed_profile(tmp_path)
+    registry.set("coll_tuned_use_measured_rules", "1")
+    registry.set("coll_autotune_min_samples", "32")
+    registry.set("coll_autotune_ewma", "1.0")
+    registry.set("coll_autotune_fusion", "0")
+    tr = trace.Tracer(0, capacity=64)
+    tuner = autotune.Autotuner()
+    tuner.register(_fake_state(tr))
+    tr.hists[trace.HIST_COLL_DISPATCH][8] += 16
+    assert tuner.fold() is False           # 16 < 32: accumulate
+    assert tuner.folds == 0
+    tr.hists[trace.HIST_COLL_DISPATCH][8] += 16
+    assert tuner.fold() is True            # both windows counted
+    assert tuner.folds == 1
+    prof = calibrate.get_profile(create=False)
+    assert prof["autotune"]["samples"] == 32
+    assert tuner.fold() is False           # baselines advanced: no news
+
+
+def test_fusion_retune_clamped(tmp_path):
+    """The fusion flush threshold tracks dispatch_us/host_alpha_us but
+    never escapes [4, 256] — a wild histogram cannot configure the
+    batcher into pathology."""
+    _seed_profile(tmp_path, host_alpha_us=0.5)
+    registry.set("coll_tuned_use_measured_rules", "1")
+    registry.set("coll_autotune_min_samples", "1")
+    registry.set("coll_autotune_ewma", "1.0")
+    registry.set("coll_autotune_fusion", "1")
+    tr = trace.Tracer(0, capacity=64)
+    tuner = autotune.Autotuner()
+    tuner.register(_fake_state(tr))
+    tr.hists[trace.HIST_COLL_DISPATCH][15] += 10   # ~24.6 ms dispatch
+    assert tuner.fold() is True
+    assert int(registry.get("coll_device_fusion_max_ops")) == 256
+    # cheap dispatch vs expensive host constant: floor clamp
+    _seed_profile(tmp_path, name="prof2.json", host_alpha_us=4000.0)
+    tr2 = trace.Tracer(0, capacity=64)
+    tuner2 = autotune.Autotuner()
+    tuner2.register(_fake_state(tr2))
+    tr2.hists[trace.HIST_COLL_DISPATCH][1] += 10   # ~1.5 us dispatch
+    assert tuner2.fold() is True
+    assert int(registry.get("coll_device_fusion_max_ops")) == 4

@@ -1,11 +1,16 @@
 """Cross-rank span tracing (ompi_tpu/trace + tools/traceview):
-disabled-cost contract, ring wraparound accounting, clock-corrected
-multi-rank merge, histogram pvars, the extended PERUSE coll/nbc
-events, the pml/monitoring finalize dump, and pstat pvar idempotency
-across repeated worlds."""
+disabled-cost contract, enabled-path allocation guard, ring
+wraparound accounting, sampling exactness + adaptive backoff,
+clock-corrected multi-rank merge, histogram pvars, the extended
+PERUSE coll/nbc events, the pml/monitoring finalize dump, pstat pvar
+idempotency across repeated worlds, and the hotpath_audit AST lint
+that holds the hot functions to the zero-allocation budget."""
 
+import gc
 import json
 import os
+import time
+import tracemalloc
 
 import numpy as np
 import pytest
@@ -23,6 +28,9 @@ def _clean_trace():
     registry.set("trace_enable", "0")
     registry.set("trace_dump_path", "")
     registry.set("trace_buffer_events", "8192")
+    registry.set("trace_sample_spec", "")
+    registry.set("trace_sample_auto", "1024")
+    registry.set("trace_sample_max", "64")
     registry.set("pml_monitoring_enable", "0")
     registry.set("pml_monitoring_dump_path", "")
     peruse.unsubscribe_all()
@@ -68,6 +76,59 @@ def test_trace_disabled_costs_nothing():
     assert trace.global_tracer() is None
 
 
+def test_enabled_hot_path_retains_no_objects():
+    """The recording hot path allocates NOTHING that survives the
+    call: ring columns are preallocated typed arrays, ids are interned
+    ints, timestamps are transient PyLongs.  Measured with tracemalloc
+    over thousands of start_sampled/end pairs (skip branch, keep
+    branch, adaptation, and ring wraparound all exercised) — the net
+    retained memory must stay within a few stray counter ints, i.e.
+    far under one byte per span."""
+    tr = trace.Tracer(0, capacity=256)
+    # warm: cross the wraparound boundary and the first adaptation
+    # thresholds so every code path has already run once
+    for _ in range(2048):
+        t0 = tr.start_sampled(trace.CAT_COLL_DISPATCH)
+        if t0:
+            tr.end(t0, trace.NAME_MEET, trace.CAT_COLL_DISPATCH, 1, 2, 3)
+    gc.collect()
+    tracemalloc.start()
+    base = tracemalloc.get_traced_memory()[0]
+    for _ in range(4000):
+        t0 = tr.start_sampled(trace.CAT_COLL_DISPATCH)
+        if t0:
+            tr.end(t0, trace.NAME_MEET, trace.CAT_COLL_DISPATCH, 1, 2, 3)
+    gc.collect()
+    grown = tracemalloc.get_traced_memory()[0] - base
+    tracemalloc.stop()
+    assert grown < 4096, f"hot path retained {grown} bytes over 4000 spans"
+
+
+def test_wall_anchor_read_once():
+    """time.time is read ONCE at Tracer construction; recording and
+    snapshot decoding run entirely on perf_counter_ns + the stored
+    anchor.  Proven by making the wall clock explode after init."""
+    tr = trace.Tracer(0, capacity=8)
+    real_time = time.time
+
+    def boom():
+        raise AssertionError("wall clock read on the hot path")
+
+    time.time = boom
+    try:
+        t0 = tr.start()
+        tr.end(t0, trace.NAME_MEET, trace.CAT_COLL, 7, 1, 64)
+        t1 = tr.start_sampled(trace.CAT_COLL)
+        tr.end(t1, trace.NAME_MEET, trace.CAT_COLL, 7, 2, 64)
+        evs = tr.snapshot()
+    finally:
+        time.time = real_time
+    assert len(evs) == 2
+    # timestamps decode affinely off the single anchor, in order
+    assert evs[0]["ts"] <= evs[1]["ts"]
+    assert abs(evs[0]["ts"] - tr.anchor_wall) < 5.0
+
+
 def test_ring_wraparound_counts_drops():
     tr = trace.Tracer(0, capacity=8)
     for i in range(20):
@@ -83,12 +144,21 @@ def test_ring_wraparound_counts_drops():
 def test_span_records_duration_and_histogram():
     tr = trace.Tracer(0, capacity=64)
     t0 = tr.start()
-    tr.end(t0, "op", "p2p", mid="0:0:1:1", bytes=16)
+    # hot API: interned ids + integer arg columns; the p2p match-id
+    # string is synthesized at snapshot time, never on the hot path
+    tr.end(t0, trace.NAME_SEND, trace.CAT_P2P, 0, 0, 1, 1, 16)
     (ev,) = tr.snapshot()
     assert ev["ph"] == "X" and ev["cat"] == "p2p"
     assert ev["dur"] >= 0
     assert ev["args"]["mid"] == "0:0:1:1"
+    assert ev["args"]["bytes"] == 16
     assert tr.hist_total(trace.HIST_P2P_COMPLETE) == 1
+    # cold compat path: string keys + a real kwargs dict, seconds out
+    t0 = tr.start()
+    dur_s = tr.end_slow(t0, "reconnect", "oob", node="n0")
+    assert dur_s >= 0.0
+    ev = tr.snapshot()[-1]
+    assert ev["name"] == "reconnect" and ev["args"] == {"node": "n0"}
     # bucketing: 3 us -> bucket 2 ([2,4) us), 0 us -> bucket 0
     tr.hist_add(trace.HIST_COLL_DISPATCH, 3e-6)
     assert tr.hists[trace.HIST_COLL_DISPATCH][2] == 1
@@ -97,6 +167,93 @@ def test_span_records_duration_and_histogram():
     # far overflow lands in the last bucket, never raises
     tr.hist_add(trace.HIST_COLL_DISPATCH, 3600.0)
     assert tr.hists[trace.HIST_COLL_DISPATCH][trace.N_BUCKETS - 1] == 1
+
+
+# -- sampling: exact counters, adaptive backoff -----------------------------
+
+def test_sampled_counters_exact():
+    """1-in-N sampling never loses count: kept + sampled-out always
+    equals seen, per category, and the pvar-facing accessors agree."""
+    registry.set("trace_sample_spec", "p2p:4")
+    registry.set("trace_sample_auto", "0")   # pin the period
+    tr = trace.Tracer(0, capacity=4096)
+    kept = 0
+    for i in range(100):
+        t0 = tr.start_sampled(trace.CAT_P2P)
+        if t0:
+            tr.end(t0, trace.NAME_SEND, trace.CAT_P2P, 0, 0, 1, i, 8)
+            kept += 1
+    assert kept == 25                      # exactly 1-in-4
+    assert tr.recorded == 100              # seen, kept or not
+    assert tr.cat_seen("p2p") == 100
+    assert tr.dropped == 75
+    assert tr.dropped_by_cat()["p2p"] == 75
+    assert tr.sampling_rates()["p2p"] == 4
+    assert tr.span_count("p2p") == kept
+    # histograms count KEPT spans only: totals equal ring span counts
+    assert tr.hist_total(trace.HIST_P2P_COMPLETE) == kept
+
+
+def test_adaptive_sampling_backs_off_on_seen():
+    """The period doubles every trace_sample_auto SEEN events (kept +
+    skipped) up to trace_sample_max; quiet categories never leave full
+    fidelity, and the exact counters still balance."""
+    registry.set("trace_sample_auto", "8")
+    registry.set("trace_sample_max", "16")
+    tr = trace.Tracer(0, capacity=4096)
+    kept = 0
+    for i in range(200):
+        t0 = tr.start_sampled(trace.CAT_COLL)
+        if t0:
+            tr.end(t0, trace.NAME_MEET, trace.CAT_COLL, 1, i, 0)
+            kept += 1
+    rates = tr.sampling_rates()
+    assert rates["coll"] == 16             # reached the cap...
+    assert rates["p2p"] == 1               # ...quiet cat untouched
+    assert kept < 60                       # geometric backoff bit
+    assert tr.cat_seen("coll") == 200
+    assert tr.span_count("coll") == kept
+    assert tr.dropped_by_cat()["coll"] == 200 - kept
+
+
+def test_sampling_pvars_and_dump_sections(tmp_path):
+    """The sampling/drop accounting is visible everywhere a consumer
+    looks: MPI_T pvars in-job, the per-rank dump's sampling /
+    dropped_by_cat / anchor sections, and the traceview summary."""
+    registry.set("trace_enable", "1")
+    registry.set("trace_dump_path", str(tmp_path))
+    registry.set("trace_sample_spec", "coll:8")
+
+    def fn(comm):
+        for _ in range(32):
+            comm.Barrier()
+        tr = comm.state.tracer
+        from ompi_tpu import mpit
+        mpit.init_thread()
+        try:
+            sess = mpit.pvar_session_create()
+            rates = mpit.pvar_read(
+                mpit.pvar_handle_alloc(sess, "trace_sampling_rate"))
+            dropped = mpit.pvar_read(
+                mpit.pvar_handle_alloc(sess, "trace_dropped_coll"))
+        finally:
+            mpit.finalize()
+        assert rates["coll"] == 8
+        assert dropped > 0
+        assert dropped == tr.dropped_by_cat()["coll"]
+        # exactness through the pvar surface: kept + dropped == seen
+        assert tr.span_count("coll") + dropped == tr.cat_seen("coll")
+        return True
+
+    assert all(run_ranks(2, fn))
+    doc = json.loads((tmp_path / "trace-r0.json").read_text())
+    assert doc["sampling"]["coll"] == 8
+    assert doc["dropped_by_cat"]["coll"] > 0
+    assert doc["anchor"]["wall_s"] > 0 and doc["anchor"]["perf_ns"] > 0
+    dumps = traceview.load_dumps([str(tmp_path / "*.json")])
+    text = traceview.summary(dumps, [0.0, 0.0])
+    assert "dropped by category" in text
+    assert "sampling 1-in-N" in text and "coll:8" in text
 
 
 # -- the traced world -------------------------------------------------------
@@ -162,7 +319,10 @@ def test_histogram_pvars_match_span_counts():
             assert mpit.pvar_read(ph) == tr.recorded
         finally:
             mpit.finalize()
-        # progress ticks were observed (the loop ran at least once)
+        # sweep latency is itself sampled 1-in-16: enough explicit
+        # sweeps guarantee at least one lands on the timed stride
+        for _ in range(33):
+            comm.state.progress.progress()
         assert tr.hist_total(trace.HIST_PROGRESS_TICK) > 0
         return True
 
@@ -227,6 +387,46 @@ def test_traceview_cli(tmp_path):
     doc = json.loads(out.read_text())
     assert len([e for e in doc["traceEvents"] if e["ph"] == "X"]) > 0
     assert doc["otherData"]["ranks"]["0"]["dropped"] == 0
+
+
+# -- the hot-path budget lint -----------------------------------------------
+
+def test_hotpath_audit_clean():
+    """Tier-1 gate: every declared hot function passes the AST lint —
+    no container displays, no f-strings, no banned builtins, no
+    time.time.  A refactor that sneaks an allocation back onto the
+    recording path fails HERE, not in a perf probe three PRs later."""
+    from ompi_tpu.tools import hotpath_audit
+    assert hotpath_audit.audit() == []
+
+
+def test_hotpath_audit_detects_regressions():
+    from ompi_tpu.tools import hotpath_audit
+    bad = (
+        "import time\n"
+        "class Tracer:\n"
+        "    def end(self):\n"
+        "        x = (1, 2)\n"
+        "        y = [3]\n"
+        "        d = {'a': 1}\n"
+        "        s = f'{x}'\n"
+        "        z = dict(a=1)\n"
+        "        return time.time()\n"
+    )
+    got = hotpath_audit.audit_source(bad, ("Tracer.end",), "fake.py")
+    text = "\n".join(got)
+    for what in ("tuple allocation", "list allocation",
+                 "dict allocation", "f-string", "call to dict()",
+                 "time.time reference"):
+        assert what in text, f"lint missed: {what}"
+    # a Store-context unpack target is NOT an allocation
+    ok = "def f(pair):\n    a, b = pair\n    return a\n"
+    assert hotpath_audit.audit_source(ok, ("f",), "fake.py") == []
+    # a renamed/missing hot function is itself a violation (the audit
+    # must never silently stop covering a function)
+    missing = hotpath_audit.audit_source(
+        "def g():\n    pass\n", ("f",), "fake.py")
+    assert missing and "not found" in missing[0]
 
 
 # -- shared PERUSE instrumentation points -----------------------------------
