@@ -210,6 +210,7 @@ def _prune_dead_locked() -> bool:
     for s in list(_live_states):
         w = getattr(s.rte, "world", None)
         if getattr(s, "finalized", False) or \
+                getattr(s, "ulfm_dead", False) or \
                 getattr(w, "aborted", None):
             _live_states.discard(s)
     return bool(_live_states)

@@ -2,10 +2,10 @@
 
 SCR-style multi-level C/R (Moody et al., "Design, Modeling, and
 Evaluation of a Scalable Multi-level Checkpointing System") applied to
-the TPU-host model: each rank's checkpoint blob — the same
-host-captured payload ``cr.checkpoint`` would write to the filesystem
-store — is pickled once and replicated over the wire to
-``cr_buddy_degree`` partner ranks, who hold it in process memory
+the TPU-host model: each rank's checkpoint blob — the same sharded
+image (cr/shard.py: pickled residue + CRC-stamped array shards) the
+filesystem tier writes — is serialized once and replicated over the
+wire to ``cr_buddy_degree`` partner ranks, who hold it in process memory
 (``ProcState.extra["cr_buddy"]``).  Nothing touches a filesystem: the
 copies live exactly where a respawned replacement can reach them over
 MPI p2p, which is what makes kill -> respawn -> restore work without a
@@ -41,13 +41,14 @@ pickle, no traffic (the --probe-respawn budget check measures this).
 
 from __future__ import annotations
 
-import pickle
 import time
 from typing import Any, Dict, Optional
 
 import numpy as np
 
-from ompi_tpu.cr import _decode, _encode, quiesce
+from ompi_tpu.cr import _keep_var as _cr_keep_var
+from ompi_tpu.cr import quiesce
+from ompi_tpu.cr import shard as _shard
 from ompi_tpu.mca.params import registry as _registry
 
 _degree_var = _registry.register(
@@ -105,8 +106,19 @@ def committed_seq(state) -> int:
     return _buddy_state(state)["committed"]
 
 
+def _keep_seqs() -> int:
+    """Sequences retained per rank: the job-wide ``cr_keep`` knob,
+    floored at KEEP_SEQS so the pre-barrier commit window can never
+    discard the only restorable snapshot (the same knob prunes the
+    filesystem tier's epoch directories — one retention policy across
+    tiers).  cr_keep 0 means keep-all there, but buddy copies live in
+    partner RAM, so the KEEP_SEQS default applies instead."""
+    k = int(_cr_keep_var.value)
+    return max(KEEP_SEQS, k) if k > 0 else KEEP_SEQS
+
+
 def _prune(bs: Dict[str, Any], seq: int) -> None:
-    floor = seq - KEEP_SEQS  # keep (seq, seq-1, ...): KEEP_SEQS of them
+    floor = seq - _keep_seqs()  # keep (seq, seq-1, ...)
     for s in [s for s in bs["self"] if s <= floor]:
         del bs["self"][s]
     for k in [k for k in bs["held"] if k[1] <= floor]:
@@ -135,12 +147,20 @@ def checkpoint(comm, payload: Any, degree: Optional[int] = None) -> int:
     # must not be torn by an armed ft interrupt (same discipline as
     # cr.checkpoint)
     with state.progress.deferred_interrupts():
+        from ompi_tpu.op.op import MAX
         t0 = time.perf_counter()
         bs = _buddy_state(state)
-        seq = bs["committed"] + 1
-        blob = pickle.dumps(
-            {"payload": _encode(payload), "rank": comm.rank, "seq": seq},
-            protocol=pickle.HIGHEST_PROTOCOL)
+        # agree on the sequence number: a replacement rank that was
+        # re-seeded from the filesystem tier (or joined before its
+        # first restore) has a stale local counter — max(committed)+1
+        # keeps the ring's blob keys aligned on every rank
+        me = np.array([bs["committed"]], dtype=np.int64)
+        mx = np.empty(1, dtype=np.int64)
+        comm.Allreduce(me, mx, MAX)
+        seq = int(mx[0]) + 1
+        # the exact shard image the filesystem tier writes (residue +
+        # CRC-stamped shards), not a second ad-hoc whole-state pickle
+        blob = _shard.dumps(payload)
         mine = np.frombuffer(blob, dtype=np.uint8)
         nbytes = np.array([len(blob)], dtype=np.int64)
         peer_n = np.zeros(1, dtype=np.int64)
@@ -223,8 +243,7 @@ def restore(comm) -> Optional[Any]:
             rbuf = np.empty(int(n[0]), dtype=np.uint8)
             comm.Recv(rbuf, supplier, _TAG_RESTORE + 1)
             bs["self"][restore_seq] = rbuf.tobytes()
-    obj = pickle.loads(bs["self"][restore_seq])
+    out = _shard.loads(bs["self"][restore_seq], state.device)
     bs["committed"] = restore_seq
-    out = _decode(obj["payload"], state.device)
     comm.Barrier()
     return out

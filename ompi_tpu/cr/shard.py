@@ -1,0 +1,241 @@
+"""Sharded payload serialization shared by every checkpoint tier.
+
+ISSUE 5's buddy tier pickled a rank's *whole* state per partner; this
+module is the fix (ISSUE 8 satellite) and the substrate of the
+filesystem tier (cr/ckpt.py): a payload pytree is split into
+
+  * a **residue** — the pickled skeleton with every array leaf
+    replaced by an indexed ``_ShardRef`` placeholder.  Small (shapes,
+    Python scalars, dict keys), safe to materialize eagerly.
+  * **shards** — one per array leaf, carrying dtype/shape metadata and
+    a ``zlib.crc32`` over the raw bytes.  jax arrays are immutable, so
+    ``plan`` holds a *reference* and defers the device→host copy to
+    ``drain`` (the async-drain engine calls it from progress ticks);
+    numpy arrays are mutable and get snapshotted at plan time — that
+    copy is part of the checkpoint's enqueue cost by design.
+
+The split is what lets the filesystem tier write shard-at-a-time with
+per-shard integrity, and lets buddy ship the exact same bytes the
+durable tier would, instead of a second ad-hoc pickle format.
+
+Mirrors Open MPI's layering where crs components share one snapshot
+image format with the sstore layer (ref: opal/mca/crs/crs.h,
+orte/mca/sstore) — one serializer, many transports.
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+import zlib
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+class _ShardRef:
+    """Pickle-stable placeholder for an extracted array leaf."""
+
+    __slots__ = ("idx",)
+
+    def __init__(self, idx: int) -> None:
+        self.idx = idx
+
+
+class Shard:
+    """One array leaf of a checkpoint plan.
+
+    ``kind`` records whether the leaf was a device (jax) or host
+    (numpy) array so ``rebuild`` puts it back where it came from.
+    ``arr`` holds the original leaf until :func:`drain` converts it to
+    ``host`` (a flat uint8 view) and stamps ``crc``.
+    """
+
+    __slots__ = ("idx", "kind", "dtype", "shape", "nbytes", "arr",
+                 "host", "crc")
+
+    def __init__(self, idx: int, kind: str, dtype: str,
+                 shape: Tuple[int, ...], nbytes: int) -> None:
+        self.idx = idx
+        self.kind = kind
+        self.dtype = dtype
+        self.shape = shape
+        self.nbytes = nbytes
+        self.arr: Any = None
+        self.host: Optional[np.ndarray] = None
+        self.crc = 0
+
+    def meta(self) -> Dict[str, Any]:
+        """JSON-safe manifest entry for this shard."""
+        return {"idx": self.idx, "kind": self.kind, "dtype": self.dtype,
+                "shape": list(self.shape), "nbytes": self.nbytes,
+                "crc": self.crc}
+
+
+class Plan:
+    """A planned (but possibly not yet drained) rank snapshot."""
+
+    __slots__ = ("residue", "shards")
+
+    def __init__(self, residue: bytes, shards: List[Shard]) -> None:
+        self.residue = residue
+        self.shards = shards
+
+    @property
+    def shard_nbytes(self) -> int:
+        return sum(s.nbytes for s in self.shards)
+
+    @property
+    def total_nbytes(self) -> int:
+        return len(self.residue) + self.shard_nbytes
+
+
+def _leaf_nbytes(dtype: np.dtype, shape: Tuple[int, ...]) -> int:
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return int(dtype.itemsize) * n
+
+
+def plan(payload: Any) -> Plan:
+    """Walk the payload pytree, extracting array leaves into shards.
+
+    jax leaves are held by reference (immutable — no tearing risk);
+    numpy leaves are copied now so later mutation by the application
+    cannot tear the snapshot.  Object-dtype numpy arrays cannot be
+    byte-sharded and stay in the residue pickle.
+    """
+    import jax
+
+    shards: List[Shard] = []
+
+    def walk(obj):
+        if isinstance(obj, jax.Array):
+            dt = np.dtype(obj.dtype)
+            sh = Shard(len(shards), "jax", dt.str, tuple(obj.shape),
+                       _leaf_nbytes(dt, tuple(obj.shape)))
+            sh.arr = obj
+            shards.append(sh)
+            return _ShardRef(sh.idx)
+        if isinstance(obj, np.ndarray) and not obj.dtype.hasobject:
+            dt = obj.dtype
+            sh = Shard(len(shards), "np", dt.str, tuple(obj.shape),
+                       _leaf_nbytes(dt, tuple(obj.shape)))
+            sh.arr = np.array(obj, copy=True)  # snapshot: enqueue cost
+            shards.append(sh)
+            return _ShardRef(sh.idx)
+        if isinstance(obj, dict):
+            return {k: walk(v) for k, v in obj.items()}
+        if isinstance(obj, tuple):
+            return tuple(walk(v) for v in obj)
+        if isinstance(obj, list):
+            return [walk(v) for v in obj]
+        return obj
+
+    skeleton = walk(payload)
+    residue = pickle.dumps(skeleton, protocol=pickle.HIGHEST_PROTOCOL)
+    return Plan(residue, shards)
+
+
+def drain(sh: Shard) -> int:
+    """Materialize a shard's host bytes (device→host for jax leaves)
+    and stamp its CRC.  Idempotent; returns the shard's byte count.
+    This is the unit of work the async drain engine meters with
+    ``cr_drain_depth``."""
+    if sh.host is None:
+        a = np.ascontiguousarray(np.asarray(sh.arr))
+        sh.arr = None
+        sh.host = a.reshape(-1).view(np.uint8)
+        sh.crc = zlib.crc32(sh.host)
+    return sh.nbytes
+
+
+def _revive(obj, leaves):
+    if isinstance(obj, _ShardRef):
+        return leaves[obj.idx]
+    if isinstance(obj, dict):
+        return {k: _revive(v, leaves) for k, v in obj.items()}
+    if isinstance(obj, tuple):
+        return tuple(_revive(v, leaves) for v in obj)
+    if isinstance(obj, list):
+        return [_revive(v, leaves) for v in obj]
+    return obj
+
+
+def make_leaf(meta: Dict[str, Any], raw: np.ndarray, device):
+    """Rebuild one array leaf from its raw bytes + manifest meta.
+
+    ``raw`` is a flat uint8 array (any backing — a file-read buffer
+    slice works).  jax leaves go back to the rank's device; numpy
+    leaves come back as a private writable copy.
+    """
+    import jax
+
+    a = np.frombuffer(raw.tobytes(), dtype=np.dtype(meta["dtype"]))
+    a = a.reshape(tuple(meta["shape"]))
+    if meta["kind"] == "jax":
+        return (jax.device_put(a, device) if device is not None
+                else jax.numpy.asarray(a))
+    return np.array(a, copy=True)
+
+
+def rebuild(residue: bytes, metas: List[Dict[str, Any]],
+            fetch: Callable[[int], np.ndarray], device) -> Any:
+    """Reassemble a payload: unpickle the residue skeleton and splice
+    the array leaves back in.  ``fetch(idx)`` returns shard ``idx``'s
+    raw uint8 bytes (already CRC-verified by the caller)."""
+    leaves = [None] * len(metas)
+    for m in metas:
+        leaves[m["idx"]] = make_leaf(m, fetch(m["idx"]), device)
+    return _revive(pickle.loads(residue), leaves)
+
+
+# ---------------------------------------------------------------------
+# self-describing one-buffer image (the buddy tier's wire format)
+# ---------------------------------------------------------------------
+
+_MAGIC = b"TPSH"  # shard image v1
+
+
+def dumps(payload: Any) -> bytes:
+    """Serialize a payload eagerly into one self-describing buffer:
+    ``TPSH | u64 header_len | header pickle | shard bytes...``.
+    Same residue/shard split and CRCs the filesystem tier writes, in
+    one contiguous image the buddy ring can ship."""
+    p = plan(payload)
+    for sh in p.shards:
+        drain(sh)
+    header = pickle.dumps(
+        {"residue": p.residue, "shards": [sh.meta() for sh in p.shards]},
+        protocol=pickle.HIGHEST_PROTOCOL)
+    parts = [_MAGIC, struct.pack("<Q", len(header)), header]
+    for sh in p.shards:
+        parts.append(sh.host.tobytes())
+    return b"".join(parts)
+
+
+def loads(data: bytes, device) -> Any:
+    """Inverse of :func:`dumps`; verifies every shard CRC (a buddy
+    replica that rotted in transit or in a partner's memory is caught
+    here, the same way a torn file shard is caught at restore)."""
+    if data[:4] != _MAGIC:
+        raise ValueError("shard.loads: bad magic (not a TPSH image)")
+    (hlen,) = struct.unpack_from("<Q", data, 4)
+    header = pickle.loads(data[12:12 + hlen])
+    metas = header["shards"]
+    base = 12 + hlen
+    offs: List[int] = []
+    o = base
+    for m in metas:
+        offs.append(o)
+        o += m["nbytes"]
+    raws: List[np.ndarray] = []
+    for i, m in enumerate(metas):
+        raw = np.frombuffer(data, np.uint8, m["nbytes"], offs[i])
+        crc = zlib.crc32(raw)
+        if crc != m["crc"]:
+            raise ValueError(
+                f"shard.loads: CRC mismatch on shard {m['idx']} "
+                f"(stored {m['crc']:#010x}, computed {crc:#010x})")
+        raws.append(raw)
+    return rebuild(header["residue"], metas, lambda i: raws[i], device)

@@ -162,6 +162,14 @@ def rejoin(comm, name: str = ""):
         raise ValueError(
             "respawn.rejoin must run on a full-world-size communicator")
     state.progress.interrupt = None  # disarm: rejoin must not re-raise
+    # drop any in-flight filesystem checkpoint epoch torn: it was begun
+    # with the dead ranks and can never commit (the manifest gather
+    # would wait on them forever); the previous committed epoch is
+    # intact by two-phase construction, so the restore ladder
+    # (ckpt.restore — buddy, then filesystem replay) still has its
+    # newest durable state
+    from ompi_tpu.cr import ckpt as _ckpt
+    _ckpt.ft_abort(state)
     store = _ulfm._store(state)
     am_joining = joining(state)
     epoch = state.respawn_epoch + 1

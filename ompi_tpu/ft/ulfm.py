@@ -385,6 +385,9 @@ def arm_rank_kill(state, after_s: float) -> None:
             return
         _trace.instant_state(state, "ft_inject", "ft",
                              cls="rank_kill", rank=state.rank)
+        # this incarnation can never finalize: let process-wide
+        # last-rank accounting (coll.device) stop waiting for it
+        state.ulfm_dead = True
         state.progress.interrupt = RankKilled(
             f"ft_inject rank_kill: rank {state.rank}")
         state.progress.wakeup()
@@ -397,6 +400,7 @@ def arm_rank_kill(state, after_s: float) -> None:
 def kill_now(state):
     """Deterministic in-line kill for tests/benchmarks: the calling
     rank dies HERE (no timer race)."""
+    state.ulfm_dead = True
     raise RankKilled(f"rank {state.rank} killed (ulfm.kill_now)")
 
 

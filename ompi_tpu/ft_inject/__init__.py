@@ -43,8 +43,8 @@ _plan_var = registry.register(
     help="Comma list of fault classes to arm, each optionally "
          "class:rate — e.g. 'drop:0.05,sever:0.01'.  Classes: drop, "
          "delay, dup, reorder, corrupt, sever, daemon_kill, "
-         "oob_sever, kv_partition, rank_kill.  Empty = framework "
-         "disabled")
+         "oob_sever, kv_partition, rank_kill, io_stall, io_partial, "
+         "io_enospc.  Empty = framework disabled")
 _rate_var = registry.register(
     "ft", "inject", "rate", 0.02, float,
     help="Default per-event injection probability for plan entries "
@@ -78,6 +78,13 @@ _delay_ms_var = registry.register(
 
 BTL_CLASSES = ("drop", "delay", "dup", "reorder", "corrupt", "sever")
 NODE_CLASSES = ("daemon_kill", "oob_sever")
+# checkpoint-I/O faults, consumed by the cr/ckpt shard-write path:
+#   io_stall   — the write is held delay_ms before hitting the disk
+#   io_partial — the shard is silently truncated (manifest CRC is
+#                over the full shard, so restore detects the tear)
+#   io_enospc  — the write raises ENOSPC; the epoch aborts on every
+#                rank through the commit error agreement
+IO_CLASSES = ("io_stall", "io_partial", "io_enospc")
 # permanent per-RANK scenarios: unlike the transient classes these
 # fire exactly once (there is no rate — death is not probabilistic)
 RANK_CLASSES = ("rank_kill",)
@@ -188,6 +195,30 @@ def coll_injector(rank: int) -> Optional[CollInjector]:
     if not p:
         return None
     return CollInjector("coll", rank, p)
+
+
+class IoInjector(_Scoped):
+    """Faults at the checkpoint shard-write choke point (cr/ckpt).
+    Deliberately NOT wired into io.file itself: a raise inside an
+    fcoll aggregator would strand peer ranks in the collective's
+    barrier, whereas the ckpt layer agrees on errors before anything
+    collective happens."""
+
+    @property
+    def delay_s(self) -> float:
+        return max(0, _delay_ms_var.value) / 1000.0
+
+    def pick(self) -> Optional[str]:
+        """One shard is about to be written; return a fault class to
+        apply, or None to write it clean."""
+        return self._roll()
+
+
+def io_injector(rank: int) -> Optional[IoInjector]:
+    p = {c: r for c, r in plan().items() if c in IO_CLASSES}
+    if not p:
+        return None
+    return IoInjector("io", rank, p)
 
 
 def node_faults(node_id: int) -> List[str]:

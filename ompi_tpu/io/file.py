@@ -118,13 +118,23 @@ class File:
         self.pos = 0            # individual fp, etype units
         self._closed = False
         # sharedfp: rank 0 exposes the counter through a window on a
-        # dup (internal traffic must not alias user comm traffic)
-        from ompi_tpu.osc import window as oscmod
-        self._sp_comm = comm.dup(name=f"file-{id(self):x}")
+        # dup (internal traffic must not alias user comm traffic).
+        # The ROMIO-style info hint "sharedfp" => "false" skips the
+        # sub-framework entirely: no dup, no window, and no per-sweep
+        # AM polling for the file's whole lifetime — callers that
+        # never touch shared file pointers (the checkpoint engine)
+        # keep the hot path clean.
+        self._sp_comm = None
+        self._sp_win = None
         self._sp_mem = np.zeros(1, dtype=np.int64)
-        self._sp_win = oscmod.create(self._sp_comm,
-                                     self._sp_mem if comm.rank == 0
-                                     else np.zeros(0, dtype=np.int64))
+        if str(self.info.get("sharedfp", "true")).lower() not in (
+                "false", "0", "disable"):
+            from ompi_tpu.osc import window as oscmod
+            self._sp_comm = comm.dup(name=f"file-{id(self):x}")
+            self._sp_win = oscmod.create(self._sp_comm,
+                                         self._sp_mem if comm.rank == 0
+                                         else np.zeros(0,
+                                                       dtype=np.int64))
         if amode & MODE_APPEND:
             # MPI_MODE_APPEND: individual + shared fps start at EOF
             self.pos = self._size_etypes()
@@ -137,8 +147,9 @@ class File:
         if self._closed:
             return
         self.comm.Barrier()
-        self._sp_win.free()
-        self._sp_comm.free()
+        if self._sp_win is not None:
+            self._sp_win.free()
+            self._sp_comm.free()
         os.close(self.fd)
         if self.amode & MODE_DELETE_ON_CLOSE and self.comm.rank == 0:
             try:
@@ -146,6 +157,22 @@ class File:
             except OSError:
                 pass
         self._closed = True
+
+    def ft_abandon(self) -> None:
+        """LOCAL close for fault paths: the job just lost ranks, so
+        ``close``'s barrier and the sharedfp window's free handshake
+        are not an option.  Drops the fd and abandons the window (its
+        wildcard receive must not survive into the recovered epoch —
+        see Window.abandon); the dup'd comm is left for GC."""
+        if self._closed:
+            return
+        self._closed = True
+        if self._sp_win is not None:
+            self._sp_win.abandon()
+        try:
+            os.close(self.fd)
+        except OSError:
+            pass
 
     def get_size(self) -> int:
         return os.fstat(self.fd).st_size
@@ -326,9 +353,17 @@ class File:
         return _done_req(self.comm, self.write_at(offset, spec))
 
     # -- shared fp --------------------------------------------------------
+    def _sp_required(self) -> None:
+        if self._sp_win is None:
+            raise RuntimeError(
+                "shared file pointers were disabled by the "
+                "'sharedfp' info hint at open (MPI_ERR_UNSUPPORTED_"
+                "OPERATION)")
+
     def _shared_fetch_add(self, delta: int) -> int:
         from ompi_tpu.op import op as opmod
         from ompi_tpu.osc.window import LOCK_SHARED
+        self._sp_required()
         result = np.zeros(1, dtype=np.int64)
         self._sp_win.lock(0, LOCK_SHARED)
         self._sp_win.fetch_and_op(delta, result, 0, 0, opmod.SUM)
@@ -339,6 +374,7 @@ class File:
         """Collective; all ranks must give the same offset."""
         from ompi_tpu.op import op as opmod
         from ompi_tpu.osc.window import LOCK_EXCLUSIVE
+        self._sp_required()
         self.comm.Barrier()
         if self.comm.rank == 0:
             if whence == SEEK_CUR:
@@ -373,6 +409,7 @@ class File:
     # (ref: sharedfp read_ordered semantics)
     def _ordered_pos(self, nbytes: int) -> int:
         from ompi_tpu.op import op as opmod
+        self._sp_required()  # symmetric raise BEFORE any collective
         mine = np.array([nbytes // max(1, self.view.etype.size)],
                         dtype=np.int64)
         pref = np.zeros(1, dtype=np.int64)

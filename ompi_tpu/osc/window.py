@@ -179,6 +179,14 @@ class Window:
                 continue
             if self._hdr_req is None or not self._hdr_req.complete:
                 return events
+            if self._hdr_req.status.error:
+                # a peer died while the wildcard header receive was
+                # parked (ulfm_sweep error-completes it, buffer
+                # untouched): the window cannot make progress until
+                # recovery frees or abandons it — park instead of
+                # parsing a zeroed header as an RMA message
+                self._hdr_req = None
+                return events
             hdr = self._hdr_buf.copy()
             src = self._hdr_req.status.source
             self._hdr_req = None
@@ -565,6 +573,24 @@ class Window:
             self._hdr_req.cancel()
             self._hdr_req = None
         self.comm.free()
+
+    def abandon(self) -> None:
+        """LOCAL teardown for fault paths: stop polling and receiving
+        on this window without the collective handshake ``free``
+        needs (peers may be dead).  Cancelling the wildcard header
+        receive matters beyond hygiene: the dup'd comm's cid can be
+        reused by a communicator built after recovery, and a live
+        wildcard irecv on the dead window would steal — and misparse —
+        the new communicator's traffic.  The dup'd comm itself is left
+        for garbage collection."""
+        if self._freed:
+            return
+        self._freed = True
+        self._progress.unregister(self._am_progress)
+        if self._hdr_req is not None:
+            self._hdr_req.cancel()
+            self._hdr_req = None
+        self._pending = None
 
     def __repr__(self) -> str:
         return (f"Window({self.comm.name}, rank={self.rank}/{self.size}, "

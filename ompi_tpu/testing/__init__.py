@@ -50,8 +50,11 @@ def run_ranks(n: int, fn: Callable, devices: bool = False,
     respawn_joining at the failure's epoch.  fn runs again on the
     replacement (applications branch on respawn.joining(state) to
     rejoin + restore instead of starting over) and its return value
-    fills the rank's result slot.  Failures are handled one rejoin at
-    a time, matching mpirun's sequential-epoch contract.
+    fills the rank's result slot.  Kills reaped in the same window are
+    replaced in ONE rejoin epoch (the decision's failed set), so
+    correlated multi-kill scenarios — a rank plus all its buddy
+    partners — exercise a single batched recovery; kills that land
+    later degrade to sequential epochs.
     """
     world = InprocWorld(n)
     results: List[Any] = [None] * n
@@ -99,7 +102,15 @@ def run_ranks(n: int, fn: Callable, devices: bool = False,
                 from ompi_tpu.ft import ulfm as _ulfm
                 if isinstance(e, _ulfm.RankKilled):
                     # the injected death IS the test scenario: the
-                    # rank is gone, survivors mitigate via ULFM
+                    # rank is gone, survivors mitigate via ULFM.
+                    # Mark the corpse for process-wide accounting
+                    # (coll.device last-rank dispatcher drain) —
+                    # whatever raised RankKilled, this incarnation
+                    # will never run mpi_finalize
+                    try:
+                        state.ulfm_dead = True
+                    except UnboundLocalError:
+                        pass
                     _ulfm.publish_world_failure(world, rank)
                     if respawn:
                         with respawn_cv:
@@ -131,21 +142,34 @@ def run_ranks(n: int, fn: Callable, devices: bool = False,
     if respawn:
         # supervision loop (the inproc analog of mpirun's respawn
         # branch): reap kills, wait out each epoch's rejoin decision,
-        # start the replacement, until every rank thread has finished
+        # start the replacements, until every rank thread has finished.
+        # Kills that land in the same reap window ride ONE epoch — the
+        # rejoin decision is a set, so a correlated multi-kill (a rank
+        # plus its buddy partners) is replaced in a single rejoin, the
+        # way mpirun batches simultaneous child exits.  The survivors'
+        # union can also decide ranks whose kill note has not reached
+        # this driver yet; those are remembered in `owed` so the late
+        # queue entry does not double-respawn them.
         from ompi_tpu.ft import respawn as _respawn
         deadline = time.monotonic() + timeout
         epoch = 0
+        owed: set = set()
         while True:
             alive = any(t.is_alive() for t in live.values())
             with respawn_cv:
                 pending, respawn_q[:] = list(respawn_q), []
-            for rank in pending:
+            batch = [r for r in pending if r not in owed]
+            owed.difference_update(pending)
+            if batch:
                 epoch += 1
-                _respawn.thread_decision(
+                d = _respawn.thread_decision(
                     world, epoch,
                     timeout=max(1.0, deadline - time.monotonic()))
-                live[rank] = _spawn(rank, joining_epoch=epoch)
-            if not alive and not pending:
+                decided = sorted(int(x) for x in d["failed"])
+                owed.update(r for r in decided if r not in batch)
+                for rank in decided:
+                    live[rank] = _spawn(rank, joining_epoch=epoch)
+            if not alive and not batch:
                 break
             if world.aborted is not None and not pending:
                 # a real error (not a kill): let the join path below

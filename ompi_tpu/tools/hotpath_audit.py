@@ -44,6 +44,17 @@ HOT_FUNCTIONS: Dict[str, Tuple[str, ...]] = {
     "ompi_tpu/pml/ob1.py": (
         "PmlOb1._trace_p2p_end",
     ),
+    # the progress sweep runs on every blocking wait iteration; the
+    # checkpoint drain tick rides every 8th sweep for the rest of the
+    # job once one checkpoint has been taken — neither may allocate
+    # on its idle path (ISSUE 8: the async drain hook must not tax
+    # ranks that aren't checkpointing)
+    "ompi_tpu/runtime/progress.py": (
+        "Progress.progress",
+    ),
+    "ompi_tpu/cr/ckpt.py": (
+        "Engine.tick",
+    ),
 }
 
 _BANNED_BUILTIN_CALLS = ("dict", "list", "set", "tuple", "frozenset")

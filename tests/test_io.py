@@ -550,3 +550,50 @@ def test_view_legal_interleaved_tiles():
     ft = dt.resized(dt.indexed_block(1, [0, 3], dt.INT32_T), 0, 8)
     v = FileView(0, dt.INT32_T, ft)
     assert v.map_bytes(0, 16) == [(0, 4), (12, 4), (8, 4), (20, 4)]
+
+
+# -- sharedfp info hint ------------------------------------------------------
+
+def test_sharedfp_hint_disables_shared_pointers(tmp_path):
+    """info {'sharedfp': 'false'} skips the shared-pointer window
+    entirely (no dup'd comm, no per-sweep AM polling — the checkpoint
+    engine's open mode); explicit-offset and collective I/O still
+    work, shared-fp operations raise."""
+    path = str(tmp_path / "nosp.bin")
+
+    def fn(comm):
+        f = mpiio.open(comm, path, RW, info={"sharedfp": "false"})
+        assert f._sp_win is None and f._sp_comm is None
+        data = np.full(4, float(comm.rank), dtype=np.float64)
+        f.write_at(comm.rank * 32, data)
+        f.sync()
+        comm.Barrier()
+        back = np.zeros(4, dtype=np.float64)
+        f.read_at_all(comm.rank * 32, back)
+        np.testing.assert_array_equal(back, data)
+        with pytest.raises(RuntimeError, match="sharedfp"):
+            f.write_shared(data)
+        with pytest.raises(RuntimeError, match="sharedfp"):
+            f.get_position_shared()
+        f.close()
+        return True
+
+    assert run_ranks(2, fn) == [True, True]
+
+
+def test_sharedfp_default_still_enabled(tmp_path):
+    """Without the hint the shared pointer works as before."""
+    path = str(tmp_path / "sp.bin")
+
+    def fn(comm):
+        f = mpiio.open(comm, path, RW)
+        assert f._sp_win is not None
+        one = np.full(2, float(comm.rank + 1), dtype=np.float64)
+        f.write_shared(one)
+        comm.Barrier()
+        # default view: BYTE etype, so 2 doubles x 2 ranks = 32 bytes
+        assert f.get_position_shared() == 32
+        f.close()
+        return True
+
+    assert run_ranks(2, fn) == [True, True]
