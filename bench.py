@@ -171,6 +171,15 @@ def main() -> None:
                          "two state sizes; persist under 'probe_ckpt' "
                          "in BENCH_DETAIL.json, and FAIL (exit 1) if "
                          "the steady-state overhead exceeds 5%%")
+    ap.add_argument("--probe-serve", action="store_true",
+                    help="Measure the multiplexed DVM service plane: "
+                         "warm session-attach latency vs a cold "
+                         "mpirun launch, and sustained jobs/sec with "
+                         "p50/p99 under concurrent submitters; "
+                         "persist under 'probe_serve' in "
+                         "BENCH_DETAIL.json, and FAIL (exit 1) if a "
+                         "warm attach is not at least 10x faster "
+                         "than the cold launch")
     opts = ap.parse_args()
 
     detail_path = os.path.join(
@@ -353,6 +362,38 @@ def main() -> None:
             sys.exit(1)
         return
 
+    if opts.probe_serve:
+        from benchmarks.probe_serve import persist, run_probe
+
+        probe = run_probe()
+        notes = persist(probe, detail_path)
+        line = {
+            "metric": f"dvm serve plane, np {probe['np']} warm attach "
+                      f"vs cold mpirun + {probe['submitters']} "
+                      "concurrent submitters",
+            "value": probe["attach_med_ms"],
+            "unit": "ms_warm_attach_median",
+            "cold_launch_s": probe["cold_launch_s"],
+            "attach_speedup_vs_cold": probe["attach_speedup_vs_cold"],
+            "jobs_per_s": probe["jobs_per_s"],
+            "job_p50_ms": probe["job_p50_ms"],
+            "job_p99_ms": probe["job_p99_ms"],
+            "compiled_cache_hits": probe["compiled_cache_hits"],
+            "within_budget": probe["within_budget"],
+        }
+        line.update({k: v for k, v in notes.items() if "error" in k})
+        sys.stderr.write(json.dumps(probe, indent=1) + "\n")
+        print(json.dumps(line))
+        if not probe["within_budget"]:
+            # the service-plane contract: attaching a warm session
+            # must be an order of magnitude below a cold launch
+            sys.stderr.write(
+                f"FAIL: warm attach {probe['attach_med_ms']} ms is "
+                f"not {probe['cold_factor']:.0f}x below the cold "
+                f"launch {probe['cold_launch_s']} s\n")
+            sys.exit(1)
+        return
+
     if opts.quick:
         caps = {"ar": 64 * 1024, "bcast": 16 * 1024, "a2a": 4 * 1024,
                 "rsb": 16 * 1024}
@@ -468,7 +509,8 @@ def main() -> None:
             json.dump({**{k: prior[k]
                           for k in ("probe_dispatch", "trace_overhead",
                                     "probe_recovery", "probe_respawn",
-                                    "probe_pipeline", "probe_ckpt")
+                                    "probe_pipeline", "probe_ckpt",
+                                    "probe_serve")
                           if isinstance(prior, dict) and k in prior},
                        "device_us": dev, "software_us": sw,
                        "software_tuned_tcp_us": sw_tcp,

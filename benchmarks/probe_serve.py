@@ -1,0 +1,204 @@
+"""--probe-serve microbench: the multiplexed DVM service plane.
+
+Two questions, answered against a live in-process pool (the same
+embedded-server harness test_dvm.py uses):
+
+1. **How much faster is a warm attach than a cold launch?**  Cold
+   baseline: a full ``mpirun -np N`` subprocess — interpreter start,
+   jax import, wireup, one device collective, teardown — timed
+   end-to-end, best-of-REPS (the latency a user pays today per job).
+   Warm side: ``DvmClient.attach(N)`` against the resident pool —
+   session bring-up over the already-warm runtime — median over many
+   attach/detach cycles.  The service-plane claim is attach latency
+   at least COLD_FACTOR below the cold launch; bench.py FAILS loudly
+   if it is not.
+
+2. **What does the pool sustain under contention?**  SUBMITTERS
+   concurrent clients each attach a session, pump JOBS_PER_SUBMITTER
+   back-to-back runs of the standard warm-pool workload through it,
+   and detach.  Reported: aggregate jobs/sec, per-job p50/p99, and
+   the pool's own pvar counters (attaches, peak sessions, compiled
+   cache hits) proving the sessions actually shared one warmed
+   executable cache.
+
+Results land in BENCH_DETAIL.json under ``probe_serve``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List
+
+NP = 4                   # ranks per session, both sides of the pair
+CAPACITY = 8             # pool rank capacity
+COLD_REPS = 3
+ATTACH_REPS = 12
+SUBMITTERS = 4           # concurrent clients (>= the acceptance bar)
+SUBMITTER_NP = 2         # 4 x 2 = 8 ranks resident at once
+JOBS_PER_SUBMITTER = 6
+COLD_FACTOR = 10.0       # warm attach must beat cold launch by this
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PROG = os.path.join(REPO, "tests", "_dvm_prog.py")
+
+
+def _pct(sorted_vals: List[float], p: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1,
+            max(0, int(round(p / 100.0 * (len(sorted_vals) - 1)))))
+    return sorted_vals[i]
+
+
+def _measure_cold() -> List[float]:
+    """Full mpirun subprocess launches: interpreter + jax import +
+    wireup + one collective + teardown, wall-clock each."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                        " --xla_force_host_platform_device_count=8")
+    times = []
+    for _ in range(COLD_REPS):
+        t0 = time.perf_counter()
+        r = subprocess.run(
+            [sys.executable, "-m", "ompi_tpu.tools.mpirun",
+             "-np", str(NP), PROG],
+            capture_output=True, timeout=300, env=env, cwd=REPO)
+        dt = time.perf_counter() - t0
+        if r.returncode != 0:
+            raise RuntimeError(
+                f"cold mpirun failed rc={r.returncode}: "
+                f"{r.stderr.decode(errors='replace')[-300:]}")
+        times.append(dt)
+    return times
+
+
+def run_probe() -> Dict:
+    import jax
+
+    from ompi_tpu.tools.dvm import DvmClient, DVMServer
+
+    cold_times = _measure_cold()
+    cold_s = min(cold_times)
+
+    import tempfile
+    tmpdir = tempfile.mkdtemp(prefix="probe_serve_")
+    uri = os.path.join(tmpdir, "dvm.uri")
+    srv = DVMServer(CAPACITY, devices=jax.devices(), uri_file=uri)
+    srv.start()
+    try:
+        # -- warm attach latency ------------------------------------
+        attach_s: List[float] = []
+        cli = DvmClient(uri)
+        for i in range(ATTACH_REPS + 1):
+            t0 = time.perf_counter()
+            sid = cli.attach(NP)["sid"]
+            dt = time.perf_counter() - t0
+            cli.detach(sid)
+            if i > 0:          # rep 0 warms the pool's runtime paths
+                attach_s.append(dt)
+        cli.close()
+        attach_s.sort()
+        attach_med = statistics.median(attach_s)
+
+        # -- sustained jobs/sec under concurrent submitters ---------
+        job_s: List[float] = []
+        jlock = threading.Lock()
+        errs: List[str] = []
+
+        def submitter(idx: int) -> None:
+            try:
+                c = DvmClient(uri)
+                sid = c.attach(SUBMITTER_NP, timeout=120)["sid"]
+                for _ in range(JOBS_PER_SUBMITTER):
+                    t0 = time.perf_counter()
+                    r = c.run(sid, PROG, timeout=120)
+                    dt = time.perf_counter() - t0
+                    if r["code"] != 0:
+                        raise RuntimeError(
+                            f"job rc={r['code']}: {r['stderr'][-200:]}")
+                    with jlock:
+                        job_s.append(dt)
+                c.detach(sid)
+                c.close()
+            except Exception as e:  # noqa: BLE001
+                with jlock:
+                    errs.append(f"submitter {idx}: {e}")
+
+        threads = [threading.Thread(target=submitter, args=(i,))
+                   for i in range(SUBMITTERS)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        if errs:
+            raise RuntimeError("; ".join(errs[:3]))
+        job_s.sort()
+
+        from ompi_tpu.coll.device import compile_cache
+        from ompi_tpu.mca.params import registry
+        pv = {name: registry._pvars[f"dvm_{name}"].read()
+              for name in ("attaches", "sessions_peak", "jobs")
+              if f"dvm_{name}" in registry._pvars}
+        cache_hits = int(registry._pvars[
+            "coll_device_cache_hits"].read())
+        builds = compile_cache.builds
+    finally:
+        srv.stop()
+        import shutil
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+    speedup = cold_s / attach_med if attach_med > 0 else 0.0
+    return {
+        "np": NP,
+        "capacity": CAPACITY,
+        "cold_reps": COLD_REPS,
+        "cold_launch_s": round(cold_s, 4),
+        "cold_launch_s_all": [round(t, 4) for t in cold_times],
+        "attach_reps": ATTACH_REPS,
+        "attach_med_ms": round(attach_med * 1e3, 3),
+        "attach_p99_ms": round(_pct(attach_s, 99.0) * 1e3, 3),
+        "attach_speedup_vs_cold": round(speedup, 1),
+        "submitters": SUBMITTERS,
+        "submitter_np": SUBMITTER_NP,
+        "jobs": len(job_s),
+        "jobs_per_s": round(len(job_s) / wall, 2) if wall else 0.0,
+        "job_p50_ms": round(_pct(job_s, 50.0) * 1e3, 3),
+        "job_p99_ms": round(_pct(job_s, 99.0) * 1e3, 3),
+        "pool_pvars": pv,
+        "compiled_cache_hits": cache_hits,
+        "compiled_cache_builds": builds,
+        "cold_factor": COLD_FACTOR,
+        "within_budget": bool(attach_med * COLD_FACTOR <= cold_s),
+    }
+
+
+def persist(probe: Dict, detail_path: str) -> Dict:
+    """Merge under 'probe_serve' in BENCH_DETAIL.json, preserving
+    every other section (the probe_dispatch/trace_overhead pattern)."""
+    notes: Dict = {}
+    try:
+        with open(detail_path) as fh:
+            detail = json.load(fh)
+        if not isinstance(detail, dict):
+            detail = {}
+    except (OSError, ValueError):
+        detail = {}
+    detail["probe_serve"] = probe
+    try:
+        tmp = f"{detail_path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as fh:
+            json.dump(detail, fh, indent=1)
+        os.replace(tmp, detail_path)
+    except OSError as e:
+        notes["detail_error"] = str(e)[:120]
+    return notes

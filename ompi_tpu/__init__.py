@@ -63,6 +63,17 @@ def finalize() -> None:
     global _finalized_once
     st = statemod.maybe_current()
     if st is not None and st.initialized and not st.finalized:
+        if st.serve_resident:
+            # DVM-resident session (tools/dvm): the world outlives the
+            # program.  Finalize degrades to a run boundary — flush
+            # deferred fused batches and meet the peers — so the next
+            # program attached to this session starts from a quiet,
+            # still-warm world.  Real teardown happens at session
+            # detach, when the pool clears serve_resident.
+            from ompi_tpu.coll import fusion as _fusion
+            _fusion.flush_state(st)
+            st.rte.fence()
+            return
         mpi_finalize(st)
         _finalized_once = True
 

@@ -62,6 +62,38 @@ _pv_bytes = registry.register_pvar(
     "coll", "device", "fused_bytes",
     help="Payload bytes carried by fused batches")
 
+# -- cross-session batching (the DVM serve plane, tools/dvm) ---------------
+# Concurrently-resident sessions are independent worlds multiplexed
+# over the SAME device mesh, so their fused batches — each already one
+# dispatch — can share a single XLA call when they land within a short
+# window of each other.  The window only opens while the pool reports
+# >1 resident session (set_xsession_hint), so solo jobs never pay it.
+_xwin_var = registry.register(
+    "dvm", "", "batch_window_us", 0, int,
+    help="Cross-session fusion window (microseconds): a fused batch "
+         "dispatched from a DVM-resident session waits this long for "
+         "compatible batches from OTHER resident sessions and rides "
+         "one combined XLA dispatch with them.  0 disables.  Only "
+         "consulted while more than one session is resident "
+         "(tpu-dvm --batch-window-us sets it pool-wide)")
+_pv_xbatches = registry.register_pvar(
+    "dvm", "", "xsession_batches",
+    help="Combined dispatches that carried fused batches from 2+ "
+         "concurrently-resident DVM sessions")
+_pv_xcolls = registry.register_pvar(
+    "dvm", "", "xsession_collectives",
+    help="Individual collectives that rode a cross-session combined "
+         "dispatch")
+
+_xsession_hint = 0  # resident-session count, maintained by tools/dvm
+
+
+def set_xsession_hint(n: int) -> None:
+    """The DVM pool reports its resident-session count here on every
+    attach/detach; the cross-session window opens only above 1."""
+    global _xsession_hint
+    _xsession_hint = n
+
 
 class FusedRequest(Request):
     """Request handle for a (possibly) coalesced device collective.
@@ -179,6 +211,39 @@ def _build_pack(dev, sig, slots, roots):
     return jax.jit(body, out_shardings=SingleDeviceSharding(dev))
 
 
+def _mesh_slot_outs(sig, xs):
+    """Traced body of one session's mesh-mode batch: ``xs`` is its
+    packed group buffers followed by its raw gather-fold slots;
+    returns the per-slot outputs.  Shared by the single-batch and the
+    cross-session combined executables so both trace the SAME ops per
+    batch — the byte-identity contract of the serve plane."""
+    from jax import lax
+
+    from ompi_tpu.coll import device
+    from ompi_tpu.datatype.device import segment_offsets
+
+    red_map = {"MPI_SUM": lax.psum, "MPI_MAX": lax.pmax,
+               "MPI_MIN": lax.pmin}
+    groups, folds = _group_plan(sig)
+    outs = [None] * len(sig)
+    for gi, (opname, _dt, slots) in enumerate(groups):
+        shapes = [sig[i][1] for i in slots]
+        offs, lens, _total = segment_offsets(shapes)
+        red = red_map[opname](xs[gi], "r")
+        for j, i in enumerate(slots):
+            outs[i] = red[offs[j]:offs[j] + lens[j]].reshape(shapes[j])
+    for fi, i in enumerate(folds):
+        fold = device._fold_fn(sig[i][3])
+        outs[i] = fold(lax.all_gather(xs[len(groups) + fi], "r",
+                                      tiled=False))
+    return outs
+
+
+def _mesh_nin(sig) -> int:
+    groups, folds = _group_plan(sig)
+    return len(groups) + len(folds)
+
+
 def _build_fused_mesh(mesh, sig):
     """One jitted shard_map running a whole fused batch on the comm
     mesh.  Inputs are the per-rank packed group buffers (one per
@@ -187,34 +252,65 @@ def _build_fused_mesh(mesh, sig):
     reduced with ONE psum/pmax/pmin over the concatenation and sliced
     back out at the static offsets."""
     import jax
-    from jax import lax
     from jax.sharding import PartitionSpec as P
 
     from ompi_tpu.coll import device
-    from ompi_tpu.datatype.device import segment_offsets
-
-    n = len(sig)
-    red_map = {"MPI_SUM": lax.psum, "MPI_MAX": lax.pmax,
-               "MPI_MIN": lax.pmin}
-    groups, folds = _group_plan(sig)
 
     def body(*xs):
-        outs = [None] * n
-        for gi, (opname, _dt, slots) in enumerate(groups):
-            shapes = [sig[i][1] for i in slots]
-            offs, lens, _total = segment_offsets(shapes)
-            red = red_map[opname](xs[gi], "r")
-            for j, i in enumerate(slots):
-                outs[i] = red[offs[j]:offs[j] + lens[j]].reshape(shapes[j])
-        for fi, i in enumerate(folds):
-            fold = device._fold_fn(sig[i][3])
-            outs[i] = fold(lax.all_gather(xs[len(groups) + fi], "r",
-                                          tiled=False))
+        return tuple(_mesh_slot_outs(sig, xs))
+
+    nin = _mesh_nin(sig)
+    return jax.jit(device.shard_map_compat(
+        body, mesh, (P("r"),) * nin, (P(None),) * len(sig)))
+
+
+def _build_fused_mesh_multi(mesh, sigs):
+    """Cross-session combined dispatch (mesh mode): one shard_map
+    carrying several sessions' fused batches back to back.  Each
+    session's segment is computed exactly as its solo executable
+    would — the combination only amortizes the dispatch."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from ompi_tpu.coll import device
+
+    nins = [_mesh_nin(s) for s in sigs]
+
+    def body(*xs):
+        outs = []
+        off = 0
+        for s, nin in zip(sigs, nins):
+            outs.extend(_mesh_slot_outs(s, xs[off:off + nin]))
+            off += nin
         return tuple(outs)
 
-    nin = len(groups) + len(folds)
+    nout = sum(len(s) for s in sigs)
     return jax.jit(device.shard_map_compat(
-        body, mesh, (P("r"),) * nin, (P(None),) * n))
+        body, mesh, (P("r"),) * sum(nins), (P(None),) * nout))
+
+
+def _hbm_slot_outs(size, sig, xs):
+    """Traced body of one session's hbm-mode batch over its slot-major
+    ``len(sig)*size`` shards (shared by solo and cross-session
+    combined executables — see _mesh_slot_outs)."""
+    import jax.numpy as jnp
+
+    from ompi_tpu.coll import device
+
+    outs = []
+    for i, (kind, _shape, _dt, extra) in enumerate(sig):
+        shards = xs[i * size:(i + 1) * size]
+        if kind == "bcast":
+            outs.append(shards[extra])
+        elif extra == "MPI_SUM":
+            outs.append(jnp.sum(jnp.stack(shards), axis=0))
+        elif extra == "MPI_MAX":
+            outs.append(jnp.max(jnp.stack(shards), axis=0))
+        elif extra == "MPI_MIN":
+            outs.append(jnp.min(jnp.stack(shards), axis=0))
+        else:
+            outs.append(device._fold_fn(extra)(jnp.stack(shards)))
+    return outs
 
 
 def _build_fused_hbm(size, sig):
@@ -222,29 +318,126 @@ def _build_fused_hbm(size, sig):
     slot-major ``n*size`` shards; each slot stacks + reduces (or picks
     the root shard for bcast).  The win is the single dispatch."""
     import jax
-    import jax.numpy as jnp
 
-    from ompi_tpu.coll import device
+    def body(*xs):
+        return tuple(_hbm_slot_outs(size, sig, xs))
 
-    n = len(sig)
+    return jax.jit(body)
+
+
+def _build_fused_hbm_multi(size, sigs):
+    """Cross-session combined dispatch (hbm mode): several sessions'
+    slot-major shard lists concatenated into one jit call."""
+    import jax
 
     def body(*xs):
         outs = []
-        for i, (kind, shape, dt, extra) in enumerate(sig):
-            shards = xs[i * size:(i + 1) * size]
-            if kind == "bcast":
-                outs.append(shards[extra])
-            elif extra == "MPI_SUM":
-                outs.append(jnp.sum(jnp.stack(shards), axis=0))
-            elif extra == "MPI_MAX":
-                outs.append(jnp.max(jnp.stack(shards), axis=0))
-            elif extra == "MPI_MIN":
-                outs.append(jnp.min(jnp.stack(shards), axis=0))
-            else:
-                outs.append(device._fold_fn(extra)(jnp.stack(shards)))
+        off = 0
+        for s in sigs:
+            n = len(s) * size
+            outs.extend(_hbm_slot_outs(size, s, xs[off:off + n]))
+            off += n
         return tuple(outs)
 
     return jax.jit(body)
+
+
+class _XEntry:
+    __slots__ = ("sig", "args", "outs", "err", "event")
+
+    def __init__(self, sig, args) -> None:
+        import threading
+        self.sig = sig
+        self.args = args
+        self.outs = None
+        self.err = None
+        self.event = threading.Event()
+
+
+class _XBatcher:
+    """Process-global meeting point for cross-session batch
+    coalescing.  Callers are the last-arriver threads of independent
+    sessions' batch rendezvous (device.meet fn) — one thread per
+    session batch.  The first arriver under a compatibility key
+    becomes the leader: it holds the window open, then runs ONE
+    combined executable over every batch that joined and hands each
+    follower its slice.  Entries are sorted by signature before
+    combining so the compiled-executable cache key is arrival-order
+    independent."""
+
+    def __init__(self) -> None:
+        import threading
+        self.lock = threading.Lock()
+        self.groups = {}  # key -> list of _XEntry (open window)
+
+    def run(self, key, sig, args, single_fn, multi_key, multi_build):
+        import time as _time
+
+        win_s = max(0, _xwin_var.value) / 1e6
+        e = _XEntry(sig, args)
+        with self.lock:
+            grp = self.groups.get(key)
+            leader = grp is None
+            if leader:
+                self.groups[key] = [e]
+            else:
+                grp.append(e)
+        if leader:
+            _time.sleep(win_s)
+            with self.lock:
+                entries = self.groups.pop(key)
+            self._dispatch(entries, single_fn, multi_key, multi_build)
+        if not e.event.wait(timeout=120.0):
+            raise RuntimeError(
+                "cross-session batch leader did not dispatch within "
+                "120s (dvm_batch_window_us misconfigured or leader "
+                "session died mid-window)")
+        if e.err is not None:
+            raise RuntimeError(
+                f"cross-session combined dispatch failed: {e.err}"
+            ) from e.err
+        return e.outs
+
+    def _dispatch(self, entries, single_fn, multi_key,
+                  multi_build) -> None:
+        from ompi_tpu.coll import device
+        try:
+            if len(entries) == 1:
+                entries[0].outs = single_fn(entries[0].args)
+            else:
+                order = sorted(range(len(entries)),
+                               key=lambda i: repr(entries[i].sig))
+                sigs = tuple(entries[i].sig for i in order)
+                jfn = device.compile_cache.get(
+                    multi_key(sigs), lambda: multi_build(sigs))
+                flat = [a for i in order for a in entries[i].args]
+                outs = jfn(*flat)
+                off = 0
+                for i in order:
+                    n = len(entries[i].sig)
+                    entries[i].outs = tuple(outs[off:off + n])
+                    off += n
+                _pv_xbatches.add(1)
+                _pv_xcolls.add(off)
+        except BaseException as exc:  # noqa: BLE001
+            for e in entries:
+                e.err = exc
+        finally:
+            for e in entries:
+                e.event.set()
+
+
+_xbatcher = _XBatcher()
+
+
+def _xdispatch(key, sig, args, single_fn, multi_key, multi_build):
+    """Run one session's prepared fused batch: straight through when
+    the cross-session window is closed (knob 0, or the pool reports
+    <2 resident sessions), else through the batcher."""
+    if _xwin_var.value <= 0 or _xsession_hint < 2:
+        return single_fn(args)
+    return _xbatcher.run(key, sig, args, single_fn, multi_key,
+                         multi_build)
 
 
 class _FusionEngine:
@@ -370,24 +563,37 @@ class _FusionEngine:
             if mode == "hbm":
                 args = [shards[r][1][i]
                         for i in range(nslots) for r in range(size)]
-                jfn = device.compile_cache.get(
-                    ("fused_hbm", size, sig0),
-                    lambda: _build_fused_hbm(size, sig0))
-                outs = jfn(*args)
+
+                def single_hbm(a):
+                    jfn = device.compile_cache.get(
+                        ("fused_hbm", size, sig0),
+                        lambda: _build_fused_hbm(size, sig0))
+                    return jfn(*a)
+
+                outs = _xdispatch(
+                    ("hbm", size), sig0, args, single_hbm,
+                    lambda sigs: ("fusedx_hbm", size, sigs),
+                    lambda sigs: _build_fused_hbm_multi(size, sigs))
             else:
                 mesh = comm.mesh()
                 dev_key = tuple(
                     d.id for d in mesh.devices.reshape(-1))
-                groups0, folds0 = _group_plan(sig0)
-                nin = len(groups0) + len(folds0)
+                nin = _mesh_nin(sig0)
                 ins = [
                     device._assemble(
                         mesh, [shards[r][1][j] for r in range(size)])
                     for j in range(nin)]
-                jfn = device.compile_cache.get(
-                    ("fused", dev_key, sig0),
-                    lambda: _build_fused_mesh(mesh, sig0))
-                outs = jfn(*ins)
+
+                def single_mesh(a):
+                    jfn = device.compile_cache.get(
+                        ("fused", dev_key, sig0),
+                        lambda: _build_fused_mesh(mesh, sig0))
+                    return jfn(*a)
+
+                outs = _xdispatch(
+                    ("mesh", dev_key), sig0, ins, single_mesh,
+                    lambda sigs: ("fusedx", dev_key, sigs),
+                    lambda sigs: _build_fused_mesh_multi(mesh, sigs))
             # every output is replicated (psum/root-pick): all ranks
             # read the same arrays
             return [list(outs)] * size

@@ -156,8 +156,12 @@ class Communicator:
         """Agree on a cid free on every member of *this* comm
         (ref: ompi_comm_nextcid multi-round agreement).  After a
         respawn recovery the proposal is floored into the current
-        epoch's cid band — see EPOCH_CID_STRIDE."""
-        floor = self.state.respawn_epoch * EPOCH_CID_STRIDE
+        epoch's cid band — see EPOCH_CID_STRIDE.  A DVM-resident
+        session adds its session band (state.cid_band) on top: epoch
+        and session indices share the banded id space, so derived
+        comms of concurrent sessions can never alias."""
+        floor = ((self.state.respawn_epoch + self.state.cid_band)
+                 * EPOCH_CID_STRIDE)
         while True:
             proposal = self.state.next_cid_local()
             if proposal < floor:

@@ -44,7 +44,7 @@ _plan_var = registry.register(
          "class:rate — e.g. 'drop:0.05,sever:0.01'.  Classes: drop, "
          "delay, dup, reorder, corrupt, sever, daemon_kill, "
          "oob_sever, kv_partition, rank_kill, io_stall, io_partial, "
-         "io_enospc.  Empty = framework disabled")
+         "io_enospc, dvm_disconnect.  Empty = framework disabled")
 _rate_var = registry.register(
     "ft", "inject", "rate", 0.02, float,
     help="Default per-event injection probability for plan entries "
@@ -88,6 +88,12 @@ IO_CLASSES = ("io_stall", "io_partial", "io_enospc")
 # permanent per-RANK scenarios: unlike the transient classes these
 # fire exactly once (there is no rate — death is not probabilistic)
 RANK_CLASSES = ("rank_kill",)
+# DVM service-plane client faults (tools/dvm): dvm_disconnect drops
+# the client's pool connection right after a run request is sent —
+# the session's program is already executing collectives inside the
+# pool, so this exercises the client-death-mid-collective cleanup
+# (the pool must finish or poison ONLY that session, never peers)
+DVM_CLASSES = ("dvm_disconnect",)
 
 
 def plan() -> Dict[str, float]:
@@ -219,6 +225,20 @@ def io_injector(rank: int) -> Optional[IoInjector]:
     if not p:
         return None
     return IoInjector("io", rank, p)
+
+
+class DvmInjector(_Scoped):
+    def disconnect(self) -> bool:
+        """A DVM run request was just sent: True = drop the pool
+        connection now, leaving the job executing with no client."""
+        return self._roll() == "dvm_disconnect"
+
+
+def dvm_injector(rank: int = 0) -> Optional[DvmInjector]:
+    p = {c: r for c, r in plan().items() if c in DVM_CLASSES}
+    if not p:
+        return None
+    return DvmInjector("dvm", rank, p)
 
 
 def node_faults(node_id: int) -> List[str]:

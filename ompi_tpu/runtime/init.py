@@ -15,7 +15,8 @@ from ompi_tpu.btl import inproc as _btl_inproc  # noqa: F401 (registers)
 from ompi_tpu.btl import self_btl as _btl_self  # noqa: F401
 from ompi_tpu.btl import shm as _btl_shm  # noqa: F401
 from ompi_tpu.btl import tcp as _btl_tcp  # noqa: F401
-from ompi_tpu.comm.communicator import Communicator, Group
+from ompi_tpu.comm.communicator import (EPOCH_CID_STRIDE, Communicator,
+                                        Group)
 from ompi_tpu.pml import ob1 as _pml_ob1
 from ompi_tpu.pml import monitoring as _pml_monitoring
 from .state import ProcState, clear_current, set_current
@@ -151,12 +152,17 @@ def mpi_init(state: ProcState, device=None) -> ProcState:
     # its universe base (dpm, ref: ompi/dpm)
     wbase = getattr(state.rte, "world_base", 0)
     wsize = getattr(state.rte, "world_size", state.size)
-    state.comm_world = Communicator(state, 0,
+    # DVM-resident sessions carry a session cid band: the predefined
+    # comms live at the band base, so even cid 0/1 are session-unique
+    # across the pool (next_cid floors derived comms into the same
+    # band).  Ordinary jobs have band 0 — world cid 0, self cid 1.
+    band = state.cid_band * EPOCH_CID_STRIDE
+    state.comm_world = Communicator(state, band,
                                     Group(range(wbase, wbase + wsize)),
                                     name="MPI_COMM_WORLD")
     from ompi_tpu import attrs as _attrs
     _attrs.init_world_attrs(state.comm_world)
-    state.comm_self = Communicator(state, 1, Group([state.rank]),
+    state.comm_self = Communicator(state, band + 1, Group([state.rank]),
                                    name="MPI_COMM_SELF")
     # wire the predefined communicators' error handler EXPLICITLY
     # (mpi_errhandler_world_default; derived comms keep inheriting

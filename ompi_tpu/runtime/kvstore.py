@@ -148,6 +148,12 @@ class KVServer:
         self.counters: Dict[str, int] = {}
         self.fences: Dict[str, int] = {}
         self.fence_waiters: Dict[str, List[socket.socket]] = {}
+        # per-namespace aborts (the DVM serve plane: many resident
+        # sessions share ONE long-lived server, each under a key
+        # namespace).  An abort carrying "ns" poisons only that
+        # namespace's blocking gets/takes/fences — peer sessions keep
+        # running.  The global `aborted` (no ns) still poisons all.
+        self.ns_aborted: Dict[str, Tuple[int, int, str]] = {}
         # O(daemons)-vs-O(ranks) scalability diagnostic: connections
         # ever accepted (daemon KV proxies collapse per-rank traffic
         # onto one upstream connection per node)
@@ -210,13 +216,19 @@ class KVServer:
                     _send_msg(conn, {"ok": True})
                 elif op == "get":
                     timeout = msg.get("timeout", 60.0)
+                    ns = msg.get("ns")
                     with self.cv:
                         deadline_hit = not self.cv.wait_for(
                             lambda: msg["key"] in self.data
-                            or self.aborted is not None,
+                            or self.aborted is not None
+                            or (ns is not None
+                                and ns in self.ns_aborted),
                             timeout=timeout)
-                        if self.aborted is not None:
-                            _send_msg(conn, {"abort": list(self.aborted)})
+                        ab = self.aborted if self.aborted is not None \
+                            else (self.ns_aborted.get(ns)
+                                  if ns is not None else None)
+                        if ab is not None:
+                            _send_msg(conn, {"abort": list(ab)})
                         elif deadline_hit:
                             _send_msg(conn, {"timeout": True})
                         else:
@@ -258,19 +270,30 @@ class KVServer:
                                        k.startswith("claim:" + pfx))]:
                             del self.counters[k]
                             nd += 1
+                        # a full-namespace purge ("ns/") is session
+                        # teardown: clear the poison record too so a
+                        # reused server never haunts later lookups
+                        if pfx.endswith("/"):
+                            self.ns_aborted.pop(pfx[:-1], None)
                         self.cv.notify_all()
                     _send_msg(conn, {"ok": True, "n": nd})
                 elif op == "take":
                     # blocking get that atomically deletes the record:
                     # one-shot rendezvous consumption (dpm accept/connect)
                     timeout = msg.get("timeout", 60.0)
+                    ns = msg.get("ns")
                     with self.cv:
                         deadline_hit = not self.cv.wait_for(
                             lambda: msg["key"] in self.data
-                            or self.aborted is not None,
+                            or self.aborted is not None
+                            or (ns is not None
+                                and ns in self.ns_aborted),
                             timeout=timeout)
-                        if self.aborted is not None:
-                            _send_msg(conn, {"abort": list(self.aborted)})
+                        ab = self.aborted if self.aborted is not None \
+                            else (self.ns_aborted.get(ns)
+                                  if ns is not None else None)
+                        if ab is not None:
+                            _send_msg(conn, {"abort": list(ab)})
                         elif deadline_hit:
                             _send_msg(conn, {"timeout": True})
                         else:
@@ -300,13 +323,40 @@ class KVServer:
                             self.cv.notify_all()
                     # reply sent when fence completes (above)
                 elif op == "abort":
+                    ns = msg.get("ns")
+                    rec = (msg["rank"], msg["code"], msg.get("msg", ""))
                     with self.cv:
-                        first = self.aborted is None
-                        if first:
-                            self.aborted = (msg["rank"], msg["code"],
-                                            msg.get("msg", ""))
+                        if ns is not None:
+                            first = ns not in self.ns_aborted
+                            if first:
+                                self.ns_aborted[ns] = rec
+                            rec = self.ns_aborted[ns]
+                        else:
+                            first = self.aborted is None
+                            if first:
+                                self.aborted = rec
+                            rec = self.aborted
+                        # release fence waiters of the poisoned scope
+                        # with an error: the aborting rank never
+                        # arrives, so a parked peer must get a
+                        # diagnosable failure, not a silent hang.
+                        # Fence ids are ns-prefixed ("ns/<id>") by
+                        # KVClient, so the scope is a prefix match;
+                        # a global abort releases every fence.
+                        fpfx = f"{ns}/" if ns is not None else ""
+                        for fid in [f for f in self.fences
+                                    if f.startswith(fpfx)]:
+                            for c in self.fence_waiters.get(fid, []):
+                                try:
+                                    _send_msg(c, {"error":
+                                                  f"aborted by rank "
+                                                  f"{rec[0]}: {rec[2]}"})
+                                except OSError:
+                                    pass
+                            self.fences.pop(fid, None)
+                            self.fence_waiters.pop(fid, None)
                         self.cv.notify_all()
-                    if first and self.on_abort is not None:
+                    if first and ns is None and self.on_abort is not None:
                         self.on_abort(self.aborted)
                     _send_msg(conn, {"ok": True})
                 elif op == "spawn":
@@ -368,11 +418,20 @@ class KVClient:
     partitioned server.  A failed SEND is always retryable (the
     server discards a partial frame on its read error); a lost REPLY
     is retried only for idempotent ops — resending an ``incr`` or a
-    ``fence`` the server already applied would corrupt the job."""
+    ``fence`` the server already applied would corrupt the job.
 
-    def __init__(self, addr: str) -> None:
+    ``ns`` scopes every key under "ns/" (put_once claim tickets under
+    "claim:ns/", so the server's purge hygiene still sweeps them) and
+    tags blocking ops so a namespace-scoped abort poisons only this
+    client's session — the isolation contract of the DVM serve plane,
+    where many resident sessions share one long-lived server.  The
+    per-node KVProxy does not forward the ns abort tag; DVM sessions
+    dial the shared server directly on loopback, never a proxy."""
+
+    def __init__(self, addr: str, ns: Optional[str] = None) -> None:
         host, port = addr.rsplit(":", 1)
         self.addr = (host, int(port))
+        self.ns = ns or None
         self._lock = threading.Lock()
         self._sock: Optional[socket.socket] = self._connect()
         from ompi_tpu import ft_inject
@@ -454,13 +513,31 @@ class KVClient:
                 f"{last}") from last
         raise ConnectionError("kv server unreachable")
 
+    def _k(self, key: str) -> str:
+        """Apply the namespace prefix.  Claim tickets keep their
+        "claim:" marker OUTSIDE the namespace ("claim:ns/rest") so the
+        server's purge branch — which matches counters against both
+        ``pfx`` and ``"claim:" + pfx`` — sweeps a namespaced prefix's
+        tickets exactly like an un-namespaced one's."""
+        if self.ns is None:
+            return key
+        if key.startswith("claim:"):
+            return "claim:" + self.ns + "/" + key[len("claim:"):]
+        return f"{self.ns}/{key}"
+
+    def _ns_tag(self, msg: dict) -> dict:
+        if self.ns is not None:
+            msg["ns"] = self.ns
+        return msg
+
     def put(self, key: str, value: Any) -> None:
-        self._request({"op": "put", "key": key, "value": value},
-                      idempotent=True)
+        self._request({"op": "put", "key": self._k(key),
+                       "value": value}, idempotent=True)
 
     def get(self, key: str, timeout: float = 60.0) -> Any:
-        resp = self._request({"op": "get", "key": key,
-                              "timeout": timeout}, idempotent=True)
+        resp = self._request(self._ns_tag(
+            {"op": "get", "key": self._k(key),
+             "timeout": timeout}), idempotent=True)
         if "abort" in resp:
             raise RuntimeError(f"job aborted: {resp['abort']}")
         if resp.get("timeout"):
@@ -470,7 +547,7 @@ class KVClient:
     def incr(self, key: str) -> int:
         """Atomic fetch-and-add on a server-side counter (returns the
         pre-increment value)."""
-        resp = self._request({"op": "incr", "key": key})
+        resp = self._request({"op": "incr", "key": self._k(key)})
         return int(resp["value"])
 
     def put_once(self, key: str, value: Any) -> bool:
@@ -489,22 +566,22 @@ class KVClient:
         """Delete every data key and counter (including put_once claim
         tickets) under ``prefix``; returns the number removed.
         Idempotent by construction — deleting twice deletes nothing."""
-        resp = self._request({"op": "purge", "prefix": prefix},
+        resp = self._request({"op": "purge", "prefix": self._k(prefix)},
                              idempotent=True)
         return int(resp.get("n", 0))
 
     def uncr(self, key: str, expect: int) -> bool:
         """Roll back a ticket taken with incr() (which returned
         ``expect``) — succeeds only if no later ticket was issued."""
-        resp = self._request({"op": "uncr", "key": key,
+        resp = self._request({"op": "uncr", "key": self._k(key),
                               "expect": expect})
         return bool(resp["ok"])
 
     def take(self, key: str, timeout: float = 60.0) -> Any:
         """Blocking get that atomically removes the record — one-shot
         rendezvous consumption."""
-        resp = self._request({"op": "take", "key": key,
-                              "timeout": timeout})
+        resp = self._request(self._ns_tag(
+            {"op": "take", "key": self._k(key), "timeout": timeout}))
         if "abort" in resp:
             raise RuntimeError(f"job aborted: {resp['abort']}")
         if resp.get("timeout"):
@@ -513,7 +590,8 @@ class KVClient:
 
     def fence(self, fence_id: str, n: Optional[int] = None,
               weight: int = 1) -> None:
-        msg: Dict[str, Any] = {"op": "fence", "id": fence_id}
+        msg: Dict[str, Any] = {"op": "fence",
+                               "id": self._k(fence_id)}
         if n is not None:
             msg["n"] = n
         if weight != 1:
@@ -546,8 +624,9 @@ class KVClient:
         # best-effort by design: the job is going down anyway, and an
         # unreachable server must not mask the original error
         try:
-            self._request({"op": "abort", "rank": rank,
-                           "code": code, "msg": msg}, idempotent=True)
+            self._request(self._ns_tag(
+                {"op": "abort", "rank": rank,
+                 "code": code, "msg": msg}), idempotent=True)
         except (ConnectionError, OSError, RuntimeError):
             pass
 

@@ -256,11 +256,15 @@ class HybridRTE(EnvRTE):
 
     def __init__(self, world: HybridWorld, rank: int, kv_addr: str,
                  node_id: int = 0, jobid: str = "job0",
-                 session_dir: str = "/tmp") -> None:
+                 session_dir: str = "/tmp",
+                 kv_ns: Optional[str] = None) -> None:
         from .kvstore import KVClient  # noqa: PLC0415
 
         # no super().__init__(): identity comes from the app shell's
-        # arguments, not per-process env vars (threads share env)
+        # arguments, not per-process env vars (threads share env).
+        # kv_ns scopes every KV key (modex, fences, ULFM notes) under
+        # a session namespace — the DVM serve plane runs many resident
+        # sessions against ONE shared KV server
         self.world = world
         self.rank = rank
         self.size = world.size
@@ -269,7 +273,7 @@ class HybridRTE(EnvRTE):
         self.jobid = jobid
         self.node_id = node_id
         self.session_dir = session_dir
-        self.kv = KVClient(kv_addr)
+        self.kv = KVClient(kv_addr, ns=kv_ns)
         self.default_device: Any = None
         self._fence_count = 0
 

@@ -41,6 +41,15 @@ class ProcState:
         # replacement rank between its re-init and its first rejoin
         self.respawn_epoch = 0
         self.respawn_joining = False
+        # DVM serve plane (tools/dvm): cid_band shifts this rank's
+        # whole communicator-id space by band*EPOCH_CID_STRIDE, so
+        # concurrently-resident sessions in one pool process never
+        # share a cid (trace spans, pvar labels and rendezvous keys
+        # stay unambiguous pool-wide); serve_resident defers
+        # ompi_tpu.finalize() to a flush+fence run boundary, keeping
+        # the world warm for the session's next program
+        self.cid_band = 0
+        self.serve_resident = False
         self.extra: Dict[str, Any] = {}
 
     def next_cid_local(self) -> int:

@@ -44,17 +44,24 @@ def main(argv=None) -> int:
         sys.stderr.write(f"attach: cannot read proctable: {e}\n")
         return 1
     for ent in table:
+        # DVM-resident ranks are threads of the pool process; the
+        # proctable names the thread so a --stacks dump is navigable
+        thread = f"  thread {ent['thread']}" if "thread" in ent else ""
         sys.stdout.write(
             f"rank(s) {ent['tag']:>8}  pid {ent['pid']:>7}  "
-            f"host {ent.get('host', 'localhost')}\n")
+            f"host {ent.get('host', 'localhost')}{thread}\n")
     if opts.stacks:
         import socket as _socket
         me = _socket.gethostname()
         sent = 0
+        signalled = set()
         for ent in table:
             if ent.get("host", me) != me:
                 continue  # never signal pids on another host
             pid = int(ent["pid"])
+            if pid in signalled:
+                continue  # DVM proctables list one pool pid per rank
+            signalled.add(pid)
             # pid-recycling guard: only signal a process that still
             # looks like a Python rank (SIGUSR1's default action
             # TERMINATES a process with no faulthandler registered)

@@ -1,4 +1,4 @@
-"""tpu-dvm: a persistent distributed virtual machine for jobs.
+"""tpu-dvm: a persistent, multiplexed service plane for jobs.
 
 Re-design of orte-dvm (ref: orte/tools/orte-dvm/orte-dvm.c:1 — start
 the runtime once, run many jobs against the warm daemons).  On TPU
@@ -10,28 +10,55 @@ rank-threads inside it (the hostrun execution model).  Across jobs
 the pool retains:
 
   * the jax runtime + device handles (no PJRT re-init),
-  * the coll/device compiled-collective cache (`_compiled`,
-    `HbmCollModule._jit_cache` — keyed by device ids, not world),
+  * the coll/device compiled-collective cache (`CompiledLRU`,
+    `HbmCollModule._jit_cache` — keyed by device ids, not world, so
+    session N hits executables session 1 compiled),
   * imported modules (no interpreter warmup).
 
-Per job everything logically job-scoped is FRESH: HybridWorld, KV
-server, session dir, communicators, pml state.  Jobs are serialized
-(one at a time — the pool owns the chips exclusively, the same
-contract as a reservation).
+Unlike the original serial pool, jobs are NOT serialized: the pool is
+a concurrent, session-multiplexed service.  A client ATTACHes a
+session (np rank-threads, brought up and left resident), RUNs one or
+more programs against it, and DETACHes.  Many sessions are resident
+at once, multiplexed over the shared device mesh:
+
+  * admission control — rank-capacity accounting plus a bounded FIFO
+    wait queue (dvm_queue_max) with immediate-reject backpressure,
+  * isolation — each session gets a cid band (state.cid_band), a KV
+    namespace on ONE shared long-lived KV server (KVClient ns=...),
+    per-session stdout/argv capture (thread-local proxies, never a
+    process-global sys.stdout swap), and a SessionRTE whose abort
+    poisons only its own world + namespace (never os._exit),
+  * sharing — the compiled-executable caches are device-keyed and
+    process-global, so concurrent sessions warm each other, and small
+    fused batches from concurrently-resident sessions can ride ONE
+    combined XLA dispatch (coll/fusion cross-session batching,
+    dvm_batch_window_us).
+
+Session programs call ompi_tpu.init()/finalize() unchanged: init
+finds the pre-initialized resident world (warm attach — microseconds,
+not seconds) and finalize degrades to a flush+fence run boundary
+(state.serve_resident), keeping the world warm for the next run.
 
 Usage:
     python -m ompi_tpu.tools.dvm --np 8 --uri-file /tmp/dvm.uri &
     python -m ompi_tpu.tools.mpirun --dvm /tmp/dvm.uri -np 8 app.py
     python -m ompi_tpu.tools.mpirun --dvm /tmp/dvm.uri -np 8 app2.py
     python -m ompi_tpu.tools.dvm --halt /tmp/dvm.uri
+
+`{uri-file}.proctable.json` maps every resident session rank to its
+pool pid/thread so `ompi_tpu-attach --stacks` works on DVM jobs.
 """
 
 from __future__ import annotations
 
 import argparse
+import collections
+import faulthandler
 import io
+import itertools
 import json
 import os
+import signal
 import socket
 import struct
 import sys
@@ -39,7 +66,74 @@ import tempfile
 import threading
 import time
 import traceback
-from typing import List, Optional
+from typing import Any, Dict, List, Optional
+
+from ompi_tpu import trace
+from ompi_tpu.mca.params import registry
+
+_session_max_var = registry.register(
+    "dvm", "", "session_max", 8, int,
+    help="Most sessions concurrently resident in one DVM pool; an "
+         "attach beyond it queues (or rejects, see dvm_queue_max) "
+         "even when rank capacity remains")
+_queue_max_var = registry.register(
+    "dvm", "", "queue_max", 16, int,
+    help="Bounded FIFO admission queue: attaches that cannot be "
+         "admitted wait here; beyond this depth they are rejected "
+         "immediately (backpressure, never unbounded memory)")
+_hb_var = registry.register(
+    "dvm", "", "heartbeat_s", 2.0, float,
+    help="Pool-to-client heartbeat period while a request is in "
+         "flight; a client that misses ~3 beats declares the pool "
+         "dead instead of hanging forever")
+_drain_var = registry.register(
+    "dvm", "", "drain_timeout_s", 30.0, float,
+    help="Halt waits this long for in-flight runs to finish before "
+         "force-detaching their sessions")
+
+_pv_active = registry.register_pvar(
+    "dvm", "", "sessions_active", var_class="level",
+    help="Sessions currently resident in the pool")
+_pv_peak = registry.register_pvar(
+    "dvm", "", "sessions_peak", var_class="highwatermark",
+    help="Most sessions ever concurrently resident")
+_pv_qdepth = registry.register_pvar(
+    "dvm", "", "queue_depth", var_class="level",
+    help="Attaches currently parked in the admission queue")
+_pv_qpeak = registry.register_pvar(
+    "dvm", "", "queue_peak", var_class="highwatermark",
+    help="Deepest the admission queue has been")
+_pv_rejects = registry.register_pvar(
+    "dvm", "", "rejects",
+    help="Attaches rejected (wait=False while busy, queue full, or "
+         "queue-wait timeout)")
+_pv_attaches = registry.register_pvar(
+    "dvm", "", "attaches",
+    help="Sessions successfully attached (world brought up resident)")
+_pv_jobs = registry.register_pvar(
+    "dvm", "", "jobs",
+    help="Programs run to completion against resident sessions")
+_pv_attach_us_max = registry.register_pvar(
+    "dvm", "", "attach_us_max", var_class="highwatermark",
+    help="Slowest session attach (microseconds, queue wait included)")
+_attach_hist: List[int] = [0] * trace.N_BUCKETS
+_pv_attach_hist = registry.register_pvar(
+    "dvm", "", "attach_hist", var_class="size",
+    help="Session-attach latency histogram (log2 us buckets, bounds "
+         "in trace_hist_bucket_bounds_us)",
+    getter=lambda: list(_attach_hist))
+
+
+class DvmError(RuntimeError):
+    """Service-plane error with a client-worthy message."""
+
+    busy = False
+
+
+class DvmBusy(DvmError):
+    """Admission backpressure: the pool rejected the attach."""
+
+    busy = True
 
 
 def _send(sock: socket.socket, obj: dict) -> None:
@@ -64,18 +158,49 @@ def _recv(sock: socket.socket) -> Optional[dict]:
     return json.loads(data)
 
 
-class _Tee(io.TextIOBase):
-    """Captures a job's stdout/stderr for the submitting client while
-    still echoing to the DVM console."""
+# -- per-session stdio/argv (thread-local, never a global swap) -------------
 
-    def __init__(self, real) -> None:
+class _SessionBuf:
+    """One run's captured output: shared by all its rank-threads."""
+
+    def __init__(self) -> None:
+        self._buf = io.StringIO()
+        self._lock = threading.Lock()
+
+    def write(self, s: str) -> None:
+        with self._lock:
+            self._buf.write(s)
+
+    def value(self) -> str:
+        with self._lock:
+            return self._buf.getvalue()
+
+
+# Overlay state lives in MODULE-level TLS, not on proxy instances: a
+# host (pytest capture, user tooling) may swap sys.stdout at any time,
+# so the proxy that happens to be installed when a rank-thread writes
+# need not be the one that was installed when the run began.
+_stdio_tls = threading.local()
+_stdio_lock = threading.Lock()
+
+
+class _ThreadStdio(io.TextIOBase):
+    """Per-thread stdout/stderr overlay for the pool process.
+    Rank-threads of a run register their session's capture buffer in
+    thread-local state; every other thread (the pool's own logging,
+    user helper threads) falls through to the real stream.  This is
+    what lets two concurrent sessions print without seeing each
+    other's output — the old process-global sys.stdout swap could
+    not."""
+
+    def __init__(self, real, kind: str) -> None:
         self.real = real
-        self.buf = io.StringIO()
-        self.lock = threading.Lock()
+        self.kind = kind  # "out" | "err"
 
     def write(self, s: str) -> int:
-        with self.lock:
-            self.buf.write(s)
+        sink = getattr(_stdio_tls, self.kind, None)
+        if sink is not None:
+            sink.write(s)
         self.real.write(s)
         return len(s)
 
@@ -83,11 +208,960 @@ class _Tee(io.TextIOBase):
         self.real.flush()
 
 
+class _ThreadArgv(list):
+    """sys.argv proxy: rank-threads see their run's [prog, *args],
+    everyone else sees the pool's own argv.  A real list subclass so
+    argparse/slicing in user programs work unchanged."""
+
+    def __init__(self, base) -> None:
+        super().__init__(base)
+
+    @staticmethod
+    def _cur():
+        return getattr(_stdio_tls, "argv", None)
+
+    def __getitem__(self, i):
+        o = self._cur()
+        return o[i] if o is not None else list.__getitem__(self, i)
+
+    def __len__(self):
+        o = self._cur()
+        return len(o) if o is not None else list.__len__(self)
+
+    def __iter__(self):
+        o = self._cur()
+        return iter(o) if o is not None else list.__iter__(self)
+
+    def __repr__(self):
+        o = self._cur()
+        return repr(o) if o is not None else list.__repr__(self)
+
+
+def _ensure_stdio() -> None:
+    """Idempotently wrap the CURRENT sys.stdout/stderr/argv with the
+    per-thread overlays.  Called before every run, not just at pool
+    start: hosts (pytest capture) swap sys.stdout under us, and an
+    overlay that is no longer installed captures nothing.  Overlays
+    pass writes through when no thread-local sink is set, so leaving
+    one installed is always harmless."""
+    with _stdio_lock:
+        if not isinstance(sys.stdout, _ThreadStdio):
+            sys.stdout = _ThreadStdio(sys.stdout, "out")
+        if not isinstance(sys.stderr, _ThreadStdio):
+            sys.stderr = _ThreadStdio(sys.stderr, "err")
+        if not isinstance(sys.argv, _ThreadArgv):
+            sys.argv = _ThreadArgv(sys.argv)
+
+
+def _stdio_push(out: _SessionBuf, err: _SessionBuf,
+                argv: List[str]) -> None:
+    _stdio_tls.out = out
+    _stdio_tls.err = err
+    _stdio_tls.argv = argv
+
+
+def _stdio_pop() -> None:
+    _stdio_tls.out = None
+    _stdio_tls.err = None
+    _stdio_tls.argv = None
+
+
+# -- session runtime --------------------------------------------------------
+
+def _make_session_rte():
+    """SessionRTE built lazily: the client half of this module (mpirun
+    --dvm, --halt) must import without touching the runtime stack."""
+    from ompi_tpu.runtime.rte import HybridRTE
+
+    class SessionRTE(HybridRTE):
+        """Abort confined to the session.  EnvRTE.abort os._exit()s —
+        correct for a process-rank, fatal for a POOL hosting other
+        sessions.  Here a failing rank poisons its own world and KV
+        namespace (releasing peers parked in fences/rendezvous of
+        THIS session only) and unwinds just its rank-thread."""
+
+        def abort(self, code: int, msg: str = "") -> None:
+            if self.world.aborted is None:
+                self.world.aborted = (self.rank, code, msg)
+            for st in self.world.states:
+                if st is not None and getattr(st, "progress",
+                                              None) is not None:
+                    st.progress.wakeup()
+            try:
+                self.kv.abort(self.rank, code, msg)
+            except OSError:
+                pass
+            sys.stderr.write(
+                f"[dvm session rank {self.rank}] abort({code}): {msg}\n")
+            raise SystemExit(code or 1)
+
+    return SessionRTE
+
+
+class _Session:
+    def __init__(self, sid: int, np_: int, conn) -> None:
+        self.sid = sid
+        self.np = np_
+        self.ns = f"s{sid}"
+        self.jobid = f"dvm-{os.getpid()}-s{sid}"
+        self.conn = conn  # owning client connection (auto-detach on close)
+        self.dir = ""
+        self.world: Any = None
+        self.states: List[Any] = []
+        self.lock = threading.Lock()
+        self.running = False
+        self.dead = False
+        self.detaching = False
+        # legacy one-shot (submit) warm cache: True while this
+        # session sits resident between submits, claimable by the
+        # next same-np submit and evictable under capacity pressure
+        self.legacy_idle = False
+
+
+class _Waiter:
+    def __init__(self, np_: int, conn) -> None:
+        self.np = np_
+        self.conn = conn
+        self.event = threading.Event()
+        self.sess: Optional[_Session] = None
+        self.error: Optional[str] = None
+        self.abandoned = False
+
+
+class _Conn:
+    """One client connection: serialized sends (the reply writer and
+    the heartbeat ticker share the socket) and a busy counter so the
+    ticker only beats while a request is actually in flight."""
+
+    def __init__(self, sock: socket.socket) -> None:
+        self.sock = sock
+        self.send_lock = threading.Lock()
+        self.busy = 0
+        self.dead = False
+
+    def reply(self, obj: dict) -> None:
+        with self.send_lock:
+            _send(self.sock, obj)
+
+
+class DVMServer:
+    """The resident pool: accept loop + admission control + session
+    lifecycle.  Embeddable (tests, benchmarks: .start()/.stop()) or
+    CLI-driven (.serve_forever())."""
+
+    def __init__(self, capacity: int, devices=None,
+                 uri_file: Optional[str] = None) -> None:
+        self.capacity = capacity
+        self.devices = devices
+        self.uri_file = uri_file
+        self.lock = threading.Lock()
+        self.sessions: Dict[int, _Session] = {}
+        self.active_ranks = 0
+        self._waiters: collections.deque = collections.deque()
+        self._sid_counter = itertools.count(1)
+        self._conns: set = set()
+        self._jobs = 0
+        self._draining = False
+        self._halted = False
+        self._started = False
+        self._accept_thread: Optional[threading.Thread] = None
+        self.kv_server: Any = None
+        self.listener: Optional[socket.socket] = None
+        self.port = 0
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _setup(self) -> None:
+        if self._started:
+            return
+        self._started = True
+        from ompi_tpu.runtime.kvstore import KVServer
+        self.kv_server = KVServer(self.capacity)
+        self.listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.listener.bind(("127.0.0.1", 0))
+        self.listener.listen(16)
+        self.port = self.listener.getsockname()[1]
+        if self.uri_file:
+            tmp = self.uri_file + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(f"127.0.0.1:{self.port}\n")
+            os.replace(tmp, self.uri_file)  # submitters never see a torn file
+        _ensure_stdio()
+        self._write_proctable()
+        try:
+            # debugger attach support: SIGUSR1 dumps EVERY pool thread
+            # (all resident session ranks) for ompi_tpu-attach --stacks
+            faulthandler.register(signal.SIGUSR1, all_threads=True,
+                                  chain=True)
+        except (AttributeError, ValueError, OSError):
+            pass  # non-main thread or unsupported platform
+        threading.Thread(target=self._hb_loop, daemon=True,
+                         name="dvm-hb").start()
+        sys.stderr.write(
+            f"tpu-dvm: ready on 127.0.0.1:{self.port} "
+            f"(capacity {self.capacity} ranks, "
+            f"sessions<={_session_max_var.value}, "
+            f"queue<={_queue_max_var.value}, devices "
+            f"{'warm' if self.devices else 'none'})\n")
+
+    def start(self) -> "DVMServer":
+        self._setup()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="dvm-accept")
+        self._accept_thread.start()
+        return self
+
+    def serve_forever(self) -> int:
+        self._setup()
+        self._accept_loop()
+        return 0
+
+    def stop(self) -> None:
+        self._drain()
+        self._halted = True
+        self._close_listener()
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=10)
+        if self.kv_server is not None:
+            self.kv_server.close()
+
+    def _close_listener(self) -> None:
+        """Close the listener so a blocked accept() wakes up.  On
+        Linux close() alone does NOT interrupt a thread parked in
+        accept(); shutdown() first makes it return EINVAL."""
+        if self.listener is None:
+            return
+        try:
+            self.listener.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self.listener.close()
+        except OSError:
+            pass
+
+    @property
+    def addr(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    # -- accept / client loops ---------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._halted:
+            try:
+                sock, _ = self.listener.accept()
+            except OSError:
+                break
+            conn = _Conn(sock)
+            with self.lock:
+                self._conns.add(conn)
+            threading.Thread(target=self._client, args=(conn,),
+                             daemon=True, name="dvm-client").start()
+
+    def _hb_loop(self) -> None:
+        while not self._halted:
+            time.sleep(max(0.2, _hb_var.value))
+            with self.lock:
+                conns = list(self._conns)
+            for c in conns:
+                if c.busy > 0 and not c.dead:
+                    try:
+                        c.reply({"event": "hb"})
+                    except OSError:
+                        c.dead = True
+
+    def _client(self, conn: _Conn) -> None:
+        owned: List[int] = []
+        try:
+            while not self._halted:
+                try:
+                    msg = _recv(conn.sock)
+                except OSError:
+                    break
+                if msg is None:
+                    break
+                try:
+                    if self._dispatch(conn, msg, owned):
+                        break  # halt
+                except DvmError as e:
+                    try:
+                        conn.reply({"error": str(e), "busy": e.busy})
+                    except OSError:
+                        break
+                except OSError:
+                    break
+                except Exception as e:  # noqa: BLE001 — a bad request
+                    # must never take the pool's client loop down
+                    try:
+                        conn.reply({"error": f"{type(e).__name__}: "
+                                             f"{str(e)[:300]}"})
+                    except OSError:
+                        break
+        finally:
+            with self.lock:
+                self._conns.discard(conn)
+            # client death is a detach: a dying submitter must never
+            # strand its sessions' ranks (or poison anyone else's)
+            for sid in owned:
+                try:
+                    self._detach(sid)
+                except DvmError:
+                    pass
+            try:
+                conn.sock.close()
+            except OSError:
+                pass
+
+    def _dispatch(self, conn: _Conn, msg: dict,
+                  owned: List[int]) -> bool:
+        op = msg.get("op")
+        if op == "halt":
+            conn.busy += 1
+            try:
+                jobs = self._drain()
+            finally:
+                conn.busy -= 1
+            conn.reply({"ok": True, "jobs": jobs})
+            sys.stderr.write(f"tpu-dvm: halt after {jobs} jobs\n")
+            self._halted = True
+            self._close_listener()
+            return True
+        if op == "ping":
+            conn.reply({"ok": True, "pid": os.getpid(),
+                        "capacity": self.capacity})
+            return False
+        if op == "stats":
+            with self.lock:
+                conn.reply({"ok": True, "sessions": len(self.sessions),
+                            "active_ranks": self.active_ranks,
+                            "queued": len(self._waiters),
+                            "jobs": self._jobs})
+            return False
+        if op == "attach":
+            np_ = int(msg.get("np", self.capacity))
+            timeout = msg.get("timeout")
+            conn.busy += 1
+            try:
+                sess, attach_us, queued_us = self._attach(
+                    np_, conn, wait=bool(msg.get("wait", True)),
+                    timeout=float(timeout) if timeout else None)
+            finally:
+                conn.busy -= 1
+            owned.append(sess.sid)
+            conn.reply({"ok": True, "sid": sess.sid, "np": np_,
+                        "attach_us": attach_us, "queued_us": queued_us})
+            return False
+        if op == "run":
+            sid = int(msg.get("sid", -1))
+            if sid not in owned:
+                raise DvmError(f"unknown session s{sid} (not attached "
+                               "on this connection)")
+            sess = self._session_for(sid)
+            conn.busy += 1
+            try:
+                code, out, err, wall = self._run(
+                    sess, msg["prog"], msg.get("args") or [])
+            finally:
+                conn.busy -= 1
+            conn.reply({"code": code, "stdout": out, "stderr": err,
+                        "wall_s": round(wall, 3)})
+            return False
+        if op == "detach":
+            sid = int(msg.get("sid", -1))
+            if sid in owned:
+                owned.remove(sid)
+            self._detach(sid)
+            conn.reply({"ok": True})
+            return False
+        if op == "submit":
+            # legacy one-shot (mpirun --dvm): attach + run, serial-
+            # pool reply shape.  The session stays RESIDENT between
+            # submits (the old warm-pool behavior: the second job's
+            # world, mesh, and fences are all reused, not just the
+            # compiled executables) — claimed by the next same-np
+            # submit, evicted when an attach needs the ranks.
+            np_ = int(msg.get("np", self.capacity))
+            if np_ > self.capacity:
+                conn.reply({"error": f"np {np_} exceeds DVM "
+                                     f"capacity {self.capacity}"})
+                return False
+            deadline = msg.get("timeout")
+            conn.busy += 1
+            try:
+                with self.lock:
+                    sess = next(
+                        (s for s in self.sessions.values()
+                         if s.legacy_idle and s.np == np_
+                         and not s.dead and not s.detaching), None)
+                    if sess is not None:
+                        sess.legacy_idle = False  # claimed
+                if sess is None:
+                    sess, _, _ = self._attach(
+                        np_, conn, wait=True,
+                        timeout=float(deadline) if deadline else 600.0)
+                try:
+                    code, out, err, wall = self._run(
+                        sess, msg["prog"], msg.get("args") or [])
+                finally:
+                    with self.lock:
+                        keep = (not sess.dead and not self._draining
+                                and not any(not w.abandoned
+                                            for w in self._waiters))
+                        if keep:
+                            sess.legacy_idle = True
+                    if not keep:
+                        self._detach(sess.sid)
+            finally:
+                conn.busy -= 1
+            conn.reply({"code": code, "stdout": out, "stderr": err,
+                        "wall_s": round(wall, 3)})
+            return False
+        conn.reply({"error": "bad op"})
+        return False
+
+    # -- admission ---------------------------------------------------------
+
+    def _can_admit_locked(self, np_: int) -> bool:
+        return (self.active_ranks + np_ <= self.capacity
+                and len(self.sessions) < max(1, _session_max_var.value))
+
+    def _admit_locked(self, np_: int, conn) -> _Session:
+        sess = _Session(next(self._sid_counter), np_, conn)
+        self.sessions[sess.sid] = sess
+        self.active_ranks += np_
+        _pv_active.add(1)
+        _pv_peak.update_max(len(self.sessions))
+        self._set_xsession_hint(len(self.sessions))
+        return sess
+
+    def _set_xsession_hint(self, n: int) -> None:
+        from ompi_tpu.coll import fusion
+        fusion.set_xsession_hint(n)
+
+    def _pump(self) -> None:
+        """Admit queued waiters in FIFO order.  Head-of-line blocking
+        is deliberate: a big-np attach at the front must not starve
+        behind a stream of small ones slipping past it."""
+        with self.lock:
+            while self._waiters:
+                w = self._waiters[0]
+                if w.abandoned:
+                    self._waiters.popleft()
+                    _pv_qdepth.add(-1)
+                    continue
+                if self._draining:
+                    self._waiters.popleft()
+                    _pv_qdepth.add(-1)
+                    w.error = "pool is halting"
+                    w.event.set()
+                    continue
+                if not self._can_admit_locked(w.np):
+                    break
+                self._waiters.popleft()
+                _pv_qdepth.add(-1)
+                w.sess = self._admit_locked(w.np, w.conn)
+                w.event.set()
+
+    def _attach(self, np_: int, conn, wait: bool = True,
+                timeout: Optional[float] = None):
+        t0 = time.perf_counter()
+        if np_ < 1 or np_ > self.capacity:
+            raise DvmError(
+                f"np {np_} exceeds DVM capacity {self.capacity}")
+        w: Optional[_Waiter] = None
+        sess: Optional[_Session] = None
+        queued_us = 0
+        while True:
+            victim: Optional[_Session] = None
+            with self.lock:
+                if self._draining:
+                    raise DvmError("pool is halting")
+                if self._can_admit_locked(np_):
+                    sess = self._admit_locked(np_, conn)
+                else:
+                    victim = next(
+                        (s for s in self.sessions.values()
+                         if s.legacy_idle and not s.detaching), None)
+                    if victim is not None:
+                        victim.legacy_idle = False
+                    elif not wait:
+                        _pv_rejects.add(1)
+                        raise DvmBusy(
+                            f"pool busy ({self.active_ranks}/"
+                            f"{self.capacity} ranks, "
+                            f"{len(self.sessions)} sessions) and "
+                            "wait=False")
+                    elif len(self._waiters) >= max(
+                            0, _queue_max_var.value):
+                        _pv_rejects.add(1)
+                        raise DvmBusy(
+                            f"admission queue full "
+                            f"({len(self._waiters)} waiting, "
+                            f"dvm_queue_max={_queue_max_var.value})")
+                    else:
+                        w = _Waiter(np_, conn)
+                        self._waiters.append(w)
+                        _pv_qdepth.add(1)
+                        _pv_qpeak.update_max(len(self._waiters))
+            if victim is None:
+                break
+            # a parked one-shot warm session is the lowest-priority
+            # tenant: reclaim its ranks for the live attach, then
+            # re-try admission
+            self._detach(victim.sid)
+        if w is not None:
+            qt0 = time.perf_counter()
+            w.event.wait(timeout=timeout)
+            with self.lock:
+                if w.sess is None and w.error is None:
+                    w.abandoned = True
+            if w.error is not None:
+                raise DvmError(w.error)
+            if w.sess is None:
+                self._pump()  # sweep the abandoned entry, admit behind it
+                _pv_rejects.add(1)
+                raise DvmBusy(
+                    f"timed out after {timeout}s waiting for capacity")
+            sess = w.sess
+            queued_us = int((time.perf_counter() - qt0) * 1e6)
+        try:
+            self._bringup(sess)
+        except BaseException:
+            self._release(sess)
+            raise
+        attach_us = int((time.perf_counter() - t0) * 1e6)
+        _pv_attaches.add(1)
+        _pv_attach_us_max.update_max(attach_us)
+        b = attach_us.bit_length()
+        _attach_hist[b if b < trace.N_BUCKETS else trace.N_BUCKETS - 1] += 1
+        tr = trace.global_tracer()
+        if tr is not None:
+            tr.hist_add(trace.HIST_SERVE_ATTACH, attach_us / 1e6)
+            tr.instant("dvm_attach", "serve", sid=sess.sid, np=np_,
+                       us=attach_us, queued_us=queued_us)
+        self._write_proctable()
+        return sess, attach_us, queued_us
+
+    def _release(self, sess: _Session) -> None:
+        with self.lock:
+            if self.sessions.pop(sess.sid, None) is not None:
+                self.active_ranks -= sess.np
+                _pv_active.add(-1)
+                self._set_xsession_hint(len(self.sessions))
+        self._pump()
+
+    def _session_for(self, sid: int) -> _Session:
+        with self.lock:
+            sess = self.sessions.get(sid)
+        if sess is None:
+            raise DvmError(f"unknown session s{sid} (already detached?)")
+        return sess
+
+    # -- session lifecycle -------------------------------------------------
+
+    def _bringup(self, sess: _Session) -> None:
+        """Pre-initialize np resident rank-threads: fresh HybridWorld,
+        KV namespace, cid band — but the SHARED device pool, so the
+        process-global compiled-executable caches (device-id keyed)
+        are warm across sessions."""
+        from ompi_tpu.runtime import state as statemod
+        from ompi_tpu.runtime.init import mpi_init
+        from ompi_tpu.runtime.kvstore import KVClient
+        from ompi_tpu.runtime.rte import HybridWorld, set_thread_rte
+
+        SessionRTE = _make_session_rte()
+        sess.dir = tempfile.mkdtemp(prefix=f"dvm_s{sess.sid}_")
+        world = HybridWorld(sess.np, 0, sess.np)
+        sess.world = world
+        sess.states = [None] * sess.np
+        errs: List[tuple] = []
+
+        def boot(rank: int) -> None:
+            try:
+                rte = SessionRTE(world, rank, self.kv_server.addr,
+                                 node_id=0, jobid=sess.jobid,
+                                 session_dir=sess.dir, kv_ns=sess.ns)
+                if self.devices:
+                    rte.default_device = self.devices[
+                        rank % len(self.devices)]
+                set_thread_rte(rte)
+                st = statemod.ProcState(rank, sess.np, rte)
+                st.cid_band = sess.sid
+                st.serve_resident = True
+                mpi_init(st, device=rte.default_device)
+                sess.states[rank] = st
+            except BaseException as e:  # noqa: BLE001
+                errs.append((rank, e))
+                if world.aborted is None:
+                    world.aborted = (rank, 1, f"bring-up failed: {e}")
+                # release peers parked in this session's init fences
+                try:
+                    kvc = KVClient(self.kv_server.addr, ns=sess.ns)
+                    kvc.abort(rank, 1, f"bring-up failed: {e}")
+                    kvc.close()
+                except OSError:
+                    pass
+            finally:
+                statemod.set_current(None)
+                set_thread_rte(None)
+
+        threads = [threading.Thread(target=boot, args=(r,), daemon=True,
+                                    name=f"dvm-s{sess.sid}-boot-r{r}")
+                   for r in range(sess.np)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errs or any(st is None for st in sess.states):
+            sess.dead = True
+            self._scrub(sess)
+            rank, e = errs[0] if errs else (
+                -1, RuntimeError("bring-up incomplete"))
+            raise DvmError(
+                f"session bring-up failed at rank {rank}: {e}")
+
+    def _run(self, sess: _Session, prog: str, args: List[str]):
+        if not os.path.isfile(prog):
+            raise DvmError(f"program not found: {prog}")
+        with sess.lock:
+            if sess.dead:
+                raise DvmError(f"session s{sess.sid} is dead "
+                               "(a prior run aborted)")
+            if sess.running:
+                raise DvmError(f"session s{sess.sid} already has a "
+                               "run in progress")
+            sess.running = True
+        import runpy
+
+        from ompi_tpu.runtime import state as statemod
+        from ompi_tpu.runtime.rte import set_thread_rte
+
+        t0 = time.perf_counter()
+        _ensure_stdio()  # per run, not just at pool start: the host
+        # may have swapped sys.stdout since (pytest capture does)
+        out, err = _SessionBuf(), _SessionBuf()
+        argv = [prog] + [str(a) for a in args]
+        failure: List[Optional[int]] = [None]
+        flock = threading.Lock()
+
+        def poison(st, code: int, why: str) -> None:
+            w = st.rte.world
+            if w.aborted is None:
+                w.aborted = (st.rank, code, why)
+            for ps in w.states:
+                if ps is not None and getattr(ps, "progress",
+                                              None) is not None:
+                    ps.progress.wakeup()
+            try:
+                st.rte.kv.abort(st.rank, code, why)
+            except OSError:
+                pass
+
+        def run_rank(st) -> None:
+            set_thread_rte(st.rte)
+            statemod.set_current(st)
+            _stdio_push(out, err, argv)
+            try:
+                runpy.run_path(prog, run_name="__main__")
+                # run boundary: flush deferred fused batches and meet
+                # the peers, so the NEXT program on this session
+                # starts from a quiet warm world.  Symmetric whether
+                # or not the program called finalize() — the
+                # serve_resident deferral makes finalize itself
+                # exactly this flush+fence.
+                from ompi_tpu.coll import fusion as _fusion
+                _fusion.flush_state(st)
+                st.rte.fence()
+            except SystemExit as e:
+                code = e.code if isinstance(e.code, int) else (
+                    0 if e.code is None else 1)
+                if code != 0:
+                    with flock:
+                        failure[0] = failure[0] or code
+                    poison(st, code, "SystemExit")
+            except BaseException:  # noqa: BLE001
+                err.write(f"[dvm s{sess.sid} rank {st.rank}] uncaught:\n"
+                          f"{traceback.format_exc()}")
+                with flock:
+                    failure[0] = failure[0] or 1
+                poison(st, 1, "uncaught exception")
+            finally:
+                _stdio_pop()
+                statemod.set_current(None)
+                set_thread_rte(None)
+
+        threads = [threading.Thread(target=run_rank, args=(st,),
+                                    daemon=True,
+                                    name=f"dvm-s{sess.sid}-r{st.rank}")
+                   for st in sess.states]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        with sess.lock:
+            sess.running = False
+            if failure[0]:
+                sess.dead = True
+        with self.lock:
+            self._jobs += 1
+        _pv_jobs.add(1)
+        tr = trace.global_tracer()
+        if tr is not None:
+            tr.instant("dvm_run", "serve", sid=sess.sid,
+                       code=failure[0] or 0,
+                       wall_ms=int(wall * 1000))
+        return (failure[0] or 0, out.value(), err.value(), wall)
+
+    def _detach(self, sid: int) -> None:
+        with self.lock:
+            sess = self.sessions.get(sid)
+            if sess is None:
+                raise DvmError(f"unknown session s{sid} "
+                               "(already detached?)")
+            if sess.detaching:
+                return
+            sess.detaching = True
+        self._destroy(sess)
+        self._release(sess)
+        self._write_proctable()
+
+    def _destroy(self, sess: _Session) -> None:
+        from ompi_tpu.runtime import state as statemod
+        from ompi_tpu.runtime.init import mpi_finalize
+        from ompi_tpu.runtime.rte import set_thread_rte
+
+        if not sess.dead:
+            def fin(st) -> None:
+                try:
+                    set_thread_rte(st.rte)
+                    statemod.set_current(st)
+                    st.serve_resident = False
+                    if st.initialized and not st.finalized:
+                        mpi_finalize(st)
+                except BaseException:  # noqa: BLE001 — teardown of one
+                    pass  # session must never take the pool down
+                finally:
+                    statemod.set_current(None)
+                    set_thread_rte(None)
+
+            threads = [threading.Thread(
+                target=fin, args=(st,), daemon=True,
+                name=f"dvm-s{sess.sid}-fin-r{st.rank}")
+                for st in sess.states if st is not None]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30)
+        # a dead session's world is poisoned: fences would only time
+        # out, so skip the graceful finalize and let GC take the world
+        self._scrub(sess)
+
+    def _scrub(self, sess: _Session) -> None:
+        """Sweep the session's KV namespace (data, counters, put-once
+        tickets, the namespace abort record) and its session dir —
+        the pool is long-lived, leaks accumulate forever."""
+        from ompi_tpu.runtime.kvstore import KVClient
+        try:
+            kvc = KVClient(self.kv_server.addr, ns=sess.ns)
+            kvc.purge("")
+            kvc.close()
+        except OSError:
+            pass
+        if sess.dir:
+            import shutil
+            shutil.rmtree(sess.dir, ignore_errors=True)
+
+    # -- drain / proctable -------------------------------------------------
+
+    def _drain(self) -> int:
+        with self.lock:
+            self._draining = True
+        self._pump()  # flushes every queued waiter with "pool is halting"
+        deadline = time.monotonic() + max(0.0, _drain_var.value)
+        while time.monotonic() < deadline:
+            with self.lock:
+                if not any(s.running for s in self.sessions.values()):
+                    break
+            time.sleep(0.05)
+        with self.lock:
+            sids = list(self.sessions)
+        for sid in sids:
+            try:
+                self._detach(sid)
+            except DvmError:
+                pass
+        with self.lock:
+            return self._jobs
+
+    def _write_proctable(self) -> None:
+        if not self.uri_file:
+            return
+        host = socket.gethostname()
+        pid = os.getpid()
+        entries = [{"tag": "pool", "pid": pid, "host": host,
+                    "thread": "dvm-accept"}]
+        with self.lock:
+            sessions = list(self.sessions.values())
+        for sess in sessions:
+            for r in range(sess.np):
+                entries.append({"tag": f"s{sess.sid}:r{r}", "pid": pid,
+                                "host": host,
+                                "thread": f"dvm-s{sess.sid}-r{r}"})
+        path = self.uri_file + ".proctable.json"
+        try:
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(entries, f, indent=1)
+            os.replace(tmp, path)
+        except OSError:
+            pass  # diagnostics must never take the pool down
+
+
+# -- client -----------------------------------------------------------------
+
+class DvmClient:
+    """Session-multiplexing client.  Heartbeat-aware: while a request
+    is in flight the pool beats every dvm_heartbeat_s; a client that
+    misses ~3 beats raises a friendly DvmError instead of the old
+    settimeout(None) forever-hang."""
+
+    def __init__(self, uri_file: str,
+                 connect_timeout: float = 10.0) -> None:
+        self.uri_file = uri_file
+        try:
+            with open(uri_file) as f:
+                host, _, port = f.read().strip().partition(":")
+        except FileNotFoundError:
+            raise DvmError(
+                f"DVM uri-file {uri_file} not found — is the pool "
+                "running?  (start one: python -m ompi_tpu.tools.dvm "
+                f"--np N --uri-file {uri_file})") from None
+        try:
+            self.sock = socket.create_connection(
+                (host, int(port)), timeout=connect_timeout)
+        except OSError as e:
+            raise DvmError(
+                f"stale uri-file {uri_file}: no DVM pool listening at "
+                f"{host}:{port} ({e}) — the pool has likely exited; "
+                "remove the file and start a new pool") from None
+        self._hb = max(0.5, float(_hb_var.value))
+        from ompi_tpu import ft_inject
+        self._inject = ft_inject.dvm_injector(0)
+
+    def _await(self, deadline: Optional[float] = None) -> dict:
+        while True:
+            if deadline is not None and time.monotonic() > deadline:
+                raise DvmError("deadline exceeded waiting for the "
+                               "DVM pool")
+            self.sock.settimeout(max(5.0, 3.0 * self._hb))
+            try:
+                resp = _recv(self.sock)
+            except socket.timeout:
+                raise DvmError(
+                    "DVM pool stopped responding (no heartbeat for "
+                    f"{max(5.0, 3.0 * self._hb):.0f}s) — the pool is "
+                    "hung or dead") from None
+            if resp is None:
+                raise DvmError("DVM pool closed the connection")
+            if resp.get("event") == "hb":
+                continue
+            return resp
+
+    def _rpc(self, msg: dict,
+             deadline: Optional[float] = None) -> dict:
+        try:
+            _send(self.sock, msg)
+        except OSError as e:
+            raise DvmError(
+                f"lost connection to the DVM pool: {e}") from None
+        return self._await(deadline)
+
+    def attach(self, np_: int, wait: bool = True,
+               timeout: Optional[float] = None) -> dict:
+        resp = self._rpc(
+            {"op": "attach", "np": np_, "wait": wait,
+             "timeout": timeout},
+            deadline=(time.monotonic() + timeout + 30.0)
+            if timeout else None)
+        if "error" in resp:
+            raise (DvmBusy if resp.get("busy") else DvmError)(
+                resp["error"])
+        return resp
+
+    def run(self, sid: int, prog: str, args=(),
+            timeout: Optional[float] = None) -> dict:
+        try:
+            _send(self.sock, {"op": "run", "sid": sid,
+                              "prog": os.path.abspath(prog),
+                              "args": list(args)})
+        except OSError as e:
+            raise DvmError(
+                f"lost connection to the DVM pool: {e}") from None
+        if self._inject is not None and self._inject.disconnect():
+            # chaos (ft_inject dvm_disconnect): the run request is in
+            # flight — die NOW, mid-collective from the pool's view.
+            # The pool must finish/poison only this session.
+            self.close()
+            raise DvmError(
+                "ft_inject dvm_disconnect: client dropped mid-run")
+        resp = self._await(
+            time.monotonic() + timeout if timeout else None)
+        if "error" in resp:
+            raise (DvmBusy if resp.get("busy") else DvmError)(
+                resp["error"])
+        return resp
+
+    def detach(self, sid: int) -> dict:
+        resp = self._rpc({"op": "detach", "sid": sid})
+        if "error" in resp:
+            raise DvmError(resp["error"])
+        return resp
+
+    def submit_job(self, np_: int, prog: str, args=(),
+                   timeout: Optional[float] = None) -> dict:
+        return self._rpc(
+            {"op": "submit", "np": np_,
+             "prog": os.path.abspath(prog), "args": list(args),
+             "timeout": timeout},
+            deadline=time.monotonic() + timeout if timeout else None)
+
+    def halt(self) -> dict:
+        return self._rpc({"op": "halt"})
+
+    def ping(self) -> dict:
+        return self._rpc({"op": "ping"})
+
+    def stats(self) -> dict:
+        return self._rpc({"op": "stats"})
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self) -> "DvmClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+# -- legacy one-shot helpers ------------------------------------------------
+
+_jobid_counter = itertools.count()
+
+
 def run_job_inproc(np_: int, prog: str, args: List[str],
                    devices) -> tuple:
     """One job as rank-threads in THIS process (hostrun model), with
     a job-private KV server and session dir.  Returns (exit_code,
-    stdout_text, stderr_text)."""
+    stdout_text, stderr_text).  Kept for embedders that want the
+    serial model without a service plane; the jobid rides a
+    process-monotonic counter (the old time.time()-ms scheme collided
+    when two jobs started within a millisecond)."""
     import runpy
 
     from ompi_tpu.runtime.kvstore import KVServer
@@ -97,12 +1171,11 @@ def run_job_inproc(np_: int, prog: str, args: List[str],
     session = tempfile.mkdtemp(prefix="dvm_job_")
     server = KVServer(np_)
     world = HybridWorld(np_, 0, np_)
-    jobid = f"dvm-{os.getpid()}-{int(time.time() * 1000) & 0xFFFFFF}"
+    jobid = f"dvm-{os.getpid()}-j{next(_jobid_counter)}"
     failure: List[Optional[int]] = [None]
     flock = threading.Lock()
 
     def run_rank(rank: int) -> None:
-        rte = None
         try:
             rte = HybridRTE(world, rank, server.addr, node_id=0,
                             jobid=jobid, session_dir=session)
@@ -147,6 +1220,27 @@ def run_job_inproc(np_: int, prog: str, args: List[str],
     return (failure[0] or 0, out.buf.getvalue(), err.buf.getvalue())
 
 
+class _Tee(io.TextIOBase):
+    """Captures a job's stdout/stderr for the submitting client while
+    still echoing to the DVM console (run_job_inproc legacy path)."""
+
+    def __init__(self, real) -> None:
+        self.real = real
+        self.buf = io.StringIO()
+        self.lock = threading.Lock()
+
+    def write(self, s: str) -> int:
+        with self.lock:
+            self.buf.write(s)
+        self.real.write(s)
+        return len(s)
+
+    def flush(self) -> None:
+        self.real.flush()
+
+
+# -- CLI entry points -------------------------------------------------------
+
 def serve(opts) -> int:
     devices = None
     if opts.devices != "none":
@@ -155,69 +1249,28 @@ def serve(opts) -> int:
             jax.config.update("jax_platforms",
                               os.environ["JAX_PLATFORMS"])
         devices = jax.devices()  # PJRT bring-up happens HERE, once
-    listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-    listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-    listener.bind(("127.0.0.1", 0))
-    listener.listen(8)
-    port = listener.getsockname()[1]
-    tmp = opts.uri_file + ".tmp"
-    with open(tmp, "w") as f:
-        f.write(f"127.0.0.1:{port}\n")
-    os.replace(tmp, opts.uri_file)  # submitters never see a torn file
-    sys.stderr.write(f"tpu-dvm: ready on 127.0.0.1:{port} "
-                     f"(capacity {opts.np}, devices "
-                     f"{'warm' if devices else 'none'})\n")
-    jobs = 0
-    while True:
-        conn, _ = listener.accept()
-        try:
-            msg = _recv(conn)
-            if msg is None:
-                continue
-            if msg.get("op") == "halt":
-                _send(conn, {"ok": True, "jobs": jobs})
-                sys.stderr.write(f"tpu-dvm: halt after {jobs} jobs\n")
-                return 0
-            if msg.get("op") != "submit":
-                _send(conn, {"error": "bad op"})
-                continue
-            np_ = int(msg.get("np", opts.np))
-            if np_ > opts.np:
-                _send(conn, {"error": f"np {np_} exceeds DVM "
-                                      f"capacity {opts.np}"})
-                continue
-            t0 = time.perf_counter()
-            code, out, err = run_job_inproc(
-                np_, msg["prog"], msg.get("args") or [], devices)
-            jobs += 1
-            _send(conn, {"code": code, "stdout": out, "stderr": err,
-                         "wall_s": round(time.perf_counter() - t0, 3)})
-        except (OSError, ValueError) as e:
-            try:
-                _send(conn, {"error": str(e)[:300]})
-            except OSError:
-                pass
-        finally:
-            try:
-                conn.close()
-            except OSError:
-                pass
+    server = DVMServer(opts.np, devices=devices,
+                       uri_file=opts.uri_file)
+    return server.serve_forever()
 
 
-def submit(uri_file: str, np_: int, prog: str,
-           args: List[str]) -> int:
-    """Client side (used by mpirun --dvm)."""
-    with open(uri_file) as f:
-        host, _, port = f.read().strip().partition(":")
-    s = socket.create_connection((host, int(port)), timeout=30)
-    _send(s, {"op": "submit", "np": np_,
-              "prog": os.path.abspath(prog), "args": args})
-    s.settimeout(None)
-    resp = _recv(s)
-    s.close()
-    if resp is None or "error" in (resp or {}):
-        sys.stderr.write(f"mpirun --dvm: "
-                         f"{(resp or {}).get('error', 'no reply')}\n")
+def submit(uri_file: str, np_: int, prog: str, args: List[str],
+           timeout: Optional[float] = None) -> int:
+    """Client side (used by mpirun --dvm): legacy one-shot submit."""
+    try:
+        client = DvmClient(uri_file)
+    except DvmError as e:
+        sys.stderr.write(f"mpirun --dvm: {e}\n")
+        return 1
+    try:
+        resp = client.submit_job(np_, prog, args, timeout=timeout)
+    except DvmError as e:
+        sys.stderr.write(f"mpirun --dvm: {e}\n")
+        return 1
+    finally:
+        client.close()
+    if "error" in resp:
+        sys.stderr.write(f"mpirun --dvm: {resp['error']}\n")
         return 1
     sys.stdout.write(resp.get("stdout", ""))
     sys.stderr.write(resp.get("stderr", ""))
@@ -225,13 +1278,16 @@ def submit(uri_file: str, np_: int, prog: str,
 
 
 def halt(uri_file: str) -> int:
-    with open(uri_file) as f:
-        host, _, port = f.read().strip().partition(":")
-    s = socket.create_connection((host, int(port)), timeout=10)
-    _send(s, {"op": "halt"})
-    resp = _recv(s)
-    s.close()
-    return 0 if resp and resp.get("ok") else 1
+    try:
+        client = DvmClient(uri_file)
+        try:
+            resp = client.halt()
+        finally:
+            client.close()
+    except DvmError as e:
+        sys.stderr.write(f"tpu-dvm: {e}\n")
+        return 1
+    return 0 if resp.get("ok") else 1
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -242,6 +1298,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="where to write the contact address")
     ap.add_argument("--devices", default="auto",
                     choices=("auto", "none"))
+    ap.add_argument("--session-max", type=int, default=None,
+                    help="max concurrently-resident sessions "
+                         "(dvm_session_max)")
+    ap.add_argument("--queue-max", type=int, default=None,
+                    help="admission queue bound (dvm_queue_max)")
+    ap.add_argument("--batch-window-us", type=int, default=None,
+                    help="cross-session fused-dispatch window "
+                         "(dvm_batch_window_us; 0 disables)")
     ap.add_argument("--halt", default=None, metavar="URI_FILE",
                     help="stop a running DVM")
     opts = ap.parse_args(argv)
@@ -249,6 +1313,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         return halt(opts.halt)
     if not opts.uri_file:
         ap.error("--uri-file is required to serve")
+    if opts.session_max is not None:
+        registry.set("dvm_session_max", opts.session_max)
+    if opts.queue_max is not None:
+        registry.set("dvm_queue_max", opts.queue_max)
+    if opts.batch_window_us is not None:
+        registry.set("dvm_batch_window_us", opts.batch_window_us)
     return serve(opts)
 
 

@@ -983,7 +983,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                 f"configuration is fixed at dvm start)\n")
             return 2
         from ompi_tpu.tools.dvm import submit
-        return submit(opts.dvm, opts.np, opts.prog, opts.args)
+        return submit(opts.dvm, opts.np, opts.prog, opts.args,
+                      timeout=opts.timeout or None)
     # per-job control-plane secret (sec/basic analog): KV/OOB servers
     # refuse connections without it.  setdefault so a relaunch under
     # an outer job reuses the outer credential.

@@ -118,8 +118,9 @@ HIST_PROGRESS_TICK = 0
 HIST_COLL_DISPATCH = 1
 HIST_P2P_COMPLETE = 2
 HIST_COLL_SEGMENT = 3  # per-segment rendezvous latency (pipeline tier)
+HIST_SERVE_ATTACH = 4  # DVM session-attach latency (tools/dvm)
 HIST_NAMES = ("progress_tick", "coll_dispatch", "p2p_complete",
-              "coll_segment")
+              "coll_segment", "serve_attach")
 
 
 # -- intern tables ----------------------------------------------------------
@@ -182,6 +183,7 @@ CAT_COMPILE = intern_cat("compile")
 CAT_FT = intern_cat("ft")
 CAT_OOB = intern_cat("oob")
 CAT_FAULT = intern_cat("fault")
+CAT_SERVE = intern_cat("serve", HIST_SERVE_ATTACH)
 
 # categories whose spans are sampled / drop-accounted (pvar surface)
 SPAN_CATS = ("p2p", "coll", "nbc", "coll_dispatch", "coll_segment",
@@ -718,6 +720,11 @@ registry.register_pvar(
     help="Per-segment rendezvous latency histogram of the pipelined "
          "large-message tier (log2 us buckets)",
     getter=_tr_hist(HIST_COLL_SEGMENT))
+registry.register_pvar(
+    "trace", "", "hist_serve_attach", var_class="size",
+    help="DVM service-plane session-attach latency histogram "
+         "(log2 us buckets; fed by the pool's global tracer)",
+    getter=_tr_hist(HIST_SERVE_ATTACH))
 
 
 # -- shared collective/nbc instrumentation points ---------------------------

@@ -1,0 +1,18 @@
+"""Slow DVM session workload: parks long enough for test_dvm.py to
+race a halt against it — the drain must let this run finish."""
+import time
+
+import numpy as np
+
+import ompi_tpu
+from ompi_tpu.op import op as mpi_op
+
+comm = ompi_tpu.init()
+time.sleep(1.5)
+x = np.full(8, comm.rank + 1.0, np.float32)
+r = np.empty_like(x)
+comm.Allreduce(x, r, mpi_op.SUM)
+assert abs(float(r[0]) - sum(range(1, comm.size + 1))) < 1e-3
+if comm.rank == 0:
+    print("DONE", flush=True)
+ompi_tpu.finalize()

@@ -1,0 +1,352 @@
+"""Service-plane tests for the multiplexed DVM pool (tools/dvm):
+concurrent sessions must be byte-identical to sequential ones and
+ride the shared warm compiled-executable cache; admission control
+must queue and reject deterministically; halt must drain in-flight
+runs; and a client dying mid-run must never poison the pool or a
+peer session (ft_inject dvm_disconnect class)."""
+
+import json
+import os
+import socket
+import threading
+import time
+
+import pytest
+
+from ompi_tpu.mca.params import registry
+
+jax = pytest.importorskip("jax")
+
+from ompi_tpu.tools.dvm import (DVMServer, DvmBusy,  # noqa: E402
+                                DvmClient, DvmError)
+
+HERE = os.path.dirname(__file__)
+PROG = os.path.join(HERE, "_dvm_session_prog.py")
+SLOW_PROG = os.path.join(HERE, "_dvm_slow_prog.py")
+
+
+@pytest.fixture
+def pool(tmp_path):
+    uri = str(tmp_path / "dvm.uri")
+    srv = DVMServer(8, devices=jax.devices(), uri_file=uri).start()
+    yield srv, uri
+    srv.stop()
+
+
+def _set(vals):
+    saved = {k: registry.get(k) for k in vals}
+    for k, v in vals.items():
+        registry.set(k, v)
+    return saved
+
+
+def _restore(saved):
+    for k, v in saved.items():
+        registry.set(k, v)
+
+
+def _run_once(uri, tag, np_=4):
+    with DvmClient(uri) as c:
+        sid = c.attach(np_)["sid"]
+        resp = c.run(sid, PROG, [tag], timeout=120)
+        c.detach(sid)
+    assert resp.get("code") == 0, resp.get("stderr", "")[-2000:]
+    return resp["stdout"]
+
+
+def test_concurrent_sessions_byte_identical_and_warm(pool):
+    """Two concurrent sessions == two sequential sessions, byte for
+    byte — and after the sequential warm-up, the concurrent pair
+    compiles NOTHING (device-id-keyed CompiledLRU shared pool-wide;
+    hit pvars prove the reuse)."""
+    from ompi_tpu.coll.device import compile_cache
+
+    srv, uri = pool
+    seq = [_run_once(uri, "x") for _ in range(2)]
+    assert seq[0] == seq[1]
+    assert "DIGEST x " in seq[0]
+    builds0 = compile_cache.builds
+    hits0 = compile_cache.pv_hits.read()
+    outs = [None, None]
+
+    def worker(i):
+        outs[i] = _run_once(uri, "x")
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert outs[0] == seq[0] and outs[1] == seq[0], (outs, seq)
+    assert compile_cache.builds == builds0, \
+        "a concurrent session recompiled executables the sequential " \
+        "runs already cached"
+    assert compile_cache.pv_hits.read() > hits0
+
+
+def test_session_argv_isolation(pool):
+    """Two concurrent sessions with DIFFERENT argv each see their
+    own (thread-local sys.argv proxy, not a process-global swap)."""
+    srv, uri = pool
+    outs = {}
+
+    def worker(tag):
+        outs[tag] = _run_once(uri, tag, np_=2)
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in ("alpha", "beta")]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert "DIGEST alpha " in outs["alpha"]
+    assert "DIGEST beta " in outs["beta"]
+    assert "beta" not in outs["alpha"]
+
+
+def test_cross_session_batching_byte_identical(pool):
+    """With the cross-session window open, fused batches from two
+    concurrently-resident sessions coalesce into combined dispatches
+    — results still byte-identical to the solo run, and the
+    dvm_xsession pvars prove at least one combined dispatch."""
+    from ompi_tpu.coll import fusion
+
+    srv, uri = pool
+    baseline = _run_once(uri, "w")
+    saved = _set({"dvm_batch_window_us": 800000})
+    xb0 = fusion._pv_xbatches.read()
+    xc0 = fusion._pv_xcolls.read()
+    try:
+        # attach both sessions FIRST so the pool reports 2 resident
+        # sessions before either program dispatches
+        ca, cb = DvmClient(uri), DvmClient(uri)
+        sa = ca.attach(4)["sid"]
+        sb = cb.attach(4)["sid"]
+        res = {}
+
+        def runner(c, sid, key):
+            res[key] = c.run(sid, PROG, ["w"], timeout=120)
+
+        threads = [threading.Thread(target=runner, args=args)
+                   for args in ((ca, sa, "a"), (cb, sb, "b"))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for key in ("a", "b"):
+            assert res[key]["code"] == 0, res[key]["stderr"][-2000:]
+            assert res[key]["stdout"] == baseline
+        ca.detach(sa)
+        cb.detach(sb)
+        ca.close()
+        cb.close()
+    finally:
+        _restore(saved)
+    assert fusion._pv_xbatches.read() > xb0, \
+        "no combined cross-session dispatch happened inside the window"
+    assert fusion._pv_xcolls.read() >= xc0 + 2
+
+
+def test_admission_queue_and_reject(tmp_path):
+    """Rank-capacity admission: wait=False rejects immediately when
+    full; one waiter queues; a second is rejected by the queue bound;
+    detach admits the queued waiter FIFO."""
+    uri = str(tmp_path / "dvm.uri")
+    srv = DVMServer(4, devices=jax.devices(), uri_file=uri).start()
+    saved = _set({"dvm_queue_max": 1})
+    try:
+        c1 = DvmClient(uri)
+        s1 = c1.attach(4)["sid"]
+        c2 = DvmClient(uri)
+        with pytest.raises(DvmBusy):
+            c2.attach(2, wait=False)
+        got = {}
+
+        def waiter():
+            try:
+                with DvmClient(uri) as c3:
+                    r = c3.attach(2, timeout=60)
+                    got.update(r)
+                    c3.detach(r["sid"])
+            except DvmError as e:  # surfaced by the assert below
+                got["err"] = str(e)
+
+        th = threading.Thread(target=waiter)
+        th.start()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            with srv.lock:
+                if len(srv._waiters) == 1:
+                    break
+            time.sleep(0.02)
+        with srv.lock:
+            assert len(srv._waiters) == 1, "waiter never queued"
+        # queue is at its bound: the next attach bounces immediately
+        c4 = DvmClient(uri)
+        with pytest.raises(DvmBusy, match="queue full"):
+            c4.attach(2, timeout=30)
+        c4.close()
+        c1.detach(s1)  # frees capacity -> the queued waiter admits
+        th.join(timeout=60)
+        assert "sid" in got, got
+        assert got["queued_us"] > 0
+        c1.close()
+        c2.close()
+    finally:
+        _restore(saved)
+        srv.stop()
+
+
+def test_halt_drains_inflight_runs(tmp_path):
+    """Halt while a run is executing: the drain lets the run finish
+    (code 0, output delivered) before the pool stops."""
+    uri = str(tmp_path / "dvm.uri")
+    srv = DVMServer(4, devices=jax.devices(), uri_file=uri).start()
+    c = DvmClient(uri)
+    sid = c.attach(4)["sid"]
+    res = {}
+
+    def runner():
+        res["resp"] = c.run(sid, SLOW_PROG, timeout=120)
+
+    th = threading.Thread(target=runner)
+    th.start()
+    time.sleep(0.4)  # the run is inside its 1.5s sleep now
+    h = DvmClient(uri)
+    hr = h.halt()
+    assert hr.get("ok"), hr
+    h.close()
+    th.join(timeout=60)
+    assert res["resp"]["code"] == 0, res["resp"]
+    assert "DONE" in res["resp"]["stdout"]
+    with srv.lock:
+        assert not srv.sessions, "halt left sessions resident"
+    c.close()
+    srv.stop()
+
+
+def test_client_disconnect_mid_run_never_poisons_pool(pool):
+    """ft_inject dvm_disconnect: a client that dies right after
+    sending a run request leaves its job executing with no client.
+    The pool must complete it, reap the orphaned session, and leave
+    the pool and a concurrently-resident peer session untouched."""
+    srv, uri = pool
+    cb = DvmClient(uri)
+    sb = cb.attach(4)["sid"]
+    saved = _set({"ft_inject_plan": "dvm_disconnect:1",
+                  "ft_inject_skip": 0})
+    try:
+        ca = DvmClient(uri)  # injector armed at construction
+        sa = ca.attach(2)["sid"]
+        with pytest.raises(DvmError, match="dvm_disconnect"):
+            ca.run(sa, PROG, ["doomed"])
+    finally:
+        _restore(saved)
+    # the peer session keeps working while the orphan unwinds
+    rb = cb.run(sb, PROG, ["peer"], timeout=120)
+    assert rb["code"] == 0, rb["stderr"][-2000:]
+    assert "DIGEST peer " in rb["stdout"]
+    # the pool notices the dead client and detaches its session
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        with srv.lock:
+            n = len(srv.sessions)
+        if n == 1:
+            break
+        time.sleep(0.05)
+    assert n == 1, f"orphaned session never reaped ({n} resident)"
+    cb.detach(sb)
+    cb.close()
+
+
+def test_failing_session_isolated(pool):
+    """A program that raises poisons ONLY its own session: the run
+    reports nonzero, the session is dead to further runs, and a peer
+    session attached to the same pool keeps working."""
+    srv, uri = pool
+    bad = os.path.join(str(srv), "")  # not used; build a bad prog
+    import tempfile
+    with tempfile.NamedTemporaryFile(
+            "w", suffix=".py", delete=False) as f:
+        f.write("import ompi_tpu\n"
+                "comm = ompi_tpu.init()\n"
+                "raise RuntimeError('boom rank %d' % comm.rank)\n")
+        bad = f.name
+    try:
+        cb = DvmClient(uri)
+        sb = cb.attach(4)["sid"]
+        ca = DvmClient(uri)
+        sa = ca.attach(2)["sid"]
+        ra = ca.run(sa, bad, timeout=120)
+        assert ra["code"] != 0
+        assert "boom" in ra["stderr"]
+        with pytest.raises(DvmError, match="dead"):
+            ca.run(sa, PROG, ["again"])
+        rb = cb.run(sb, PROG, ["peer"], timeout=120)
+        assert rb["code"] == 0, rb["stderr"][-2000:]
+        ca.detach(sa)
+        cb.detach(sb)
+        ca.close()
+        cb.close()
+    finally:
+        os.unlink(bad)
+
+
+def test_proctable_published_and_pruned(pool):
+    """Resident sessions publish {uri}.proctable.json entries mapping
+    rank -> pool pid + thread (ompi_tpu-attach --stacks target);
+    detach prunes them."""
+    srv, uri = pool
+    c = DvmClient(uri)
+    r = c.attach(3)
+    with open(uri + ".proctable.json") as f:
+        table = json.load(f)
+    tags = {e["tag"] for e in table}
+    assert "pool" in tags
+    assert {f"s{r['sid']}:r{i}" for i in range(3)} <= tags
+    assert all(e["pid"] == os.getpid() for e in table)
+    assert all("thread" in e for e in table)
+    from ompi_tpu.tools.attach import load_proctable
+    assert {e["tag"] for e in load_proctable(
+        uri + ".proctable.json")} == tags
+    c.detach(r["sid"])
+    c.close()
+    with open(uri + ".proctable.json") as f:
+        table2 = json.load(f)
+    assert not any(e["tag"].startswith(f"s{r['sid']}:")
+                   for e in table2)
+
+
+def test_client_diagnostics(tmp_path):
+    """The client must fail fast and friendly: missing uri-file, and
+    the classic stale-uri-file (pool exited, file left behind) that
+    used to hang forever on settimeout(None)."""
+    with pytest.raises(DvmError, match="not found"):
+        DvmClient(str(tmp_path / "nope.uri"))
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+    sock.close()  # nothing listens here any more
+    stale = str(tmp_path / "stale.uri")
+    with open(stale, "w") as f:
+        f.write(f"127.0.0.1:{port}\n")
+    with pytest.raises(DvmError, match="stale uri-file"):
+        DvmClient(stale, connect_timeout=5)
+
+
+def test_attach_pvars_and_jobid_counter(pool):
+    """Attach latency feeds the dvm pvars, and inproc jobids ride a
+    process-monotonic counter (the old ms-truncated time collided for
+    jobs started within the same millisecond)."""
+    from ompi_tpu.tools.dvm import _jobid_counter
+    srv, uri = pool
+    before = registry._pvars["dvm_attaches"].read()
+    _run_once(uri, "pv", np_=2)
+    assert registry._pvars["dvm_attaches"].read() == before + 1
+    assert registry._pvars["dvm_attach_us_max"].read() > 0
+    assert sum(registry._pvars["dvm_attach_hist"].read()) >= 1
+    assert registry._pvars["dvm_sessions_peak"].read() >= 1
+    ids = {f"dvm-{os.getpid()}-j{next(_jobid_counter)}"
+           for _ in range(100)}
+    assert len(ids) == 100  # same-millisecond jobs can never collide
