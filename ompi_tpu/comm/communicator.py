@@ -42,6 +42,18 @@ COMM_TYPE_SHARED = 1
 # next_cid_local's dense counting and the ULFM store's 4096+ range.
 EPOCH_CID_STRIDE = 65536
 
+# DVM-resident sessions band the same space along a DISJOINT outer
+# dimension: session b owns [b*SESSION_CID_STRIDE,
+# (b+1)*SESSION_CID_STRIDE), subdivided into its own respawn-epoch
+# bands.  The dimensions must not be additive — (band+epoch)*STRIDE
+# would alias session k at epoch e with session k+e at epoch 0, so a
+# ULFM respawn recovery inside one session could collide with a peer
+# session's cids (trace spans, pvar labels, rendezvous keys).  A
+# session that survives MAX_RESPAWN_EPOCHS in-job replacements would
+# spill into the next band; respawn.rejoin guards against that.
+MAX_RESPAWN_EPOCHS = 1024
+SESSION_CID_STRIDE = MAX_RESPAWN_EPOCHS * EPOCH_CID_STRIDE
+
 
 class Group:
     """Dense ordered set of global ranks (ref: ompi/group)."""
@@ -157,11 +169,12 @@ class Communicator:
         (ref: ompi_comm_nextcid multi-round agreement).  After a
         respawn recovery the proposal is floored into the current
         epoch's cid band — see EPOCH_CID_STRIDE.  A DVM-resident
-        session adds its session band (state.cid_band) on top: epoch
-        and session indices share the banded id space, so derived
-        comms of concurrent sessions can never alias."""
-        floor = ((self.state.respawn_epoch + self.state.cid_band)
-                 * EPOCH_CID_STRIDE)
+        session owns a disjoint OUTER band (state.cid_band *
+        SESSION_CID_STRIDE) subdivided into epoch bands, so derived
+        comms of concurrent sessions can never alias — even after a
+        respawn recovery bumps one session's epoch."""
+        floor = (self.state.cid_band * SESSION_CID_STRIDE
+                 + self.state.respawn_epoch * EPOCH_CID_STRIDE)
         while True:
             proposal = self.state.next_cid_local()
             if proposal < floor:

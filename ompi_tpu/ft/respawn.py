@@ -154,7 +154,8 @@ def rejoin(comm, name: str = ""):
     call this after catching ``ERR_PROC_FAILED``; a replacement rank
     (``respawn.joining(state)``) calls it right after init."""
     from ompi_tpu.comm.communicator import (
-        EPOCH_CID_STRIDE, Communicator, Group)
+        EPOCH_CID_STRIDE, MAX_RESPAWN_EPOCHS, SESSION_CID_STRIDE,
+        Communicator, Group)
 
     state = comm.state
     u = _ulfm._require(comm)
@@ -173,6 +174,14 @@ def rejoin(comm, name: str = ""):
     store = _ulfm._store(state)
     am_joining = joining(state)
     epoch = state.respawn_epoch + 1
+    if epoch >= MAX_RESPAWN_EPOCHS:
+        # the epoch dimension of the banded cid space is exhausted: a
+        # further band would spill into the NEXT session's cid range
+        # (see SESSION_CID_STRIDE) and break pool-wide cid uniqueness
+        raise _eh.MPIException(
+            _eh.ERR_OTHER,
+            f"respawn epoch limit reached ({MAX_RESPAWN_EPOCHS}); "
+            "restart the job instead of recovering in place")
     base = ("respawn", epoch)
     deadline = time.monotonic() + max(1.0, _timeout_var.value)
     t0 = time.perf_counter()
@@ -215,7 +224,11 @@ def rejoin(comm, name: str = ""):
                 if complete and union:
                     store.put_once(base + ("d",), {
                         "failed": sorted(union),
-                        "cid": epoch * EPOCH_CID_STRIDE
+                        # session band first: a recovery inside a
+                        # DVM-resident session must stay inside that
+                        # session's cid range (band 0 for plain jobs)
+                        "cid": state.cid_band * SESSION_CID_STRIDE
+                        + epoch * EPOCH_CID_STRIDE
                         + store.next_cid() % EPOCH_CID_STRIDE})
                     continue
             if time.monotonic() > deadline:

@@ -15,7 +15,7 @@ from ompi_tpu.btl import inproc as _btl_inproc  # noqa: F401 (registers)
 from ompi_tpu.btl import self_btl as _btl_self  # noqa: F401
 from ompi_tpu.btl import shm as _btl_shm  # noqa: F401
 from ompi_tpu.btl import tcp as _btl_tcp  # noqa: F401
-from ompi_tpu.comm.communicator import (EPOCH_CID_STRIDE, Communicator,
+from ompi_tpu.comm.communicator import (SESSION_CID_STRIDE, Communicator,
                                         Group)
 from ompi_tpu.pml import ob1 as _pml_ob1
 from ompi_tpu.pml import monitoring as _pml_monitoring
@@ -155,8 +155,10 @@ def mpi_init(state: ProcState, device=None) -> ProcState:
     # DVM-resident sessions carry a session cid band: the predefined
     # comms live at the band base, so even cid 0/1 are session-unique
     # across the pool (next_cid floors derived comms into the same
-    # band).  Ordinary jobs have band 0 — world cid 0, self cid 1.
-    band = state.cid_band * EPOCH_CID_STRIDE
+    # band; SESSION_CID_STRIDE keeps the session dimension disjoint
+    # from respawn-epoch banding).  Ordinary jobs have band 0 — world
+    # cid 0, self cid 1.
+    band = state.cid_band * SESSION_CID_STRIDE
     state.comm_world = Communicator(state, band,
                                     Group(range(wbase, wbase + wsize)),
                                     name="MPI_COMM_WORLD")

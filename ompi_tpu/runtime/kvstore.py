@@ -309,7 +309,35 @@ class KVServer:
                     fid = msg["id"]
                     want = int(msg.get("n", self.nprocs))
                     weight = int(msg.get("weight", 1))
+                    ns = msg.get("ns")
                     with self.cv:
+                        ab = self.aborted
+                        if ab is None and ns is not None:
+                            ab = self.ns_aborted.get(ns)
+                        if ab is None and self.ns_aborted:
+                            # untagged late arrival (e.g. a proxied
+                            # fence drops the ns tag): fence ids are
+                            # ns-prefixed "ns/<id>" by KVClient, so
+                            # recover the scope by prefix
+                            for a_ns, rec in self.ns_aborted.items():
+                                if fid.startswith(a_ns + "/"):
+                                    ab = rec
+                                    break
+                        if ab is not None:
+                            # the abort sweep only releases waiters
+                            # already parked; a rank fencing AFTER its
+                            # scope was poisoned must fail here — the
+                            # aborting rank will never arrive, and
+                            # re-registering the fence would park this
+                            # client forever (KVClient sockets have no
+                            # read timeout)
+                            try:
+                                _send_msg(conn, {
+                                    "error": f"aborted by rank "
+                                             f"{ab[0]}: {ab[2]}"})
+                            except OSError:
+                                pass
+                            continue
                         self.fences[fid] = self.fences.get(fid, 0) + weight
                         self.fence_waiters.setdefault(fid, []).append(conn)
                         if self.fences[fid] >= want:
@@ -590,8 +618,8 @@ class KVClient:
 
     def fence(self, fence_id: str, n: Optional[int] = None,
               weight: int = 1) -> None:
-        msg: Dict[str, Any] = {"op": "fence",
-                               "id": self._k(fence_id)}
+        msg: Dict[str, Any] = self._ns_tag(
+            {"op": "fence", "id": self._k(fence_id)})
         if n is not None:
             msg["n"] = n
         if weight != 1:

@@ -42,7 +42,7 @@ class ProcState:
         self.respawn_epoch = 0
         self.respawn_joining = False
         # DVM serve plane (tools/dvm): cid_band shifts this rank's
-        # whole communicator-id space by band*EPOCH_CID_STRIDE, so
+        # whole communicator-id space by band*SESSION_CID_STRIDE, so
         # concurrently-resident sessions in one pool process never
         # share a cid (trace spans, pvar labels and rendezvous keys
         # stay unambiguous pool-wide); serve_resident defers

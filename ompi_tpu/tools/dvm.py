@@ -355,6 +355,7 @@ class DVMServer:
         self.devices = devices
         self.uri_file = uri_file
         self.lock = threading.Lock()
+        self._pt_lock = threading.Lock()  # serializes proctable writes
         self.sessions: Dict[int, _Session] = {}
         self.active_ranks = 0
         self._waiters: collections.deque = collections.deque()
@@ -502,10 +503,13 @@ class DVMServer:
             with self.lock:
                 self._conns.discard(conn)
             # client death is a detach: a dying submitter must never
-            # strand its sessions' ranks (or poison anyone else's)
+            # strand its sessions' ranks (or poison anyone else's).
+            # force=True: the owner is gone, nobody else may detach
+            # these sids (dispatch is serial per connection, so no run
+            # of ours can still be in flight here)
             for sid in owned:
                 try:
-                    self._detach(sid)
+                    self._detach(sid, force=True)
                 except DvmError:
                     pass
             try:
@@ -569,9 +573,15 @@ class DVMServer:
             return False
         if op == "detach":
             sid = int(msg.get("sid", -1))
-            if sid in owned:
-                owned.remove(sid)
+            if sid not in owned:
+                # mirror the run op: a connection may only detach
+                # sessions IT attached — sids are small and monotonic,
+                # and a cross-client detach would scrub a world whose
+                # rank-threads another client is still driving
+                raise DvmError(f"unknown session s{sid} (not attached "
+                               "on this connection)")
             self._detach(sid)
+            owned.remove(sid)
             conn.reply({"ok": True})
             return False
         if op == "submit":
@@ -914,7 +924,7 @@ class DVMServer:
                        wall_ms=int(wall * 1000))
         return (failure[0] or 0, out.value(), err.value(), wall)
 
-    def _detach(self, sid: int) -> None:
+    def _detach(self, sid: int, force: bool = False) -> None:
         with self.lock:
             sess = self.sessions.get(sid)
             if sess is None:
@@ -922,6 +932,13 @@ class DVMServer:
                                "(already detached?)")
             if sess.detaching:
                 return
+            if sess.running and not force:
+                # finalizing/scrubbing a world while rank-threads are
+                # executing in it breaks the isolation contract; only
+                # drain (which already waited out its deadline) and
+                # owner-death cleanup may force through
+                raise DvmError(f"session s{sid} has a run in "
+                               "progress; detach after it completes")
             sess.detaching = True
         self._destroy(sess)
         self._release(sess)
@@ -989,7 +1006,7 @@ class DVMServer:
             sids = list(self.sessions)
         for sid in sids:
             try:
-                self._detach(sid)
+                self._detach(sid, force=True)
             except DvmError:
                 pass
         with self.lock:
@@ -998,25 +1015,30 @@ class DVMServer:
     def _write_proctable(self) -> None:
         if not self.uri_file:
             return
-        host = socket.gethostname()
-        pid = os.getpid()
-        entries = [{"tag": "pool", "pid": pid, "host": host,
-                    "thread": "dvm-accept"}]
-        with self.lock:
-            sessions = list(self.sessions.values())
-        for sess in sessions:
-            for r in range(sess.np):
-                entries.append({"tag": f"s{sess.sid}:r{r}", "pid": pid,
-                                "host": host,
-                                "thread": f"dvm-s{sess.sid}-r{r}"})
-        path = self.uri_file + ".proctable.json"
-        try:
-            tmp = path + ".tmp"
-            with open(tmp, "w") as f:
-                json.dump(entries, f, indent=1)
-            os.replace(tmp, path)
-        except OSError:
-            pass  # diagnostics must never take the pool down
+        # _pt_lock serializes snapshot+write: concurrent attach/detach
+        # writers share ONE fixed tmp path, so unserialized they could
+        # interleave into (and then publish) a torn JSON file, or
+        # os.replace a stale snapshot over a newer one
+        with self._pt_lock:
+            host = socket.gethostname()
+            pid = os.getpid()
+            entries = [{"tag": "pool", "pid": pid, "host": host,
+                        "thread": "dvm-accept"}]
+            with self.lock:
+                sessions = list(self.sessions.values())
+            for sess in sessions:
+                for r in range(sess.np):
+                    entries.append({"tag": f"s{sess.sid}:r{r}",
+                                    "pid": pid, "host": host,
+                                    "thread": f"dvm-s{sess.sid}-r{r}"})
+            path = self.uri_file + ".proctable.json"
+            try:
+                tmp = path + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump(entries, f, indent=1)
+                os.replace(tmp, path)
+            except OSError:
+                pass  # diagnostics must never take the pool down
 
 
 # -- client -----------------------------------------------------------------

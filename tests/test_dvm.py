@@ -350,3 +350,78 @@ def test_attach_pvars_and_jobid_counter(pool):
     ids = {f"dvm-{os.getpid()}-j{next(_jobid_counter)}"
            for _ in range(100)}
     assert len(ids) == 100  # same-millisecond jobs can never collide
+
+
+def test_detach_requires_ownership(pool):
+    """A connection may only detach sessions IT attached: a stranger
+    guessing a small monotonic sid bounces, and the victim session
+    keeps working."""
+    srv, uri = pool
+    ca = DvmClient(uri)
+    sa = ca.attach(2)["sid"]
+    cb = DvmClient(uri)
+    with pytest.raises(DvmError, match="not attached"):
+        cb.detach(sa)
+    with srv.lock:
+        assert sa in srv.sessions, "cross-client detach destroyed it"
+    r = ca.run(sa, PROG, ["own"], timeout=120)
+    assert r["code"] == 0, r["stderr"][-2000:]
+    ca.detach(sa)
+    ca.close()
+    cb.close()
+
+
+def test_detach_refused_while_running(pool):
+    """_detach must not finalize/scrub a world whose rank-threads are
+    mid-run (only drain and owner-death cleanup force through)."""
+    srv, uri = pool
+    c = DvmClient(uri)
+    sid = c.attach(4)["sid"]
+    res = {}
+
+    def runner():
+        res["r"] = c.run(sid, SLOW_PROG, timeout=120)
+
+    th = threading.Thread(target=runner)
+    th.start()
+    time.sleep(0.4)  # the run is inside its sleep now
+    with pytest.raises(DvmError, match="run in progress"):
+        srv._detach(sid)
+    th.join(timeout=60)
+    assert res["r"]["code"] == 0, res["r"]
+    assert "DONE" in res["r"]["stdout"]
+    c.detach(sid)
+    c.close()
+
+
+def test_early_rank_exit_releases_run_boundary_fence(pool):
+    """One rank exits nonzero EARLY; its peers finish the program
+    later and only then reach the run-boundary fence.  The session's
+    namespace abort must fail that late fence immediately — the abort
+    sweep released nobody (no one was parked yet), and before the fix
+    the fence re-registered and wedged the rank-threads, the
+    session's capacity, and the client's run RPC forever."""
+    srv, uri = pool
+    import tempfile
+    with tempfile.NamedTemporaryFile(
+            "w", suffix=".py", delete=False) as f:
+        f.write("import sys\nimport time\n"
+                "import ompi_tpu\n"
+                "comm = ompi_tpu.init()\n"
+                "if comm.rank == 0:\n"
+                "    sys.exit(3)\n"
+                "time.sleep(1.0)\n")
+        prog = f.name
+    try:
+        c = DvmClient(uri)
+        sid = c.attach(2)["sid"]
+        r = c.run(sid, prog, timeout=60)
+        assert r["code"] == 3, r
+        with pytest.raises(DvmError, match="dead"):
+            c.run(sid, prog)
+        c.detach(sid)
+        c.close()
+        with srv.lock:
+            assert not srv.sessions, "session never released"
+    finally:
+        os.unlink(prog)

@@ -202,3 +202,40 @@ def test_dvm_warm_pool_second_job_faster(tmp_path):
             srv.wait(timeout=10)
         except subprocess.TimeoutExpired:
             srv.kill()
+
+
+def test_kv_fence_after_ns_abort_fails_fast():
+    """A rank arriving at a fence AFTER its namespace was aborted must
+    get the abort error immediately: the abort sweep only releases
+    waiters ALREADY parked, and a late arrival that re-registered the
+    fence would hang its client forever (KVClient sockets have no read
+    timeout).  Scoping — a peer namespace stays live, and a global
+    abort poisons late fences in every namespace."""
+    import time
+
+    from ompi_tpu.runtime import kvstore
+
+    server = kvstore.KVServer(2)
+    try:
+        a = kvstore.KVClient(server.addr, ns="sA")
+        b = kvstore.KVClient(server.addr, ns="sB")
+        a.abort(0, 3, "early exit")
+        t0 = time.monotonic()
+        with pytest.raises(RuntimeError, match="aborted by rank 0"):
+            a.fence("f1", n=2)
+        assert time.monotonic() - t0 < 5, "late fence was parked"
+        # untagged late arrival: the scope is recovered from the
+        # ns-prefixed fence id
+        raw = kvstore.KVClient(server.addr)
+        with pytest.raises(RuntimeError, match="aborted"):
+            raw.fence("sA/f2", n=2)
+        # the peer namespace is unaffected: its 1-deep fence completes
+        b.fence("g1", n=1)
+        # a global abort fails late fences of EVERY namespace
+        raw.abort(0, 1, "global down")
+        with pytest.raises(RuntimeError, match="aborted"):
+            b.fence("g2", n=2)
+        for c in (a, b, raw):
+            c.close()
+    finally:
+        server.close()
