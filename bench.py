@@ -180,6 +180,17 @@ def main() -> None:
                          "BENCH_DETAIL.json, and FAIL (exit 1) if a "
                          "warm attach is not at least 10x faster "
                          "than the cold launch")
+    ap.add_argument("--probe-obs", action="store_true",
+                    help="Measure the telemetry plane: scrape-tick "
+                         "overhead on the progress sweep (interleaved "
+                         "on/off blocks at a 1 ms interval), exact "
+                         "per-session attribution under 4 concurrent "
+                         "DVM sessions, and the flight-recorder "
+                         "round-trip through attach --events and a "
+                         "traceview merge; persist under 'probe_obs' "
+                         "in BENCH_DETAIL.json, and FAIL (exit 1) if "
+                         "the median overhead exceeds 5%% or either "
+                         "truth check breaks")
     opts = ap.parse_args()
 
     detail_path = os.path.join(
@@ -394,6 +405,40 @@ def main() -> None:
             sys.exit(1)
         return
 
+    if opts.probe_obs:
+        from benchmarks.probe_obs import persist, run_probe
+
+        probe = run_probe()
+        notes = persist(probe, detail_path)
+        line = {
+            "metric": f"obs telemetry plane, scrape tick at "
+                      f"{probe['scrape_interval_ms']} ms on "
+                      f"{probe['nranks']} ranks + "
+                      f"{probe['sessions']} attributed DVM sessions",
+            "value": probe["overhead_pct"],
+            "unit": "pct_overhead_median",
+            "off_us_median": probe["off_us_median"],
+            "on_us_median": probe["on_us_median"],
+            "scrapes_on_side": probe["scrapes_on_side"],
+            "attribution_ok": probe["attribution_ok"],
+            "sessions_attributed": probe["sessions_attributed"],
+            "events_roundtrip_ok": probe["events_roundtrip_ok"],
+            "events_recorded": probe["events_recorded"],
+            "within_budget": probe["within_budget"],
+        }
+        line.update({k: v for k, v in notes.items() if "error" in k})
+        sys.stderr.write(json.dumps(probe, indent=1) + "\n")
+        print(json.dumps(line))
+        if not probe["within_budget"]:
+            sys.stderr.write(
+                f"FAIL: obs probe — overhead "
+                f"{probe['overhead_pct']}% (budget "
+                f"{probe['budget_pct']}%), attribution_ok="
+                f"{probe['attribution_ok']}, events_roundtrip_ok="
+                f"{probe['events_roundtrip_ok']}\n")
+            sys.exit(1)
+        return
+
     if opts.quick:
         caps = {"ar": 64 * 1024, "bcast": 16 * 1024, "a2a": 4 * 1024,
                 "rsb": 16 * 1024}
@@ -510,7 +555,7 @@ def main() -> None:
                           for k in ("probe_dispatch", "trace_overhead",
                                     "probe_recovery", "probe_respawn",
                                     "probe_pipeline", "probe_ckpt",
-                                    "probe_serve")
+                                    "probe_serve", "probe_obs")
                           if isinstance(prior, dict) and k in prior},
                        "device_us": dev, "software_us": sw,
                        "software_tuned_tcp_us": sw_tcp,

@@ -1,0 +1,320 @@
+"""--probe-obs microbench: the fleet telemetry plane's cost and truth.
+
+Three acceptance questions for the observability layer
+(ompi_tpu/obs, docs/DESIGN.md §16), answered in one run:
+
+1. **What does the scrape tick cost on the hot path?**  The Scraper
+   rides the progress sweep's SAMPLED tracer-timing reads (1 in 16
+   sweeps, reusing the timestamp already taken — zero clock reads of
+   its own), with a whole-histogram integer copy only when
+   ``obs_scrape_interval_ms`` elapses.  Methodology is
+   trace_overhead's: ONE 4-rank thread-rank
+   world, the scrape tick flipped between INTERLEAVED blocks (off,
+   on, off, on, ...) so scheduler/placement modes cancel, judged on
+   the MEDIAN over block pairs.  The measured op is a small ring
+   sendrecv — p2p waits spin on the progress engine, so every op
+   drives many sweeps (the sweep IS the instrumented path; device
+   collectives rendezvous without sweeping and would measure
+   nothing).  The interval is pinned to 1 ms — far hotter than the
+   100 ms default — so the budget is enforced against the worst
+   configured cadence.
+
+2. **Does per-session attribution add up?**  A live pool (capacity 8)
+   serves 4 concurrent sessions; a ``metrics`` RPC scrape taken while
+   the pool is resident must show, for EVERY ScopedPvar, the global
+   counter equal to the sum over all session bands (band 0 =
+   unattributed included).  No tolerance: these are integer counters
+   on one path.
+
+3. **Does the flight recorder round-trip?**  At least one recorded
+   event must come back through BOTH operator surfaces: live via
+   ``ompi_tpu-attach --events`` (the metrics RPC), and after halt via
+   the persisted ``<uri>.events.json`` ring merged by traceview onto
+   the perfetto timeline.
+
+``within_budget`` requires all three: median scrape overhead <= 5%%,
+attribution exact, and the event round-trip intact.  Results land in
+BENCH_DETAIL.json under ``probe_obs``; ``bench.py --probe-obs`` exits
+nonzero when any leg fails.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import json
+import os
+import statistics
+import sys
+import threading
+import time
+from typing import Dict, List
+
+NRANKS = 4
+WARMUP = 50        # untimed warm ops before anything else
+RAMP_OPS = 2000    # traced ops to settle the adaptive sampler
+BLOCK_OPS = 1500   # ring sendrecvs per measured block
+BLOCKS = 5         # interleaved off/on block pairs
+BUDGET_PCT = 5.0   # acceptance bound for the scrape-on path (median)
+SCRAPE_MS = 1      # worst-cadence interval under test (default: 100)
+
+CAPACITY = 8       # pool rank capacity for the attribution leg
+SESSIONS = 4       # concurrent sessions (the acceptance bar)
+SESSION_NP = 2     # 4 x 2 = 8 ranks resident at once
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PROG = os.path.join(REPO, "tests", "_dvm_session_prog.py")
+
+
+# -- leg 1: scrape-tick overhead on the progress sweep ----------------------
+
+def _overhead_world() -> Dict:
+    """One thread-rank world alternating scrape-off/scrape-on blocks;
+    returns rank 0's per-block timings plus the scraper's own
+    refresh count (proof the on-side actually scraped)."""
+    import numpy as np
+
+    from ompi_tpu.testing import run_ranks
+
+    def fn(comm):
+        sbuf = np.ones(8, dtype=np.float32)
+        rbuf = np.zeros(8, dtype=np.float32)
+        nxt = (comm.rank + 1) % comm.size
+        prv = (comm.rank - 1) % comm.size
+        st = comm.state
+        sc = st.progress.obs
+        assert sc is not None  # trace on + interval > 0 => attached
+
+        def op(tag):
+            rq = comm.Irecv(rbuf, prv, tag=tag)
+            comm.Send(sbuf, nxt, tag=tag)
+            rq.wait()
+
+        for _ in range(WARMUP):
+            op(1)
+        for _ in range(RAMP_OPS):
+            op(1)
+        ticks0 = sc.ticks
+        off_blocks, on_blocks = [], []
+        for b in range(BLOCKS * 2):
+            scraping = bool(b & 1)
+            comm.Barrier()
+            # every rank flips ITS OWN progress engine's obs slot:
+            # None is exactly the scrape-off contract (one is-None
+            # check per sweep — the tracer-slot model)
+            st.progress.obs = sc if scraping else None
+            comm.Barrier()
+            t0 = time.perf_counter()
+            for _ in range(BLOCK_OPS):
+                op(2)
+            dt = time.perf_counter() - t0
+            (on_blocks if scraping else off_blocks).append(
+                dt / BLOCK_OPS * 1e6)
+        st.progress.obs = sc
+        comm.Barrier()
+        return {"off_us_blocks": off_blocks,
+                "on_us_blocks": on_blocks,
+                "scrapes": sc.ticks - ticks0,
+                "gen": sc.buf[0]}
+
+    return run_ranks(NRANKS, fn, timeout=600)[0]
+
+
+def _measure_overhead() -> Dict:
+    from ompi_tpu.mca.params import registry
+
+    registry.set("trace_enable", "1")
+    registry.set("trace_buffer_events", "16384")
+    # measure the scrape tick alone: no autotune callback riding the
+    # sweep on either side
+    registry.set("coll_autotune_enable", "0")
+    registry.set("obs_scrape_interval_ms", str(SCRAPE_MS))
+    try:
+        snap = _overhead_world()
+    finally:
+        registry.set("trace_enable", "0")
+        registry.set("obs_scrape_interval_ms", "100")
+    off_times = snap["off_us_blocks"]
+    on_times = snap["on_us_blocks"]
+    off_med = statistics.median(off_times)
+    on_med = statistics.median(on_times)
+    overhead_best = ((min(on_times) - min(off_times))
+                     / min(off_times) * 100.0)
+    overhead_med = (on_med - off_med) / off_med * 100.0
+    gil = getattr(sys, "_is_gil_enabled", lambda: True)()
+    return {
+        "nranks": NRANKS,
+        "ops_per_block": BLOCK_OPS,
+        "blocks_per_side": BLOCKS,
+        "ramp_ops": RAMP_OPS,
+        "scrape_interval_ms": SCRAPE_MS,
+        "host_cores": os.cpu_count(),
+        "gil_enabled": bool(gil),
+        "off_us_median": round(off_med, 2),
+        "on_us_median": round(on_med, 2),
+        "off_us_all": [round(x, 2) for x in off_times],
+        "on_us_all": [round(x, 2) for x in on_times],
+        "overhead_pct_best": round(overhead_best, 2),
+        "overhead_pct": round(overhead_med, 2),
+        "scrapes_on_side": snap["scrapes"],
+    }
+
+
+# -- legs 2+3: attribution + event round-trip on a live pool ----------------
+
+def _serve_and_scrape() -> Dict:
+    import tempfile
+
+    import jax
+
+    from ompi_tpu import obs
+    from ompi_tpu.tools import traceview
+    from ompi_tpu.tools.attach import show_events
+    from ompi_tpu.tools.dvm import DvmClient, DVMServer
+
+    tmpdir = tempfile.mkdtemp(prefix="probe_obs_")
+    uri = os.path.join(tmpdir, "dvm.uri")
+    srv = DVMServer(CAPACITY, devices=jax.devices(), uri_file=uri)
+    srv.start()
+    live_metrics: List[dict] = []
+    errs: List[str] = []
+    out: Dict = {}
+    try:
+        barrier = threading.Barrier(SESSIONS + 1, timeout=120)
+
+        def submitter(idx: int) -> None:
+            try:
+                with DvmClient(uri) as c:
+                    sid = c.attach(SESSION_NP, timeout=120)["sid"]
+                    barrier.wait()   # all 4 sessions resident at once
+                    for _ in range(2):
+                        r = c.run(sid, PROG, [f"s{idx}"], timeout=120)
+                        if r["code"] != 0:
+                            raise RuntimeError(
+                                f"job rc={r['code']}: "
+                                f"{r['stderr'][-200:]}")
+                    barrier.wait()   # hold residency for the scrape
+                    c.detach(sid)
+            except Exception as e:  # noqa: BLE001
+                errs.append(f"submitter {idx}: {e}")
+
+        threads = [threading.Thread(target=submitter, args=(i,))
+                   for i in range(SESSIONS)]
+        for t in threads:
+            t.start()
+        barrier.wait()               # 4 sessions attached
+        # the LIVE scrape, taken while jobs run — the ranks are never
+        # stopped; then release the hold and join
+        with DvmClient(uri) as c:
+            live_metrics.append(c.metrics(events=64))
+        barrier.wait()
+        for t in threads:
+            t.join()
+        if errs:
+            raise RuntimeError("; ".join(errs[:3]))
+        with DvmClient(uri) as c:
+            live_metrics.append(c.metrics(events=64))
+
+        m = live_metrics[-1]
+        # attribution: exact for EVERY scoped counter
+        bad = []
+        for name, ent in m["scoped"].items():
+            tot = sum(int(v) for v in ent["bands"].values())
+            if tot != ent["global"]:
+                bad.append(f"{name}: global {ent['global']} != "
+                           f"sum(bands) {tot}")
+        session_jobs = {b: v
+                        for b, v in m["scoped"]["dvm_jobs"]["bands"]
+                        .items() if b != "0" and v}
+        out["attribution_ok"] = not bad
+        out["attribution_errors"] = bad[:5]
+        out["sessions_attributed"] = len(session_jobs)
+        out["jobs_by_session"] = session_jobs
+        out["pool_jobs"] = m["jobs"]
+        out["scraped_ranks_live"] = live_metrics[0]["scraped_ranks"]
+        out["percentiles"] = m["percentiles"]
+        out["events_recorded"] = m["events_recorded"]
+        out["prometheus_lines"] = len(
+            m.get("prometheus", "").splitlines())
+
+        # round-trip leg A: live through the attach --events tool
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc_live = show_events(uri, 32)
+        live_text = buf.getvalue()
+        live_ok = rc_live == 0 and "dvm_attach" in live_text
+
+        # halt persists the ring next to the uri file
+        with DvmClient(uri) as c:
+            c.halt()
+        srv.stop()
+        persisted = f"{uri}.events.json"
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc_post = show_events(uri, 32)
+        post_text = buf.getvalue()
+        post_ok = (rc_post == 0 and "dvm_halt" in post_text
+                   and persisted in post_text)
+
+        # round-trip leg B: the persisted ring merges in traceview
+        dumps = traceview.load_dumps([persisted])
+        doc = traceview.chrome_trace(dumps, [])
+        flight = [e for e in doc["traceEvents"]
+                  if e.get("cat") == "flight"]
+        out["events_roundtrip_ok"] = bool(live_ok and post_ok
+                                          and flight)
+        out["events_live_tool"] = live_ok
+        out["events_persisted_tool"] = post_ok
+        out["events_in_traceview_merge"] = len(flight)
+        out["flight_ring"] = {"recorded": dumps[0]["recorded"],
+                              "dropped": dumps[0]["dropped"],
+                              "capacity": dumps[0]["capacity"]}
+        assert obs.recorder().recorded >= out["events_recorded"]
+    finally:
+        try:
+            srv.stop()
+        except Exception:  # noqa: BLE001
+            pass
+        import shutil
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    return out
+
+
+def run_probe() -> Dict:
+    overhead = _measure_overhead()
+    serve = _serve_and_scrape()
+    within = bool(overhead["overhead_pct"] <= BUDGET_PCT
+                  and serve["attribution_ok"]
+                  and serve["events_roundtrip_ok"])
+    probe: Dict = {
+        "budget_pct": BUDGET_PCT,
+        "capacity": CAPACITY,
+        "sessions": SESSIONS,
+        "session_np": SESSION_NP,
+        "within_budget": within,
+    }
+    probe.update(overhead)
+    probe.update(serve)
+    return probe
+
+
+def persist(probe: Dict, detail_path: str) -> Dict:
+    """Merge under 'probe_obs' in BENCH_DETAIL.json, preserving every
+    other section (the probe_dispatch/trace_overhead pattern)."""
+    notes: Dict = {}
+    try:
+        with open(detail_path) as fh:
+            detail = json.load(fh)
+        if not isinstance(detail, dict):
+            detail = {}
+    except (OSError, ValueError):
+        detail = {}
+    detail["probe_obs"] = probe
+    try:
+        tmp = f"{detail_path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as fh:
+            json.dump(detail, fh, indent=1)
+        os.replace(tmp, detail_path)
+    except OSError as e:
+        notes["detail_error"] = str(e)[:120]
+    return notes

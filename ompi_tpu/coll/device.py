@@ -39,6 +39,7 @@ from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
+from ompi_tpu import obs as _obs
 from ompi_tpu import trace as _trace
 from ompi_tpu.coll.framework import CollComponent, CollModule, coll_framework
 from ompi_tpu.pml.monitoring import count_offload
@@ -600,7 +601,10 @@ class CompiledLRU:
         self._d: "OrderedDict[Tuple, Callable]" = OrderedDict()
         self._lock = threading.Lock()
         self.builds = 0
-        self.pv_hits = registry.register_pvar(
+        # session-banded (ompi_tpu/obs): a resident pool shares one
+        # compile cache, so per-tenant hit counts are the difference
+        # between "warm for me" and "warm because of my neighbor"
+        self.pv_hits = _obs.scoped_pvar(
             "coll", "device", "cache_hits",
             help="Compiled-collective cache hits")
         self.pv_misses = registry.register_pvar(
@@ -657,7 +661,7 @@ class CompiledLRU:
             fn = self._d.get(key)
             if fn is not None:
                 self._d.move_to_end(key)
-                self.pv_hits.add(1)
+                self.pv_hits.add(1, _obs.current_band())
                 return fn
         self.pv_misses.add(1)
         self.builds += 1

@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from ompi_tpu import obs as _obs
 from ompi_tpu import trace as _trace
 from ompi_tpu.mca.params import registry
 from ompi_tpu.op.op import Op
@@ -52,13 +53,17 @@ _max_ops_var = registry.register(
     help="Auto-flush a pending fusion batch at this many collectives "
          "(bounds result latency and fused-executable arity)")
 
-_pv_batches = registry.register_pvar(
+# session-banded (ompi_tpu/obs): on a resident pool each flush
+# belongs to exactly one session (the engine is per-comm, the comm's
+# state carries cid_band), so attribution is a band index away.
+# Global reads through the registry are untouched.
+_pv_batches = _obs.scoped_pvar(
     "coll", "device", "fused_batches",
     help="Fused device-collective batches dispatched")
-_pv_colls = registry.register_pvar(
+_pv_colls = _obs.scoped_pvar(
     "coll", "device", "fused_collectives",
     help="Individual collectives that rode in a fused batch")
-_pv_bytes = registry.register_pvar(
+_pv_bytes = _obs.scoped_pvar(
     "coll", "device", "fused_bytes",
     help="Payload bytes carried by fused batches")
 
@@ -489,9 +494,10 @@ class _FusionEngine:
         for p, out in zip(batch, outs):
             nbytes += p.nbytes
             p.req._deliver(out.reshape(()) if p.was_scalar else out)
-        _pv_batches.add(1)
-        _pv_colls.add(len(batch))
-        _pv_bytes.add(nbytes)
+        band = self.comm.state.cid_band
+        _pv_batches.add(1, band)
+        _pv_colls.add(len(batch), band)
+        _pv_bytes.add(nbytes, band)
 
     def _pack_groups(self, sig, batch):
         """Mesh-mode deposit payload: this rank's slots packed into one

@@ -66,6 +66,7 @@ from typing import Any, Deque, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ompi_tpu import obs as _obs
 from ompi_tpu.cr import _keep_var as _cr_keep_var
 from ompi_tpu.cr import buddy as _buddy
 from ompi_tpu.cr import shard as _shard
@@ -385,6 +386,8 @@ class Engine:
         if int(tot[0]):
             self.pending = None
             _pv_aborted.add(1)
+            _obs.record_event(_obs.EV_CKPT_ABORT, h.epoch,
+                              rank=comm.rank)
             h.file.close()  # collective; every rank is in this branch
             raise OSError(
                 errno.EIO,
@@ -437,6 +440,8 @@ class Engine:
         h.file.close()  # internal barrier: commit is global on return
         self.pending = None
         _pv_epochs.add(1)
+        _obs.record_event(_obs.EV_CKPT_COMMIT, h.epoch,
+                          rank=comm.rank)
         return h.epoch
 
     def _publish(self, comm, epoch: int) -> None:
@@ -478,6 +483,7 @@ class Engine:
         self.pending = None
         h.queue.clear()
         _pv_aborted.add(1)
+        _obs.record_event(_obs.EV_CKPT_ABORT, h.epoch)
         if h.file is not None:
             h.file.ft_abandon()
 
@@ -648,6 +654,8 @@ def _fs_restore(comm, root: str) -> Optional[Any]:
             # a shard somewhere in the epoch is torn or corrupt: never
             # restore a damaged epoch — fall back to the previous one
             _pv_crc_fb.add(1)
+            _obs.record_event(_obs.EV_CKPT_CRC_FALLBACK, epoch,
+                              rank=comm.rank)
             continue
         residue = data[r["off"]:r["off"] + r["nbytes"]].tobytes()
         metas = entry["shards"]

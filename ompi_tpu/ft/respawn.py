@@ -50,6 +50,7 @@ import time
 from typing import Any, Dict, List, Optional, Set
 
 from ompi_tpu import errhandler as _eh
+from ompi_tpu import obs as _obs
 from ompi_tpu import trace as _trace
 from ompi_tpu.ft import ulfm as _ulfm
 from ompi_tpu.mca.params import registry
@@ -338,6 +339,8 @@ def rejoin(comm, name: str = ""):
     _trace.instant_state(state, "respawn_rejoin", "ft",
                          epoch=epoch, cid=new.cid,
                          replaced=len(decided), us=dur_us)
+    _obs.record_event(_obs.EV_RESPAWN, epoch, len(decided), dur_us,
+                      rank=state.rank)
     _dbg(state, f"rejoined: cid {new.cid}, replaced {sorted(decided)}")
     return new
 

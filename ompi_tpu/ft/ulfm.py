@@ -49,6 +49,7 @@ import time
 from typing import Any, List, Optional, Set, Tuple
 
 from ompi_tpu import errhandler as _eh
+from ompi_tpu import obs as _obs
 from ompi_tpu import trace as _trace
 from ompi_tpu.mca.params import registry
 
@@ -165,6 +166,8 @@ class UlfmState:
                 rte.ulfm_failed = set(self.failed)
             _trace.instant_state(self.state, "ulfm_detect", "ft",
                                  failed=grank, epoch=self.epoch)
+            _obs.record_event(_obs.EV_ULFM_DETECT, grank, self.epoch,
+                              rank=self.state.rank)
         elif rec[0] == "revoke":
             key = (int(rec[1]), tuple(rec[2]))
             if key in self.revoked:
@@ -173,6 +176,8 @@ class UlfmState:
             _pv_revokes.add(1)
             _trace.instant_state(self.state, "ulfm_revoke", "ft",
                                  cid=key[0])
+            _obs.record_event(_obs.EV_ULFM_REVOKE, key[0],
+                              rank=self.state.rank)
         else:
             return 0
         self._sweep_pml()
@@ -385,6 +390,8 @@ def arm_rank_kill(state, after_s: float) -> None:
             return
         _trace.instant_state(state, "ft_inject", "ft",
                              cls="rank_kill", rank=state.rank)
+        _obs.record_event(_obs.EV_FT_INJECT, _obs.intern("rank_kill"),
+                          _obs.intern("rank"), rank=state.rank)
         # this incarnation can never finalize: let process-wide
         # last-rank accounting (coll.device) stop waiting for it
         state.ulfm_dead = True
@@ -513,6 +520,9 @@ def agree(comm, flag) -> bool:
             _trace.instant_state(comm.state, "ulfm_agree", "ft",
                                  cid=comm.cid, seq=seq,
                                  flag=bool(d["flag"]))
+            _obs.record_event(_obs.EV_ULFM_AGREE, comm.cid, seq,
+                              int(bool(d["flag"])),
+                              rank=comm.state.rank)
             return bool(d["flag"])
         u.poll()
         live = [r for r in range(comm.size)
@@ -701,4 +711,6 @@ def shrink(comm, name: str = ""):
     _trace.instant_state(comm.state, "ulfm_shrink", "ft",
                          cid=comm.cid, new_cid=new.cid,
                          survivors=len(survivors), us=dur_us)
+    _obs.record_event(_obs.EV_ULFM_SHRINK, comm.cid, new.cid,
+                      len(survivors), dur_us, rank=comm.state.rank)
     return new

@@ -143,11 +143,15 @@ class _Scoped:
                 # annotate the span timeline: a fault firing explains
                 # the latency spike around it (trace is a leaf module;
                 # import here keeps injection import-light when off)
+                from ompi_tpu import obs as _obs
                 from ompi_tpu import trace
                 tr = trace.current_tracer()
                 if tr is not None:
                     tr.instant("ft_inject", "fault", cls=cls,
                                scope=self.scope)
+                _obs.record_event(_obs.EV_FT_INJECT,
+                                  _obs.intern(cls),
+                                  _obs.intern(str(self.scope)))
                 return cls
         return None
 

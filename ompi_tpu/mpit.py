@@ -250,3 +250,21 @@ def category_get_info(index: int) -> Dict[str, Any]:
     return {"name": fw.name, "project": fw.project,
             "num_cvars": len(cvars), "cvar_indices": cvars,
             "num_pvars": len(pvars), "pvar_indices": pvars}
+
+
+# -- whole-registry snapshot (telemetry plane) ------------------------------
+
+def pvar_snapshot() -> Dict[str, Any]:
+    """Every pvar's current value keyed by full name, in registration
+    order.  A tool-facing convenience for the obs scrape path (the DVM
+    ``metrics`` RPC and the tpud OOB op): read-only against the
+    process-global registry, so — like MPI_T itself — it needs no
+    init_thread and never perturbs handle baselines.  Getter errors
+    surface as None rather than aborting the scrape."""
+    out: Dict[str, Any] = {}
+    for p in registry.pvars_in_registration_order():
+        try:
+            out[p.full_name] = p.read()
+        except Exception:
+            out[p.full_name] = None
+    return out

@@ -54,6 +54,10 @@ def mpi_init(state: ProcState, device=None) -> ProcState:
         pass  # non-main thread or unsupported platform
     from ompi_tpu.runtime import pstat as _pstat
     _pstat.register_pvars(state.rank)
+    # telemetry plane: percentile gauges + flight recorder (idempotent
+    # across looped worlds), and the scrape tick when enabled
+    from ompi_tpu import obs as _obs
+    _obs.attach(state)
     from ompi_tpu.runtime import topology as _topo
     _world = getattr(state.rte, "world", None)
     if _world is not None:
@@ -259,6 +263,11 @@ def mpi_finalize(state: ProcState) -> None:
     from ompi_tpu.coll import autotune as _autotune
     _autotune.detach(state)
     state.rte.finalize()
+    # stop the telemetry scrape tick for this world (the recorder and
+    # registered gauges are process-scoped and survive into the next
+    # looped world)
+    from ompi_tpu import obs as _obs_fin
+    _obs_fin.detach(state)
     # trace dump LAST: teardown spans (flush rendezvous, btl close)
     # are part of the timeline
     from ompi_tpu import trace as _trace

@@ -10,9 +10,17 @@ local rank dump ALL its thread stacks to its stderr (ranks install a
 SIGUSR1 faulthandler at init) — the "where is my hung 256-rank job
 stuck" workflow without a real debugger.
 
+``--events`` extends the workflow to the telemetry plane
+(docs/DESIGN.md §16): given a DVM uri file (or its neighboring
+``.proctable.json``), query the pool's flight recorder LIVE over the
+metrics RPC; when the pool is gone, fall back to the
+``<uri>.events.json`` ring it persisted at halt or on session
+failure — the durable record of what happened to a pool that no
+longer exists.
+
 Usage:
-    python -m ompi_tpu.tools.attach <session_dir|proctable.json>
-        [--stacks]
+    python -m ompi_tpu.tools.attach <session_dir|proctable.json|uri>
+        [--stacks] [--events [N]]
 """
 
 from __future__ import annotations
@@ -31,13 +39,76 @@ def load_proctable(path: str) -> list:
         return json.load(fh)
 
 
+def _resolve_uri(path: str) -> str:
+    """The DVM uri file for whatever the operator pointed at: the uri
+    file itself, or the ``<uri>.proctable.json`` the pool writes next
+    to it."""
+    suffix = ".proctable.json"
+    if path.endswith(suffix):
+        return path[:-len(suffix)]
+    return path
+
+
+def _format_event(ev: dict) -> str:
+    args = " ".join(f"{k}={v}" for k, v in ev.get("args", {}).items())
+    rank = ev.get("rank", -1)
+    who = f"r{rank}" if rank >= 0 else "pool"
+    return (f"{ev.get('ts', 0.0):.6f}  {who:>5}  "
+            f"{ev.get('name', '?'):<18} {args}")
+
+
+def show_events(target: str, last: int) -> int:
+    """Print the flight-recorder tail: live over the metrics RPC when
+    the pool answers, else from the persisted ring."""
+    uri = _resolve_uri(target)
+    events = None
+    source = None
+    if os.path.isfile(uri):
+        try:
+            from ompi_tpu.tools.dvm import DvmClient, DvmError
+            with DvmClient(uri, connect_timeout=3.0) as cli:
+                m = cli.metrics(events=last)
+            events = m.get("events", [])
+            source = "live"
+        except (DvmError, OSError, ValueError):
+            events = None
+    if events is None:
+        persisted = f"{uri}.events.json"
+        try:
+            with open(persisted) as fh:
+                dump = json.load(fh)
+            events = dump.get("events", [])
+            source = persisted
+        except (OSError, ValueError):
+            sys.stderr.write(
+                f"attach: no pool answering at {uri} and no "
+                f"persisted ring at {persisted}\n")
+            return 1
+    if last > 0:
+        events = events[-last:]
+    sys.stdout.write(f"flight recorder ({source}): "
+                     f"{len(events)} event(s)\n")
+    for ev in events:
+        sys.stdout.write(_format_event(ev) + "\n")
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="ompi_tpu-attach")
-    ap.add_argument("session", help="job session dir or proctable.json")
+    ap.add_argument("session", help="job session dir, proctable.json, "
+                                    "or DVM uri file")
     ap.add_argument("--stacks", action="store_true",
                     help="SIGUSR1 every local pid: each rank dumps "
                          "all thread stacks to its stderr")
+    ap.add_argument("--events", nargs="?", const=32, type=int,
+                    default=None, metavar="N",
+                    help="show the last N flight-recorder events "
+                         "(default 32): live from the pool's metrics "
+                         "RPC, or from the persisted <uri>.events.json "
+                         "after a halt/failure")
     opts = ap.parse_args(argv)
+    if opts.events is not None:
+        return show_events(opts.session, opts.events)
     try:
         table = load_proctable(opts.session)
     except (OSError, ValueError) as e:

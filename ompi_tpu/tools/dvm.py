@@ -68,6 +68,7 @@ import time
 import traceback
 from typing import Any, Dict, List, Optional
 
+from ompi_tpu import obs as _obs
 from ompi_tpu import trace
 from ompi_tpu.mca.params import registry
 
@@ -110,9 +111,20 @@ _pv_rejects = registry.register_pvar(
 _pv_attaches = registry.register_pvar(
     "dvm", "", "attaches",
     help="Sessions successfully attached (world brought up resident)")
-_pv_jobs = registry.register_pvar(
+# session-banded (ompi_tpu/obs): a pool serves many tenants; global
+# reads through the registry stay O(1), per-session values come from
+# the metrics RPC only
+_pv_jobs = _obs.scoped_pvar(
     "dvm", "", "jobs",
     help="Programs run to completion against resident sessions")
+_pv_job_wall_us = _obs.scoped_pvar(
+    "dvm", "", "job_wall_us",
+    help="Wall microseconds spent running programs (dispatch-to-exit, "
+         "summed; per-session via the metrics RPC)")
+_pv_queue_wait_us = _obs.scoped_pvar(
+    "dvm", "", "queue_wait_us",
+    help="Microseconds attaches spent parked in the admission queue "
+         "(summed; per-session via the metrics RPC)")
 _pv_attach_us_max = registry.register_pvar(
     "dvm", "", "attach_us_max", var_class="highwatermark",
     help="Slowest session attach (microseconds, queue wait included)")
@@ -526,6 +538,8 @@ class DVMServer:
                 jobs = self._drain()
             finally:
                 conn.busy -= 1
+            _obs.record_event(_obs.EV_DVM_HALT, len(self.sessions), jobs)
+            self._persist_events("halt")
             conn.reply({"ok": True, "jobs": jobs})
             sys.stderr.write(f"tpu-dvm: halt after {jobs} jobs\n")
             self._halted = True
@@ -627,8 +641,100 @@ class DVMServer:
             conn.reply({"code": code, "stdout": out, "stderr": err,
                         "wall_s": round(wall, 3)})
             return False
+        if op == "metrics":
+            conn.reply(self._metrics(
+                events=int(msg.get("events", 16)),
+                want_prom=msg.get("prometheus")))
+            return False
         conn.reply({"error": "bad op"})
         return False
+
+    # -- telemetry (ompi_tpu/obs; docs/DESIGN.md §16) ----------------------
+
+    def _metrics(self, events: int = 16,
+                 want_prom: Optional[bool] = None) -> dict:
+        """The live scrape: pvar registry snapshot, per-session
+        attribution, latency histograms aggregated across resident
+        ranks (read from each rank's scrape buffer — the ranks are
+        never stopped), derived percentiles, and the flight-recorder
+        tail.  Runs on the pool's accept thread; everything it reads
+        is either generation-stamped (scrape buffers), lock-free
+        append-only (pvar values), or snapshotted under the recorder
+        lock."""
+        from ompi_tpu import mpit
+        agg = [[0] * trace.N_BUCKETS for _ in trace.HIST_NAMES]
+        scraped = 0
+        sessions: Dict[str, dict] = {}
+        with self.lock:
+            items = list(self.sessions.items())
+            queue_depth = len(self._waiters)
+            active_ranks = self.active_ranks
+        for sid, sess in items:
+            row = {"np": sess.np, "dead": sess.dead}
+            for sp in _obs.scoped_items():
+                row[sp.full_name] = sp.read_band(sid)
+            sessions[str(sid)] = row
+            for st in sess.states:
+                sc = st.progress.obs
+                hists = sc.read_hists() if sc is not None else None
+                if hists is not None:
+                    scraped += 1
+                elif st.tracer is not None:
+                    # scrape tick off (or no refresh yet): fall back
+                    # to the tracer's own lists — integer reads, safe
+                    # against a concurrently-bumping rank
+                    hists = st.tracer.hists
+                if hists is not None:
+                    for w in range(len(trace.HIST_NAMES)):
+                        h = hists[w]
+                        row_a = agg[w]
+                        for b in range(trace.N_BUCKETS):
+                            row_a[b] += h[b]
+        # the pool's own serve_attach histogram (module-level: attach
+        # latency is a pool property, not any one rank's)
+        ah = agg[trace.HIST_SERVE_ATTACH]
+        for b in range(trace.N_BUCKETS):
+            ah[b] += _attach_hist[b]
+        hists_doc = {}
+        pcts = {}
+        for w, name in enumerate(trace.HIST_NAMES):
+            hists_doc[name] = agg[w]
+            pcts[name] = _obs.hist_percentiles(agg[w])
+        rec = _obs.recorder()
+        out = {
+            "ok": True,
+            "ts": time.time(),
+            "pid": os.getpid(),
+            "capacity": self.capacity,
+            "active_ranks": active_ranks,
+            "queue_depth": queue_depth,
+            "jobs": self._jobs,
+            "scraped_ranks": scraped,
+            "pvars": mpit.pvar_snapshot(),
+            "scoped": _obs.scoped_snapshot(),
+            "sessions": sessions,
+            "hists": hists_doc,
+            "percentiles": pcts,
+            "events": rec.snapshot(events),
+            "events_recorded": rec.recorded,
+            "events_dropped": rec.dropped,
+        }
+        prom = (_obs.prometheus_enabled() if want_prom is None
+                else bool(want_prom))
+        if prom:
+            out["prometheus"] = _obs.prometheus_text(out)
+        return out
+
+    def _persist_events(self, why: str) -> None:
+        """Flight-recorder durability: on halt and on session failure
+        the ring is written next to the uri file, so the record of
+        what happened survives the pool process.  Best-effort."""
+        if not self.uri_file:
+            return
+        path = f"{self.uri_file}.events.json"
+        if _obs.recorder().persist(path) is not None:
+            sys.stderr.write(f"tpu-dvm: flight recorder -> {path} "
+                             f"({why})\n")
 
     # -- admission ---------------------------------------------------------
 
@@ -697,6 +803,8 @@ class DVMServer:
                         victim.legacy_idle = False
                     elif not wait:
                         _pv_rejects.add(1)
+                        _obs.record_event(_obs.EV_ADMIT_REJECT, -1,
+                                          _obs.intern("busy"))
                         raise DvmBusy(
                             f"pool busy ({self.active_ranks}/"
                             f"{self.capacity} ranks, "
@@ -705,6 +813,8 @@ class DVMServer:
                     elif len(self._waiters) >= max(
                             0, _queue_max_var.value):
                         _pv_rejects.add(1)
+                        _obs.record_event(_obs.EV_QUEUE_FULL,
+                                          len(self._waiters))
                         raise DvmBusy(
                             f"admission queue full "
                             f"({len(self._waiters)} waiting, "
@@ -731,6 +841,8 @@ class DVMServer:
             if w.sess is None:
                 self._pump()  # sweep the abandoned entry, admit behind it
                 _pv_rejects.add(1)
+                _obs.record_event(_obs.EV_ADMIT_REJECT, -1,
+                                  _obs.intern("timeout"))
                 raise DvmBusy(
                     f"timed out after {timeout}s waiting for capacity")
             sess = w.sess
@@ -742,7 +854,9 @@ class DVMServer:
             raise
         attach_us = int((time.perf_counter() - t0) * 1e6)
         _pv_attaches.add(1)
+        _pv_queue_wait_us.add(queued_us, sess.sid)
         _pv_attach_us_max.update_max(attach_us)
+        _obs.record_event(_obs.EV_DVM_ATTACH, sess.sid, np_, attach_us)
         b = attach_us.bit_length()
         _attach_hist[b if b < trace.N_BUCKETS else trace.N_BUCKETS - 1] += 1
         tr = trace.global_tracer()
@@ -916,7 +1030,14 @@ class DVMServer:
                 sess.dead = True
         with self.lock:
             self._jobs += 1
-        _pv_jobs.add(1)
+        _pv_jobs.add(1, sess.sid)
+        _pv_job_wall_us.add(int(wall * 1e6), sess.sid)
+        _obs.record_event(_obs.EV_DVM_RUN, sess.sid, failure[0] or 0,
+                          int(wall * 1000))
+        if failure[0]:
+            # a dead session is exactly the moment the flight record
+            # must outlive the process that wrote it
+            self._persist_events(f"s{sess.sid} failed")
         tr = trace.global_tracer()
         if tr is not None:
             tr.instant("dvm_run", "serve", sid=sess.sid,
@@ -940,6 +1061,7 @@ class DVMServer:
                 raise DvmError(f"session s{sid} has a run in "
                                "progress; detach after it completes")
             sess.detaching = True
+        _obs.record_event(_obs.EV_DVM_DETACH, sid)
         self._destroy(sess)
         self._release(sess)
         self._write_proctable()
@@ -1157,6 +1279,17 @@ class DvmClient:
 
     def stats(self) -> dict:
         return self._rpc({"op": "stats"})
+
+    def metrics(self, events: int = 16,
+                prometheus: Optional[bool] = None) -> dict:
+        """Live telemetry scrape (docs/DESIGN.md §16): pvar snapshot,
+        per-session attribution, aggregated latency histograms with
+        p50/p90/p99, and the flight-recorder tail — without stopping
+        any resident rank."""
+        msg: Dict[str, Any] = {"op": "metrics", "events": int(events)}
+        if prometheus is not None:
+            msg["prometheus"] = bool(prometheus)
+        return self._rpc(msg)
 
     def close(self) -> None:
         try:

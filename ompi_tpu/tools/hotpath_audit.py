@@ -52,6 +52,14 @@ HOT_FUNCTIONS: Dict[str, Tuple[str, ...]] = {
     "ompi_tpu/runtime/progress.py": (
         "Progress.progress",
     ),
+    # the telemetry scrape tick rides the progress sweep's SAMPLED
+    # tracer-timing reads whenever obs_scrape_interval_ms > 0 on a
+    # traced rank (ISSUE 10): no clock read of its own, a round-robin
+    # single-histogram integer copy only when the interval elapses —
+    # and never an allocation either way
+    "ompi_tpu/obs/__init__.py": (
+        "Scraper.tick",
+    ),
     "ompi_tpu/cr/ckpt.py": (
         "Engine.tick",
     ),

@@ -276,6 +276,19 @@ def main(argv: Optional[List[str]] = None) -> int:
         elif op == "kill":
             kill_local()
             done.set()
+        elif op == "metrics":
+            # process-job equivalent of the DVM metrics RPC: the HNP
+            # (or an attach tool routed through it) asks this node for
+            # its live pvar/histogram/flight-recorder snapshot; the
+            # reply rides the OOB channel like iof/proc_exit
+            from ompi_tpu import obs as _obs
+            try:
+                m = _obs.local_metrics(
+                    events=int(msg.get("events", 16)))
+            except Exception as e:  # noqa: BLE001
+                m = {"error": str(e)[:200]}
+            report({"op": "metrics", "node": opts.node,
+                    "name": opts.name, "metrics": m})
         elif op == "exit":
             done.set()
 

@@ -166,8 +166,62 @@ def straggler_report(events: List[dict], top: int = 5) -> List[str]:
     return out
 
 
+def _hist_percentiles(hist: List[int]) -> Dict[str, float]:
+    """p50/p90/p99 (us) from a log2 latency histogram: bucket b holds
+    [2^(b-1), 2^b) us (hist_add's bit_length bucketing), and the
+    reported value is the bucket upper bound — the resolution the
+    gauge actually has.  Kept stdlib-local so traceview stays
+    runnable against dump files alone."""
+    total = sum(hist)
+    out: Dict[str, float] = {}
+    if not total:
+        return out
+    for tag, q in (("p50", 0.50), ("p90", 0.90), ("p99", 0.99)):
+        cum = 0
+        for b, c in enumerate(hist):
+            cum += c
+            if cum >= q * total:
+                out[tag] = float(1 << b)
+                break
+    return out
+
+
+def hist_gauge_summary(dumps: List[dict],
+                       metrics: Optional[dict] = None) -> List[str]:
+    """Latency percentiles from the HISTOGRAM GAUGES rather than raw
+    spans.  On always-sampled runs the adaptive sampler decimates the
+    ring (slowest-span tables see a fraction of each category), but
+    every operation lands in the histograms exactly once — so these
+    lines stay truthful when the span tables cannot.  A metrics
+    snapshot (the DVM ``metrics`` RPC reply, already aggregated
+    across resident ranks) takes precedence; otherwise the per-rank
+    dump histograms are summed."""
+    agg: Dict[str, List[int]] = {}
+    if metrics and metrics.get("hists"):
+        for name, h in metrics["hists"].items():
+            agg[name] = list(h)
+    else:
+        for d in dumps:
+            for name, h in (d.get("hists") or {}).items():
+                cur = agg.setdefault(name, [0] * len(h))
+                for b, c in enumerate(h):
+                    cur[b] += c
+    lines = []
+    for name in sorted(agg):
+        p = _hist_percentiles(agg[name])
+        if not p:
+            continue
+        lines.append(f"  {name:<16} p50 {p['p50']:>9.0f} us  "
+                     f"p90 {p['p90']:>9.0f} us  "
+                     f"p99 {p['p99']:>9.0f} us  "
+                     f"(n={sum(agg[name])})")
+    if not lines:
+        return ["  (no histogram gauges in dumps or snapshot)"]
+    return lines
+
+
 def summary(dumps: List[dict], offsets_us: List[float],
-            top: int = 5) -> str:
+            top: int = 5, metrics: Optional[dict] = None) -> str:
     events = corrected_events(dumps, offsets_us)
     lines = []
     total = sum(d.get("recorded", 0) for d in dumps)
@@ -204,6 +258,9 @@ def summary(dumps: List[dict], offsets_us: List[float],
                            if k in ("cid", "seq", "mid", "nbytes"))
             lines.append(f"  r{e['rank']:<3} {e['name']:<20} "
                          f"{e.get('dur', 0.0):10.1f} us  {key}")
+    lines.append("latency percentiles (histogram gauges"
+                 + (", metrics snapshot" if metrics else "") + "):")
+    lines.extend(hist_gauge_summary(dumps, metrics))
     lines.append("straggler ranks (latest to arrive at correlated "
                  "collectives):")
     lines.extend(straggler_report(events, top))
@@ -224,10 +281,20 @@ def main(argv=None) -> int:
                     help="write Chrome trace-event JSON here")
     ap.add_argument("--top", type=int, default=5,
                     help="rows per summary section")
+    ap.add_argument("--metrics", default=None,
+                    help="a metrics-RPC snapshot JSON (DvmClient."
+                         "metrics() reply): its aggregated histogram "
+                         "gauges feed the percentile summary, so "
+                         "summaries work on decimated/always-sampled "
+                         "dumps")
     opts = ap.parse_args(argv)
 
     dumps = load_dumps(opts.dumps)
     offsets = load_offsets(opts.sync)
+    metrics = None
+    if opts.metrics:
+        with open(opts.metrics) as fh:
+            metrics = json.load(fh)
     if opts.out:
         doc = chrome_trace(dumps, offsets)
         with open(opts.out, "w") as fh:
@@ -235,7 +302,8 @@ def main(argv=None) -> int:
         sys.stderr.write(
             f"wrote {len(doc['traceEvents'])} trace events to "
             f"{opts.out}\n")
-    sys.stdout.write(summary(dumps, offsets, top=opts.top) + "\n")
+    sys.stdout.write(summary(dumps, offsets, top=opts.top,
+                             metrics=metrics) + "\n")
     return 0
 
 

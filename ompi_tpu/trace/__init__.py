@@ -123,6 +123,16 @@ HIST_NAMES = ("progress_tick", "coll_dispatch", "p2p_complete",
               "coll_segment", "serve_attach")
 
 
+def bucket_upper_us(b: int) -> float:
+    """Upper bound in microseconds of log2 bucket ``b`` under
+    hist_add's bit_length bucketing (bucket b holds [2^(b-1), 2^b)
+    us; the overflow bucket reports its lower bound doubled).  The
+    telemetry plane (ompi_tpu/obs) derives p50/p90/p99 gauges from
+    the histograms, so the bucket→value mapping lives here with the
+    bucketing itself rather than drifting in a consumer."""
+    return float(1 << b)
+
+
 # -- intern tables ----------------------------------------------------------
 # Category and span-name strings live HERE, once per process; the ring
 # stores small integer ids.  The tables are append-only (ids never
