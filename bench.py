@@ -180,6 +180,17 @@ def main() -> None:
                          "BENCH_DETAIL.json, and FAIL (exit 1) if a "
                          "warm attach is not at least 10x faster "
                          "than the cold launch")
+    ap.add_argument("--probe-fleet", action="store_true",
+                    help="Measure the overload-robust serving control "
+                         "plane: high-priority p99 under 2x overload "
+                         "vs unloaded (preemption + deadline "
+                         "shedding), checkpoint-resume byte-identity "
+                         "of a preempted run, and live pool resize "
+                         "under traffic with zero failed jobs and "
+                         "exact per-band pvar sums; persist under "
+                         "'probe_fleet' in BENCH_DETAIL.json, and "
+                         "FAIL (exit 1) if any of the three "
+                         "invariants breaks")
     ap.add_argument("--probe-obs", action="store_true",
                     help="Measure the telemetry plane: scrape-tick "
                          "overhead on the progress sweep (interleaved "
@@ -405,6 +416,45 @@ def main() -> None:
             sys.exit(1)
         return
 
+    if opts.probe_fleet:
+        from benchmarks.probe_fleet import persist, run_probe
+
+        probe = run_probe()
+        notes = persist(probe, detail_path)
+        ov, pr, rz = (probe["overload"], probe["preempt_resume"],
+                      probe["resize"])
+        line = {
+            "metric": f"dvm fleet control plane, "
+                      f"{ov['low_submitters']}x np{ov['low_np']} "
+                      f"overload vs np{ov['hi_np']} priority burst + "
+                      f"preempt-resume + live resize",
+            "value": ov["hi_p99_vs_unloaded"],
+            "unit": "hi_p99_vs_unloaded_ratio",
+            "hi_p99_ms": ov["hi_p99_ms"],
+            "unloaded_p99_ms": ov["unloaded_p99_ms"],
+            "preemptions": ov["preemptions"],
+            "sheds": ov["sheds"],
+            "low_jobs_done": ov["low_jobs_done"],
+            "low_jobs_shed": ov["low_jobs_shed"],
+            "resume_ok": pr["resume_ok"],
+            "resumed_at_step": pr["resumed_at_step"],
+            "resize_ok": rz["resize_ok"],
+            "band_sums_exact": rz["band_sums_exact"],
+            "within_budget": probe["within_budget"],
+        }
+        line.update({k: v for k, v in notes.items() if "error" in k})
+        sys.stderr.write(json.dumps(probe, indent=1) + "\n")
+        print(json.dumps(line))
+        if not probe["within_budget"]:
+            sys.stderr.write(
+                f"FAIL: fleet probe — priority_ok="
+                f"{ov['priority_ok']} (p99 ratio "
+                f"{ov['hi_p99_vs_unloaded']}x vs "
+                f"{ov['priority_factor']}x budget), resume_ok="
+                f"{pr['resume_ok']}, resize_ok={rz['resize_ok']}\n")
+            sys.exit(1)
+        return
+
     if opts.probe_obs:
         from benchmarks.probe_obs import persist, run_probe
 
@@ -555,7 +605,8 @@ def main() -> None:
                           for k in ("probe_dispatch", "trace_overhead",
                                     "probe_recovery", "probe_respawn",
                                     "probe_pipeline", "probe_ckpt",
-                                    "probe_serve", "probe_obs")
+                                    "probe_serve", "probe_obs",
+                                    "probe_fleet")
                           if isinstance(prior, dict) and k in prior},
                        "device_us": dev, "software_us": sw,
                        "software_tuned_tcp_us": sw_tcp,

@@ -1,0 +1,411 @@
+"""--probe-fleet microbench: the overload-robust serving control
+plane (ISSUE 12), proven against a live in-process pool:
+
+1. **Priority under 2x overload.**  Four low-priority preemptible
+   submitters offer twice the pool's rank capacity in attach/run/
+   detach cycles; a high-priority client preempts its way to the
+   whole pool and pumps a burst of runs through it.  The claim:
+   high-priority p99 stays within PRIORITY_FACTOR (2x) of the
+   unloaded baseline p99, while the dvm_preemptions / dvm_sheds
+   pvars show the low tier actually paid for it — and every
+   low-priority job still completes or sheds, none fail.
+
+2. **Preemption resumes from checkpoint, byte-identical.**  A
+   checkpointing victim is preempted mid-run by a high-priority
+   attach; its single (slower) run must return rc 0 with the same
+   digest as an unpreempted baseline, and its STEPS line must show
+   a nonzero resume point.
+
+3. **Live resize under traffic.**  Grow 4->8, shrink 8->4 while
+   submitters stream jobs: zero failed jobs, both pool epochs
+   recorded, and every ScopedPvar holds global == sum(bands)
+   (attribution exactness across resize epochs).
+
+Results land in BENCH_DETAIL.json under ``probe_fleet``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List
+
+CAPACITY = 4             # pool rank capacity, parts 1 and 3
+LOW_SUBMITTERS = 4       # 4 x np2 = 2x the pool's capacity offered
+LOW_NP = 2
+LOW_CYCLES = 5           # attach/run/detach cycles per low submitter
+HI_NP = 4                # the high tier claims the whole pool
+HI_RUNS = 10
+BASELINE_RUNS = 10
+PRIORITY_FACTOR = 2.0    # hi p99 under overload vs unloaded p99
+CKPT_STEPS = 10
+CKPT_SLEEP_S = 0.2
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PROG = os.path.join(REPO, "tests", "_dvm_prog.py")
+CKPT_PROG = os.path.join(REPO, "tests", "_fleet_ckpt_prog.py")
+
+
+def _pct(sorted_vals: List[float], p: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1,
+            max(0, int(round(p / 100.0 * (len(sorted_vals) - 1)))))
+    return sorted_vals[i]
+
+
+def _pv(name: str) -> int:
+    from ompi_tpu.mca.params import registry
+    return int(registry._pvars[name].read())
+
+
+def _digest_line(stdout: str, kind: str, tag: str) -> str:
+    for line in stdout.splitlines():
+        parts = line.split()
+        if len(parts) == 3 and parts[0] == kind and parts[1] == tag:
+            return parts[2]
+    raise RuntimeError(f"no {kind} {tag} line in session stdout")
+
+
+def _new_pool(tmpdir: str, capacity: int):
+    import jax
+
+    from ompi_tpu.tools.dvm import DVMServer
+    uri = os.path.join(tmpdir, f"dvm-{capacity}-{time.time_ns()}.uri")
+    srv = DVMServer(capacity, devices=jax.devices(), uri_file=uri)
+    srv.start()
+    return srv, uri
+
+
+# -- part 1: priority under 2x overload -------------------------------------
+
+
+def _probe_overload(tmpdir: str) -> Dict:
+    from ompi_tpu.tools.dvm import DvmBusy, DvmClient, DvmDeadline
+
+    srv, uri = _new_pool(tmpdir, CAPACITY)
+    try:
+        # unloaded baseline: one resident high-style session, alone
+        base_s: List[float] = []
+        c = DvmClient(uri)
+        sid = c.attach(HI_NP)["sid"]
+        for i in range(BASELINE_RUNS + 1):
+            t0 = time.perf_counter()
+            r = c.run(sid, PROG, timeout=120)
+            if r["code"] != 0:
+                raise RuntimeError(f"baseline rc={r['code']}: "
+                                   f"{r['stderr'][-200:]}")
+            if i > 0:  # rep 0 warms the pool
+                base_s.append(time.perf_counter() - t0)
+        c.detach(sid)
+        c.close()
+        base_s.sort()
+        base_p99 = _pct(base_s, 99.0)
+        base_med_ms = _pct(base_s, 50.0) * 1e3
+
+        p0, s0 = _pv("dvm_preemptions"), _pv("dvm_sheds")
+        lock = threading.Lock()
+        low_done: List[float] = []
+        low_shed = [0]
+        errs: List[str] = []
+
+        low_deadline_ms = max(50, int(base_med_ms * 20))
+
+        def low_submitter(idx: int) -> None:
+            # one-shot overload traffic: paced attach/run/detach
+            # cycles with a finite deadline — under deep backlog the
+            # widened shed margin rejects infeasible cycles up front
+            try:
+                for _ in range(LOW_CYCLES):
+                    with DvmClient(uri) as cli:
+                        try:
+                            lsid = cli.attach(
+                                LOW_NP, timeout=180,
+                                preemptible=True)["sid"]
+                        except DvmBusy:
+                            continue  # overloaded; that IS the point
+                        t0 = time.perf_counter()
+                        try:
+                            lr = cli.run(
+                                lsid, PROG, timeout=180,
+                                deadline_ms=low_deadline_ms)
+                            if lr["code"] != 0:
+                                raise RuntimeError(
+                                    f"low job rc={lr['code']}: "
+                                    f"{lr['stderr'][-200:]}")
+                            with lock:
+                                low_done.append(
+                                    time.perf_counter() - t0)
+                        except DvmDeadline:
+                            with lock:
+                                low_shed[0] += 1
+                        cli.detach(lsid)
+                    time.sleep(0.05)
+            except Exception as e:  # noqa: BLE001
+                with lock:
+                    errs.append(f"low {idx}: {e}")
+
+        # one long-running preemptible tenant holds ranks through the
+        # high-priority attach — the preemption victim, by construction
+        victim_res: Dict = {}
+
+        def long_victim() -> None:
+            try:
+                with DvmClient(uri) as cli:
+                    vsid = cli.attach(LOW_NP, timeout=180,
+                                      preemptible=True)["sid"]
+                    store = os.path.join(tmpdir, "overload_vic")
+                    vr = cli.run(vsid, CKPT_PROG,
+                                 ["ov", store, "24", "0.15"],
+                                 timeout=300)
+                    if vr["code"] != 0:
+                        raise RuntimeError(
+                            f"victim rc={vr['code']}: "
+                            f"{vr['stderr'][-200:]}")
+                    victim_res.update(vr)
+                    cli.detach(vsid)
+                with lock:
+                    low_done.append(0.0)
+            except Exception as e:  # noqa: BLE001
+                with lock:
+                    errs.append(f"victim: {e}")
+
+        threads = [threading.Thread(target=low_submitter, args=(i,))
+                   for i in range(LOW_SUBMITTERS)]
+        threads.append(threading.Thread(target=long_victim))
+        for t in threads:
+            t.start()
+        time.sleep(0.5)  # the low tier saturates the pool first
+
+        hi_s: List[float] = []
+        hc = DvmClient(uri)
+        hsid = hc.attach(HI_NP, timeout=180, priority=9)["sid"]
+        for i in range(HI_RUNS + 1):
+            t0 = time.perf_counter()
+            r = hc.run(hsid, PROG, timeout=120)
+            if r["code"] != 0:
+                raise RuntimeError(f"hi rc={r['code']}: "
+                                   f"{r['stderr'][-200:]}")
+            if i > 0:  # rep 0 is session bring-up warm-up, both sides
+                hi_s.append(time.perf_counter() - t0)
+        hc.detach(hsid)
+        hc.close()
+        for t in threads:
+            t.join(timeout=300)
+        # deterministic shed evidence: with the estimator warm, a
+        # 1 ms deadline is infeasible by construction
+        with DvmClient(uri) as cli:
+            lsid = cli.attach(LOW_NP, timeout=60)["sid"]
+            try:
+                cli.run(lsid, PROG, timeout=60, deadline_ms=1)
+                raise RuntimeError("1 ms deadline was not shed")
+            except DvmDeadline:
+                low_shed[0] += 1
+            cli.detach(lsid)
+        if errs:
+            raise RuntimeError("; ".join(errs[:3]))
+        hi_s.sort()
+        hi_p99 = _pct(hi_s, 99.0)
+        ratio = hi_p99 / base_p99 if base_p99 > 0 else 0.0
+        return {
+            "capacity": CAPACITY,
+            "low_submitters": LOW_SUBMITTERS,
+            "low_np": LOW_NP,
+            "hi_np": HI_NP,
+            "unloaded_p50_ms": round(base_med_ms, 3),
+            "unloaded_p99_ms": round(base_p99 * 1e3, 3),
+            "hi_runs": len(hi_s),
+            "hi_p50_ms": round(_pct(hi_s, 50.0) * 1e3, 3),
+            "hi_p99_ms": round(hi_p99 * 1e3, 3),
+            "hi_p99_vs_unloaded": round(ratio, 2),
+            "low_jobs_done": len(low_done),
+            "low_jobs_shed": low_shed[0],
+            "victim_preempted": victim_res.get("preempted", 0),
+            "preemptions": _pv("dvm_preemptions") - p0,
+            "sheds": _pv("dvm_sheds") - s0,
+            "priority_factor": PRIORITY_FACTOR,
+            "priority_ok": bool(
+                ratio <= PRIORITY_FACTOR
+                and _pv("dvm_preemptions") - p0 >= 1
+                and _pv("dvm_sheds") - s0 >= 1),
+        }
+    finally:
+        srv.stop()
+
+
+# -- part 2: preempt -> checkpoint resume, byte-identical -------------------
+
+
+def _probe_preempt_resume(tmpdir: str) -> Dict:
+    from ompi_tpu.tools.dvm import DvmClient
+
+    srv, uri = _new_pool(tmpdir, 2)
+    try:
+        store_a = os.path.join(tmpdir, "store_base")
+        cb = DvmClient(uri)
+        sb = cb.attach(2)["sid"]
+        rb = cb.run(sb, CKPT_PROG,
+                    ["base", store_a, str(CKPT_STEPS)], timeout=240)
+        if rb["code"] != 0:
+            raise RuntimeError(f"ckpt baseline rc={rb['code']}: "
+                               f"{rb['stderr'][-200:]}")
+        base_dig = _digest_line(rb["stdout"], "DIGEST", "base")
+        cb.detach(sb)
+        cb.close()
+
+        store_v = os.path.join(tmpdir, "store_vic")
+        cv = DvmClient(uri)
+        sv = cv.attach(2, preemptible=True)["sid"]
+        res: Dict = {}
+
+        def victim() -> None:
+            res["r"] = cv.run(
+                sv, CKPT_PROG,
+                ["vic", store_v, str(CKPT_STEPS), str(CKPT_SLEEP_S)],
+                timeout=240)
+
+        t0 = time.perf_counter()
+        th = threading.Thread(target=victim)
+        th.start()
+        time.sleep(1.0)  # a few steps checkpointed by now
+        hi = DvmClient(uri)
+        rh = hi.attach(2, priority=9, timeout=120)
+        rr = hi.run(rh["sid"], PROG, timeout=120)
+        hi.detach(rh["sid"])
+        hi.close()
+        th.join(timeout=240)
+        wall = time.perf_counter() - t0
+        r = res["r"]
+        resumed_at = int(_digest_line(r["stdout"], "STEPS", "vic"))
+        dig = _digest_line(r["stdout"], "DIGEST", "vic")
+        ok = (r["code"] == 0 and rr["code"] == 0
+              and r.get("preempted", 0) >= 1
+              and resumed_at > 0 and dig == base_dig)
+        return {
+            "steps": CKPT_STEPS,
+            "victim_rc": r["code"],
+            "victim_preempted": r.get("preempted", 0),
+            "resumed_at_step": resumed_at,
+            "digest_matches_baseline": bool(dig == base_dig),
+            "victim_wall_s": round(wall, 3),
+            "resume_ok": bool(ok),
+        }
+    finally:
+        srv.stop()
+
+
+# -- part 3: live resize under traffic --------------------------------------
+
+
+def _probe_resize(tmpdir: str) -> Dict:
+    from ompi_tpu import obs as _obs
+    from ompi_tpu.tools.dvm import DvmClient
+
+    srv, uri = _new_pool(tmpdir, CAPACITY)
+    try:
+        z0 = _pv("dvm_resizes")
+        lock = threading.Lock()
+        done = [0]
+        errs: List[str] = []
+
+        def worker(idx: int, nruns: int) -> None:
+            try:
+                with DvmClient(uri) as c:
+                    sid = c.attach(2, timeout=180)["sid"]
+                    for _ in range(nruns):
+                        r = c.run(sid, PROG, timeout=120)
+                        if r["code"] != 0:
+                            raise RuntimeError(
+                                f"rc={r['code']}: "
+                                f"{r['stderr'][-200:]}")
+                        with lock:
+                            done[0] += 1
+                    c.detach(sid)
+            except Exception as e:  # noqa: BLE001
+                with lock:
+                    errs.append(f"worker {idx}: {e}")
+
+        threads = [threading.Thread(target=worker, args=(i, 4))
+                   for i in range(2)]
+        for t in threads:
+            t.start()
+        time.sleep(0.3)
+        admin = DvmClient(uri)
+        admin.resize(CAPACITY * 2)
+        extra = threading.Thread(target=worker, args=(2, 3))
+        extra.start()  # rides the grown headroom
+        threads.append(extra)
+        time.sleep(0.3)
+        admin.resize(CAPACITY)
+        for t in threads:
+            t.join(timeout=300)
+        st = admin.stats()
+        admin.close()
+        exact = []
+        for sp in _obs.scoped_items():
+            g, s = sp.pvar.read(), sum(sp.bands)
+            if g != s:
+                exact.append(f"{sp.pvar.full_name}: {g} != {s}")
+        ok = (not errs and done[0] == 11
+              and st["capacity"] == CAPACITY and st["epoch"] == 2
+              and not exact)
+        return {
+            "capacity": CAPACITY,
+            "grow_to": CAPACITY * 2,
+            "jobs_done": done[0],
+            "jobs_failed": len(errs),
+            "failures": errs[:3],
+            "resizes": _pv("dvm_resizes") - z0,
+            "final_capacity": st["capacity"],
+            "pool_epoch": st["epoch"],
+            "band_sum_violations": exact[:5],
+            "band_sums_exact": bool(not exact),
+            "resize_ok": bool(ok),
+        }
+    finally:
+        srv.stop()
+
+
+def run_probe() -> Dict:
+    import tempfile
+
+    tmpdir = tempfile.mkdtemp(prefix="probe_fleet_")
+    try:
+        overload = _probe_overload(tmpdir)
+        resume = _probe_preempt_resume(tmpdir)
+        resize = _probe_resize(tmpdir)
+    finally:
+        import shutil
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    return {
+        "overload": overload,
+        "preempt_resume": resume,
+        "resize": resize,
+        "within_budget": bool(overload["priority_ok"]
+                              and resume["resume_ok"]
+                              and resize["resize_ok"]),
+    }
+
+
+def persist(probe: Dict, detail_path: str) -> Dict:
+    """Merge under 'probe_fleet' in BENCH_DETAIL.json, preserving
+    every other section (the probe_dispatch/trace_overhead pattern)."""
+    notes: Dict = {}
+    try:
+        with open(detail_path) as fh:
+            detail = json.load(fh)
+        if not isinstance(detail, dict):
+            detail = {}
+    except (OSError, ValueError):
+        detail = {}
+    detail["probe_fleet"] = probe
+    try:
+        tmp = f"{detail_path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as fh:
+            json.dump(detail, fh, indent=1)
+        os.replace(tmp, detail_path)
+    except OSError as e:
+        notes["detail_error"] = str(e)[:120]
+    return notes

@@ -599,6 +599,10 @@ class CompiledLRU:
 
     def __init__(self) -> None:
         self._d: "OrderedDict[Tuple, Callable]" = OrderedDict()
+        # who compiled each entry (ompi_tpu/obs cid band): the serving
+        # control plane enforces a per-session cache share, and a
+        # preempted/destroyed session's executables are dropped by band
+        self._bands: Dict[Tuple, int] = {}
         self._lock = threading.Lock()
         self.builds = 0
         # session-banded (ompi_tpu/obs): a resident pool shares one
@@ -615,6 +619,10 @@ class CompiledLRU:
             "coll", "device", "cache_evictions",
             help="Compiled-collective LRU evictions "
                  "(coll_device_cache_max bound enforced)")
+        self.pv_band_evictions = registry.register_pvar(
+            "coll", "device", "cache_band_evictions",
+            help="Own-band LRU evictions forced by the per-session "
+                 "cache share quota (dvm_quota_cache_share_pct)")
         registry.register_pvar(
             "coll", "device", "cache_size", var_class="level",
             getter=lambda: len(self._d),
@@ -626,6 +634,30 @@ class CompiledLRU:
     def clear(self) -> None:
         with self._lock:
             self._d.clear()
+            self._bands.clear()
+
+    def count_band(self, band: int) -> int:
+        """Entries currently attributed to `band` (compile-time
+        current_band of the inserting thread)."""
+        with self._lock:
+            n = 0
+            for b in self._bands.values():
+                if b == band:
+                    n += 1
+            return n
+
+    def drop_band(self, band: int) -> int:
+        """Drop every executable compiled under session band `band`.
+        The DVM calls this when a session is destroyed or preempted:
+        its cid band may be reused by a later tenant, and share
+        accounting must not charge the newcomer for a ghost's
+        compiles.  Returns how many entries were dropped."""
+        with self._lock:
+            stale = [k for k, b in self._bands.items() if b == band]
+            for k in stale:
+                self._d.pop(k, None)
+                del self._bands[k]
+            return len(stale)
 
     def drop_mesh(self, dev_key: Tuple) -> int:
         """Drop every executable compiled against `dev_key` (a tuple
@@ -638,6 +670,7 @@ class CompiledLRU:
             stale = [k for k in self._d if dev_key in k]
             for k in stale:
                 del self._d[k]
+                self._bands.pop(k, None)
             return len(stale)
 
     def drop_device(self, dev_id: int) -> int:
@@ -654,6 +687,7 @@ class CompiledLRU:
                             for p in k)]
             for k in stale:
                 del self._d[k]
+                self._bands.pop(k, None)
             return len(stale)
 
     def get(self, key: Tuple, builder: Callable[[], Callable]) -> Callable:
@@ -673,17 +707,47 @@ class CompiledLRU:
             fn = builder()
             tr.end(t0, _trace.NAME_XLA_COMPILE, _trace.CAT_COMPILE,
                    _trace.intern_name(str(key[0])))
+        band = _obs.current_band()
         with self._lock:
             self._d[key] = fn
             self._d.move_to_end(key)
+            self._bands[key] = band
             cap = max(1, _cache_max_var.value)
+            # per-session cache share (serving control plane): a tenant
+            # over its share evicts ITS OWN oldest entries, never a
+            # neighbor's — churn degrades the offender, not the pool.
+            # Band 0 is unbanded (no session) and exempt.
+            share = registry.get("dvm_quota_cache_share_pct", 0)
+            if band and share and 0 < share < 100:
+                band_cap = max(1, cap * share // 100)
+                mine = [k for k in self._d if self._bands.get(k) == band]
+                if len(mine) > band_cap:
+                    for k in mine[:len(mine) - band_cap]:
+                        self._d.pop(k, None)
+                        del self._bands[k]
+                        self.pv_band_evictions.add(1)
             while len(self._d) > cap:
-                self._d.popitem(last=False)
+                k, _ = self._d.popitem(last=False)
+                self._bands.pop(k, None)
                 self.pv_evictions.add(1)
         return fn
 
 
 compile_cache = CompiledLRU()
+
+
+# serving-plane HBM quota hook (ompi_tpu/serve/quota): lazy-bound so
+# coll never imports the serve package unless a pool armed a quota —
+# and a plain mpirun world pays one None check per deposit, nothing
+# else.  serve.quota.install() points this at the real charge
+# function.
+_hbm_charge_hook: Optional[Callable[[int], None]] = None
+
+
+def _charge_hbm(nbytes: int) -> None:
+    hook = _hbm_charge_hook
+    if hook is not None:
+        hook(nbytes)
 
 
 def _mesh_collective(kind: str, mesh, shape, dtype, extra=None) -> Callable:
@@ -792,9 +856,13 @@ def _assemble(mesh, shards: List):
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     devs = list(mesh.devices.reshape(-1))
-    placed = [s if getattr(s, "device", None) == devs[i]
-              else jax.device_put(s, devs[i])
-              for i, s in enumerate(shards)]
+    placed = []
+    for i, s in enumerate(shards):
+        if getattr(s, "device", None) == devs[i]:
+            placed.append(s)
+        else:
+            _charge_hbm(int(getattr(s, "nbytes", 0)))
+            placed.append(jax.device_put(s, devs[i]))
     n = placed[0].shape[0]
     global_shape = (n * len(placed),) + tuple(placed[0].shape[1:])
     sharding = NamedSharding(mesh, P("r"))
@@ -1066,7 +1134,9 @@ class HbmCollModule(CollModule):
         if _is_jax_array(x):
             return x
         import jax
-        return jax.device_put(np.asarray(x), comm.state.device)
+        arr = np.asarray(x)
+        _charge_hbm(arr.nbytes)
+        return jax.device_put(arr, comm.state.device)
 
     def _stacked(self, kind: str, opname: str, nshards: int, shape, dtype,
                  extra=None) -> Callable:

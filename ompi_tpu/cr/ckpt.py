@@ -553,6 +553,18 @@ def checkpoint(comm, payload: Any, store_dir: Optional[str] = None,
     return _buddy.checkpoint(comm, payload), fs_epoch
 
 
+def committed_epochs(store_dir: Optional[str] = None) -> List[int]:
+    """Committed filesystem epochs (manifest present), newest first.
+    Local, non-collective: the DVM preemption path and tests use it
+    to ask "would a restore here find durable state?" without
+    touching any communicator — a preempted session's world is
+    already torn down when the question matters."""
+    root = _root(store_dir)
+    if not root:
+        return []
+    return _committed_epochs(root)
+
+
 def flush(comm) -> int:
     """Collective: commit the in-flight epoch now (tests, clean
     shutdown before a planned stop).  Returns the epoch, -1 if none

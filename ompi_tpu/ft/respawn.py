@@ -81,6 +81,21 @@ def joining(state) -> bool:
     return bool(getattr(state, "respawn_joining", False))
 
 
+def epoch_cid_floor(cid_band: int, epoch: int) -> int:
+    """The cid-space floor of ``(session band, recovery epoch)`` —
+    the one banding formula both consumers of the epoch machinery
+    share.  Rejoin mints its post-recovery communicator cids here;
+    the DVM pool-resize path pre-sets ``state.respawn_epoch`` so
+    sessions admitted after a resize spawn their derived comms into
+    the next epoch band (docs/DESIGN.md §17), and their floor must
+    agree with what a later in-session rejoin would compute."""
+    from ompi_tpu.comm.communicator import (EPOCH_CID_STRIDE,
+                                            MAX_RESPAWN_EPOCHS,
+                                            SESSION_CID_STRIDE)
+    return (cid_band * SESSION_CID_STRIDE
+            + (epoch % MAX_RESPAWN_EPOCHS) * EPOCH_CID_STRIDE)
+
+
 def _dbg(state, msg: str) -> None:
     if os.environ.get("FT_DEBUG"):
         import sys
@@ -155,8 +170,7 @@ def rejoin(comm, name: str = ""):
     call this after catching ``ERR_PROC_FAILED``; a replacement rank
     (``respawn.joining(state)``) calls it right after init."""
     from ompi_tpu.comm.communicator import (
-        EPOCH_CID_STRIDE, MAX_RESPAWN_EPOCHS, SESSION_CID_STRIDE,
-        Communicator, Group)
+        EPOCH_CID_STRIDE, MAX_RESPAWN_EPOCHS, Communicator, Group)
 
     state = comm.state
     u = _ulfm._require(comm)
@@ -228,8 +242,7 @@ def rejoin(comm, name: str = ""):
                         # session band first: a recovery inside a
                         # DVM-resident session must stay inside that
                         # session's cid range (band 0 for plain jobs)
-                        "cid": state.cid_band * SESSION_CID_STRIDE
-                        + epoch * EPOCH_CID_STRIDE
+                        "cid": epoch_cid_floor(state.cid_band, epoch)
                         + store.next_cid() % EPOCH_CID_STRIDE})
                     continue
             if time.monotonic() > deadline:

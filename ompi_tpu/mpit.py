@@ -254,15 +254,20 @@ def category_get_info(index: int) -> Dict[str, Any]:
 
 # -- whole-registry snapshot (telemetry plane) ------------------------------
 
-def pvar_snapshot() -> Dict[str, Any]:
+def pvar_snapshot(prefix: Optional[str] = None) -> Dict[str, Any]:
     """Every pvar's current value keyed by full name, in registration
     order.  A tool-facing convenience for the obs scrape path (the DVM
     ``metrics`` RPC and the tpud OOB op): read-only against the
     process-global registry, so — like MPI_T itself — it needs no
     init_thread and never perturbs handle baselines.  Getter errors
-    surface as None rather than aborting the scrape."""
+    surface as None rather than aborting the scrape.  ``prefix``
+    filters by full-name prefix (e.g. ``"dvm_"`` or ``"ctrl_"``) so a
+    fleet scraper polling one subsystem does not pay for — or ship —
+    the whole registry every tick."""
     out: Dict[str, Any] = {}
     for p in registry.pvars_in_registration_order():
+        if prefix is not None and not p.full_name.startswith(prefix):
+            continue
         try:
             out[p.full_name] = p.read()
         except Exception:

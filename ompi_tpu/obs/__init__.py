@@ -182,12 +182,18 @@ EV_DVM_ATTACH = 11
 EV_DVM_DETACH = 12
 EV_DVM_HALT = 13
 EV_DVM_RUN = 14
+EV_DVM_PREEMPT = 15
+EV_DVM_SHED = 16
+EV_DVM_RESIZE = 17
+EV_DVM_QUOTA = 18
+EV_CTRL_ADJUST = 19
 
 EVENT_NAMES = (
     "ulfm_detect", "ulfm_revoke", "ulfm_agree", "ulfm_shrink",
     "respawn_rejoin", "ckpt_commit", "ckpt_abort", "ckpt_crc_fallback",
     "dvm_reject", "dvm_queue_full", "ft_inject", "dvm_attach",
-    "dvm_detach", "dvm_halt", "dvm_run",
+    "dvm_detach", "dvm_halt", "dvm_run", "dvm_preempt", "dvm_shed",
+    "dvm_resize", "dvm_quota", "ctrl_adjust",
 )
 
 # Per-type argument field names (positional a0..a3); a trailing "$"
@@ -209,6 +215,11 @@ EVENT_FIELDS = (
     ("sid",),                                # dvm_detach
     ("sessions", "jobs"),                    # dvm_halt
     ("sid", "code", "wall_ms"),              # dvm_run
+    ("sid", "by_sid", "prio", "us"),         # dvm_preempt
+    ("sid", "deadline_ms", "est_ms"),        # dvm_shed
+    ("old", "new", "epoch"),                 # dvm_resize
+    ("sid", "kind$", "val"),                 # dvm_quota
+    ("margin_pct", "qdepth", "p99_us"),      # ctrl_adjust
 )
 
 # interned strings for event args (reason/cls/scope): the ring holds
@@ -606,11 +617,15 @@ def detach(state) -> None:
 
 # -- local metrics + Prometheus exposition ----------------------------------
 
-def local_metrics(events: int = 16, tracer=None) -> Dict[str, Any]:
+def local_metrics(events: int = 16, tracer=None,
+                  prefix: Optional[str] = None) -> Dict[str, Any]:
     """Process-local metrics document: the full pvar registry, the
     latency histograms + derived percentiles, scoped-counter
     attribution, and the flight-recorder tail.  Used by the tpud
-    ``metrics`` OOB op and as the building block of the DVM RPC."""
+    ``metrics`` OOB op and as the building block of the DVM RPC.
+    ``prefix`` narrows the pvar snapshot to one subsystem (a fleet
+    scraper polling ``dvm_``/``ctrl_`` state does not ship the whole
+    registry per node per tick)."""
     from ompi_tpu import mpit
     if tracer is None:
         tracer = _trace.current_tracer()
@@ -622,7 +637,7 @@ def local_metrics(events: int = 16, tracer=None) -> Dict[str, Any]:
             pcts[name] = hist_percentiles(h)
     return {
         "ts": time.time(),
-        "pvars": mpit.pvar_snapshot(),
+        "pvars": mpit.pvar_snapshot(prefix),
         "hists": hists,
         "percentiles": pcts,
         "scoped": scoped_snapshot(),

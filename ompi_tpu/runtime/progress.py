@@ -110,6 +110,12 @@ class Progress:
         # on the tracer's SAMPLED sweeps with the already-read
         # timestamp, so scrape-on adds no clock reads per sweep.
         self.obs = None
+        # fleet controller (ompi_tpu/serve): set by the DVM pool on
+        # resident session ranks; ticks on the same sampled sweeps as
+        # the scraper (one extra is-None check), so control decisions
+        # react at traffic speed while jobs run — the hb loop covers
+        # the idle pool, where no rank-thread sweeps.
+        self.ctrl = None
 
     def deferred_interrupts(self):
         """Context manager: hold any armed ft interrupt until exit.
@@ -316,6 +322,9 @@ class Progress:
                 obs = self.obs
                 if obs is not None:
                     obs.tick(_t0)
+                ctrl = self.ctrl
+                if ctrl is not None:
+                    ctrl.tick(_t0)
             tr.tick_ns(time.perf_counter_ns() - _t0)
         return events
 

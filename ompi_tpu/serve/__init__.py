@@ -1,0 +1,27 @@
+"""Serving control plane for the resident DVM pool.
+
+The multiplexed pool (tools/dvm.py) gives many sessions one resident
+set of rank-threads; this package is what keeps that pool *healthy
+under overload* rather than merely multiplexed:
+
+- ``quota``       — per-session HBM and compile-cache budgets,
+                    attributed through the obs cid bands and enforced
+                    at deposit/compile time (degrade first, typed
+                    reject second — a greedy tenant never poisons the
+                    pool).
+- ``controller``  — FleetController, the closed loop: an audit-clean
+                    ``tick()`` riding the same sampled progress sweeps
+                    as obs.Scraper reads queue depth and utilization
+                    and decides pool resizes and shed margins; the
+                    pool's heartbeat loop applies the decisions off
+                    the hot path.
+
+Admission policy itself (priorities, preemption, deadline shedding)
+lives in tools/dvm.py next to the queue it governs; this package
+holds the parts that must be importable from the collective layer
+(quota charging) or auditable in isolation (the controller tick).
+"""
+
+from ompi_tpu.serve.controller import FleetController  # noqa: F401
+from ompi_tpu.serve.quota import (QuotaExceeded, begin_run,  # noqa: F401
+                                  charge_hbm, install)

@@ -1,0 +1,131 @@
+"""Per-session resource quotas for the resident serving pool.
+
+Attribution rides the obs cid bands (ompi_tpu/obs ScopedPvar): every
+rank-thread of session N runs with ``state.cid_band == N``, so
+``current_band()`` inside a deposit or compile IS the tenant identity
+— no per-callsite plumbing.
+
+Two budgets, both off by default (0 = unlimited):
+
+- ``dvm_quota_hbm_bytes``        — host→device deposit bytes per run.
+- ``dvm_quota_cache_share_pct``  — share of the CompiledLRU one
+                                   session may hold (enforced inside
+                                   coll/device.py at insert time).
+
+Enforcement is degrade-then-reject, per the overload-robustness
+contract: the FIRST breach of the HBM budget evicts the offender's
+own compiled-cache band (reclaiming its executables' footprint and
+forcing IT to recompile, not its neighbors); a continued breach
+raises :class:`QuotaExceeded`, which fails that one run through the
+session-confined abort path — the pool and every other tenant keep
+going.
+
+The charge tap is installed into coll/device lazily (``install()``):
+a plain mpirun world never imports this module and pays one None
+check per deposit.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ompi_tpu import obs as _obs
+from ompi_tpu.mca.params import registry
+
+MAX_BANDS = _obs.MAX_BANDS
+
+_hbm_var = registry.register(
+    "dvm", "quota", "hbm_bytes", 0,
+    help="Per-run HBM deposit budget per session, bytes (0 = "
+         "unlimited).  First breach evicts the session's own "
+         "compiled-cache entries; continued breach raises "
+         "QuotaExceeded, failing only that session's run.")
+_share_var = registry.register(
+    "dvm", "quota", "cache_share_pct", 0,
+    help="Max share of the compiled-collective cache one session may "
+         "hold, percent (0 = unlimited).  Over-share evicts the "
+         "session's own oldest entries at insert time.")
+
+# deposited bytes per band — the gauge operators watch to see WHO is
+# filling HBM, and the counter the budget is checked against
+pv_hbm = _obs.scoped_pvar(
+    "serve", "quota", "hbm_bytes",
+    help="Host-to-device deposit bytes attributed per session band")
+pv_rejects = _obs.scoped_pvar(
+    "dvm", "quota", "rejects",
+    help="Runs failed by QuotaExceeded (HBM budget breached after "
+         "own-cache degradation), per session band")
+
+
+class QuotaExceeded(RuntimeError):
+    """Typed per-session budget breach.  Raised from the deposit path
+    after degradation already ran; the session abort machinery
+    confines it to the offending run."""
+
+    def __init__(self, band: int, kind: str, used: int, budget: int):
+        super().__init__(
+            "session band %d over %s quota: %d > %d bytes"
+            % (band, kind, used, budget))
+        self.band = band
+        self.kind = kind
+        self.used = used
+        self.budget = budget
+
+
+_lock = threading.Lock()
+# per-band bytes charged since begin_run; parallel degraded flag
+# (first breach evicted own cache already)
+_charged = [0] * MAX_BANDS
+_degraded = [0] * MAX_BANDS
+_installed = False
+
+
+def install() -> None:
+    """Point coll/device's deposit tap at charge_hbm.  Idempotent;
+    the DVM pool calls this once at startup."""
+    global _installed
+    from ompi_tpu.coll import device as _device
+    _device._hbm_charge_hook = charge_hbm
+    _installed = True
+
+
+def begin_run(band: int) -> None:
+    """Zero the band's budget window — quotas are per *run*, so a
+    well-behaved session is never haunted by its previous job."""
+    if not 0 <= band < MAX_BANDS:
+        return
+    with _lock:
+        _charged[band] = 0
+        _degraded[band] = 0
+
+
+def charge_hbm(nbytes: int) -> None:
+    """Account a host→device deposit to the calling thread's session
+    band, then enforce the budget: degrade on first breach, raise
+    :class:`QuotaExceeded` on the next."""
+    band = _obs.current_band()
+    pv_hbm.add(nbytes, band)
+    if band == 0:
+        return
+    budget = _hbm_var.value
+    if not budget or budget <= 0:
+        return
+    with _lock:
+        _charged[band] += nbytes
+        used = _charged[band]
+        if used <= budget:
+            return
+        first = not _degraded[band]
+        _degraded[band] = 1
+    if first:
+        # degrade: reclaim the offender's own compiled executables
+        # (their HBM residency and cache share), not anyone else's
+        from ompi_tpu.coll import device as _device
+        _device.compile_cache.drop_band(band)
+        _obs.record_event(_obs.EV_DVM_QUOTA, band,
+                          _obs.intern("hbm_degrade"), used)
+        return
+    pv_rejects.add(1, band)
+    _obs.record_event(_obs.EV_DVM_QUOTA, band,
+                      _obs.intern("hbm_reject"), used)
+    raise QuotaExceeded(band, "hbm", used, budget)

@@ -91,6 +91,21 @@ _drain_var = registry.register(
     "dvm", "", "drain_timeout_s", 30.0, float,
     help="Halt waits this long for in-flight runs to finish before "
          "force-detaching their sessions")
+_queue_timeout_var = registry.register(
+    "dvm", "", "queue_timeout_s", 0.0, float,
+    help="Server-side deadline for queued attaches that gave no "
+         "timeout of their own: past it the waiter gets a friendly "
+         "DvmBusy (retry later) instead of parking forever "
+         "(0 = park until capacity or client timeout)")
+_ctrl_var = registry.register(
+    "dvm", "", "ctrl", 0, int,
+    help="Enable the FleetController closed loop (serve/controller): "
+         "queue-depth-driven pool resizes and adaptive deadline-shed "
+         "margins")
+_ctrl_max_var = registry.register(
+    "dvm", "", "ctrl_max_ranks", 0, int,
+    help="Capacity ceiling the FleetController may grow the pool to "
+         "(0 = 4x the starting capacity)")
 
 _pv_active = registry.register_pvar(
     "dvm", "", "sessions_active", var_class="level",
@@ -111,6 +126,19 @@ _pv_rejects = registry.register_pvar(
 _pv_attaches = registry.register_pvar(
     "dvm", "", "attaches",
     help="Sessions successfully attached (world brought up resident)")
+_pv_preempts = registry.register_pvar(
+    "dvm", "", "preemptions",
+    help="Sessions preempted by a higher-priority attach (parked and "
+         "transparently resumed — never a failed job)")
+_pv_sheds = registry.register_pvar(
+    "dvm", "", "sheds",
+    help="Runs shed at admission: the wall-time estimator said the "
+         "deadline was infeasible (fast typed reject, no pool time "
+         "spent)")
+_pv_resizes = registry.register_pvar(
+    "dvm", "", "resizes",
+    help="Live pool capacity changes applied (grow or shrink), each "
+         "opening a new pool epoch")
 # session-banded (ompi_tpu/obs): a pool serves many tenants; global
 # reads through the registry stay O(1), per-session values come from
 # the metrics RPC only
@@ -146,6 +174,14 @@ class DvmBusy(DvmError):
     """Admission backpressure: the pool rejected the attach."""
 
     busy = True
+
+
+class DvmDeadline(DvmError):
+    """Deadline shed: the pool's wall-time estimator says this run
+    cannot finish inside the client's deadline, so it was rejected at
+    admission — fast and typed, before any rank-thread was spent."""
+
+    shed = True
 
 
 def _send(sock: socket.socket, obj: dict) -> None:
@@ -328,12 +364,33 @@ class _Session:
         # session sits resident between submits, claimable by the
         # next same-np submit and evictable under capacity pressure
         self.legacy_idle = False
+        # serving control plane (ISSUE 12): admission priority, and
+        # whether a higher-priority attach may preempt this session.
+        # A preempted session is PARKED — world torn down, ranks
+        # released, sid/ns/jobid kept — and transparently re-admitted
+        # and resumed (its program restores from checkpoint), never
+        # failed.
+        self.priority = 0
+        self.preemptible = False
+        self.parked = False
+        self.preempt_requested = False
+        self.preempt_count = 0
+        self.epoch = 0  # pool epoch at (re)admission — cid-bands
+        #                 derived comms per resize epoch (ft/respawn)
 
 
 class _Waiter:
-    def __init__(self, np_: int, conn) -> None:
+    def __init__(self, np_: int, conn, priority: int = 0,
+                 preemptible: bool = False,
+                 resume: Optional[_Session] = None) -> None:
         self.np = np_
         self.conn = conn
+        self.priority = priority
+        self.preemptible = preemptible
+        # re-admission of a parked (preempted) session: _pump hands
+        # back THIS session object — same sid/ns — instead of minting
+        # a new one
+        self.resume = resume
         self.event = threading.Event()
         self.sess: Optional[_Session] = None
         self.error: Optional[str] = None
@@ -374,6 +431,10 @@ class DVMServer:
         self._sid_counter = itertools.count(1)
         self._conns: set = set()
         self._jobs = 0
+        # serving control plane (ISSUE 12)
+        self.pool_epoch = 0      # bumped per live resize
+        self.est_wall_us = 0     # EWMA of run wall time (shed input)
+        self.ctrl: Any = None    # FleetController when dvm_ctrl=1
         self._draining = False
         self._halted = False
         self._started = False
@@ -401,6 +462,16 @@ class DVMServer:
                 f.write(f"127.0.0.1:{self.port}\n")
             os.replace(tmp, self.uri_file)  # submitters never see a torn file
         _ensure_stdio()
+        # arm the serving-plane quota tap (per-band HBM attribution is
+        # useful telemetry even with no budget set; budgets only bite
+        # when the dvm_quota_* knobs are nonzero)
+        from ompi_tpu.serve import quota as _squota
+        _squota.install()
+        if _ctrl_var.value:
+            from ompi_tpu.serve.controller import FleetController
+            ceil = _ctrl_max_var.value or self.capacity * 4
+            self.ctrl = FleetController(self, floor=self.capacity,
+                                        ceil=ceil)
         self._write_proctable()
         try:
             # debugger attach support: SIGUSR1 dumps EVERY pool thread
@@ -477,12 +548,37 @@ class DVMServer:
             time.sleep(max(0.2, _hb_var.value))
             with self.lock:
                 conns = list(self._conns)
+            swept = False
             for c in conns:
                 if c.busy > 0 and not c.dead:
                     try:
                         c.reply({"event": "hb"})
                     except OSError:
                         c.dead = True
+                if c.dead:
+                    swept = True
+            if swept:
+                # a dead client's queued attach must not hold its
+                # place in line: wake the waiter (its thread marks
+                # itself abandoned / fails the reply) and re-pump so
+                # the session parked BEHIND it is admitted now, not
+                # at the next capacity change
+                with self.lock:
+                    for w in self._waiters:
+                        if (w.conn.dead and not w.abandoned
+                                and w.sess is None and w.error is None):
+                            w.abandoned = True
+                            w.error = "client connection lost"
+                            w.event.set()
+                self._pump()
+            ctrl = self.ctrl
+            if ctrl is not None:
+                # idle-pool coverage: rank-threads only tick the
+                # controller DURING runs; the heartbeat keeps the
+                # loop deciding (and applies its decisions, which
+                # must stay off the rank hot path) while none run
+                ctrl.tick(time.perf_counter_ns())
+                ctrl.apply()
 
     def _client(self, conn: _Conn) -> None:
         owned: List[int] = []
@@ -499,7 +595,8 @@ class DVMServer:
                         break  # halt
                 except DvmError as e:
                     try:
-                        conn.reply({"error": str(e), "busy": e.busy})
+                        conn.reply({"error": str(e), "busy": e.busy,
+                                    "shed": getattr(e, "shed", False)})
                     except OSError:
                         break
                 except OSError:
@@ -554,7 +651,19 @@ class DVMServer:
                 conn.reply({"ok": True, "sessions": len(self.sessions),
                             "active_ranks": self.active_ranks,
                             "queued": len(self._waiters),
-                            "jobs": self._jobs})
+                            "jobs": self._jobs,
+                            "capacity": self.capacity,
+                            "epoch": self.pool_epoch})
+            return False
+        if op == "resize":
+            new_cap = int(msg.get("np", 0))
+            conn.busy += 1
+            try:
+                old, epoch = self.resize(new_cap)
+            finally:
+                conn.busy -= 1
+            conn.reply({"ok": True, "capacity": new_cap, "was": old,
+                        "epoch": epoch})
             return False
         if op == "attach":
             np_ = int(msg.get("np", self.capacity))
@@ -563,7 +672,9 @@ class DVMServer:
             try:
                 sess, attach_us, queued_us = self._attach(
                     np_, conn, wait=bool(msg.get("wait", True)),
-                    timeout=float(timeout) if timeout else None)
+                    timeout=float(timeout) if timeout else None,
+                    priority=int(msg.get("priority", 0)),
+                    preemptible=bool(msg.get("preemptible", False)))
             finally:
                 conn.busy -= 1
             owned.append(sess.sid)
@@ -576,6 +687,9 @@ class DVMServer:
                 raise DvmError(f"unknown session s{sid} (not attached "
                                "on this connection)")
             sess = self._session_for(sid)
+            deadline_ms = msg.get("deadline_ms")
+            if deadline_ms:
+                self._shed_check(sess, int(deadline_ms))
             conn.busy += 1
             try:
                 code, out, err, wall = self._run(
@@ -583,7 +697,8 @@ class DVMServer:
             finally:
                 conn.busy -= 1
             conn.reply({"code": code, "stdout": out, "stderr": err,
-                        "wall_s": round(wall, 3)})
+                        "wall_s": round(wall, 3),
+                        "preempted": sess.preempt_count})
             return False
         if op == "detach":
             sid = int(msg.get("sid", -1))
@@ -709,6 +824,13 @@ class DVMServer:
             "active_ranks": active_ranks,
             "queue_depth": queue_depth,
             "jobs": self._jobs,
+            "epoch": self.pool_epoch,
+            "est_wall_us": self.est_wall_us,
+            "ctrl": None if self.ctrl is None else {
+                "ticks": self.ctrl.ticks,
+                "shed_margin_pct": self.ctrl.shed_margin_pct,
+                "want_capacity": self.ctrl.want_capacity,
+            },
             "scraped_ranks": scraped,
             "pvars": mpit.pvar_snapshot(),
             "scoped": _obs.scoped_snapshot(),
@@ -738,12 +860,20 @@ class DVMServer:
 
     # -- admission ---------------------------------------------------------
 
-    def _can_admit_locked(self, np_: int) -> bool:
-        return (self.active_ranks + np_ <= self.capacity
-                and len(self.sessions) < max(1, _session_max_var.value))
+    def _can_admit_locked(self, np_: int, resume: bool = False) -> bool:
+        if self.active_ranks + np_ > self.capacity:
+            return False
+        # a parked session being re-admitted is already counted in
+        # the session table; only rank capacity gates it
+        return (resume
+                or len(self.sessions) < max(1, _session_max_var.value))
 
-    def _admit_locked(self, np_: int, conn) -> _Session:
+    def _admit_locked(self, np_: int, conn, priority: int = 0,
+                      preemptible: bool = False) -> _Session:
         sess = _Session(next(self._sid_counter), np_, conn)
+        sess.priority = priority
+        sess.preemptible = preemptible
+        sess.epoch = self.pool_epoch
         self.sessions[sess.sid] = sess
         self.active_ranks += np_
         _pv_active.add(1)
@@ -751,14 +881,28 @@ class DVMServer:
         self._set_xsession_hint(len(self.sessions))
         return sess
 
+    def _enqueue_waiter_locked(self, w: _Waiter) -> None:
+        """Priority insertion, FIFO within a priority level: the queue
+        stays a deque whose head is always the best-admissible claim,
+        so _pump's head-of-line discipline is unchanged."""
+        idx = len(self._waiters)
+        for j, ex in enumerate(self._waiters):
+            if ex.priority < w.priority:
+                idx = j
+                break
+        self._waiters.insert(idx, w)
+        _pv_qdepth.add(1)
+        _pv_qpeak.update_max(len(self._waiters))
+
     def _set_xsession_hint(self, n: int) -> None:
         from ompi_tpu.coll import fusion
         fusion.set_xsession_hint(n)
 
     def _pump(self) -> None:
-        """Admit queued waiters in FIFO order.  Head-of-line blocking
-        is deliberate: a big-np attach at the front must not starve
-        behind a stream of small ones slipping past it."""
+        """Admit queued waiters in priority order (FIFO within a
+        level).  Head-of-line blocking is deliberate: a big-np attach
+        at the front must not starve behind a stream of small ones
+        slipping past it."""
         with self.lock:
             while self._waiters:
                 w = self._waiters[0]
@@ -772,21 +916,33 @@ class DVMServer:
                     w.error = "pool is halting"
                     w.event.set()
                     continue
-                if not self._can_admit_locked(w.np):
+                if not self._can_admit_locked(
+                        w.np, resume=w.resume is not None):
                     break
                 self._waiters.popleft()
                 _pv_qdepth.add(-1)
-                w.sess = self._admit_locked(w.np, w.conn)
+                if w.resume is not None:
+                    sess = w.resume
+                    self.active_ranks += w.np
+                    sess.parked = False
+                    sess.epoch = self.pool_epoch
+                    w.sess = sess
+                else:
+                    w.sess = self._admit_locked(w.np, w.conn,
+                                                w.priority,
+                                                w.preemptible)
                 w.event.set()
 
     def _attach(self, np_: int, conn, wait: bool = True,
-                timeout: Optional[float] = None):
+                timeout: Optional[float] = None, priority: int = 0,
+                preemptible: bool = False):
         t0 = time.perf_counter()
         if np_ < 1 or np_ > self.capacity:
             raise DvmError(
                 f"np {np_} exceeds DVM capacity {self.capacity}")
         w: Optional[_Waiter] = None
         sess: Optional[_Session] = None
+        pvictim: Optional[_Session] = None
         queued_us = 0
         while True:
             victim: Optional[_Session] = None
@@ -794,7 +950,8 @@ class DVMServer:
                 if self._draining:
                     raise DvmError("pool is halting")
                 if self._can_admit_locked(np_):
-                    sess = self._admit_locked(np_, conn)
+                    sess = self._admit_locked(np_, conn, priority,
+                                              preemptible)
                 else:
                     victim = next(
                         (s for s in self.sessions.values()
@@ -820,10 +977,17 @@ class DVMServer:
                             f"({len(self._waiters)} waiting, "
                             f"dvm_queue_max={_queue_max_var.value})")
                     else:
-                        w = _Waiter(np_, conn)
-                        self._waiters.append(w)
-                        _pv_qdepth.add(1)
-                        _pv_qpeak.update_max(len(self._waiters))
+                        # overload and we must park.  A priority
+                        # attach first claims a lower-priority
+                        # preemptible victim (marked under this lock;
+                        # preempted outside it) — its release pumps
+                        # our queue entry, which priority-sorts ahead
+                        # of lower-priority waiters either way.
+                        if priority > 0:
+                            pvictim = self._pick_preempt_locked(
+                                priority)
+                        w = _Waiter(np_, conn, priority, preemptible)
+                        self._enqueue_waiter_locked(w)
             if victim is None:
                 break
             # a parked one-shot warm session is the lowest-priority
@@ -831,8 +995,13 @@ class DVMServer:
             # re-try admission
             self._detach(victim.sid)
         if w is not None:
+            if pvictim is not None:
+                self._preempt(pvictim, priority)
+            qt = _queue_timeout_var.value
+            eff = timeout if timeout is not None else (
+                qt if qt and qt > 0 else None)
             qt0 = time.perf_counter()
-            w.event.wait(timeout=timeout)
+            w.event.wait(timeout=eff)
             with self.lock:
                 if w.sess is None and w.error is None:
                     w.abandoned = True
@@ -843,6 +1012,11 @@ class DVMServer:
                 _pv_rejects.add(1)
                 _obs.record_event(_obs.EV_ADMIT_REJECT, -1,
                                   _obs.intern("timeout"))
+                if timeout is None:
+                    raise DvmBusy(
+                        f"pool still saturated after queueing "
+                        f"{eff:.1f}s (dvm_queue_timeout_s) — "
+                        "try again later")
                 raise DvmBusy(
                     f"timed out after {timeout}s waiting for capacity")
             sess = w.sess
@@ -870,10 +1044,195 @@ class DVMServer:
     def _release(self, sess: _Session) -> None:
         with self.lock:
             if self.sessions.pop(sess.sid, None) is not None:
-                self.active_ranks -= sess.np
+                if not sess.parked:  # a parked session's ranks were
+                    # already returned when it was preempted
+                    self.active_ranks -= sess.np
                 _pv_active.add(-1)
                 self._set_xsession_hint(len(self.sessions))
         self._pump()
+
+    # -- preemption / shedding / live resize (ISSUE 12) --------------------
+
+    def _pick_preempt_locked(self, priority: int) -> Optional[_Session]:
+        """Lowest-priority preemptible victim (oldest sid breaks
+        ties), marked preempt_requested under the caller's lock so two
+        racing priority attaches never claim the same ranks twice."""
+        best: Optional[_Session] = None
+        for s in self.sessions.values():
+            if (not s.preemptible or s.priority >= priority
+                    or s.detaching or s.dead or s.parked
+                    or s.preempt_requested):
+                continue
+            if best is None or (s.priority, s.sid) < (best.priority,
+                                                      best.sid):
+                best = s
+        if best is not None:
+            best.preempt_requested = True
+        return best
+
+    def _poison_session(self, sess: _Session, code: int,
+                        why: str) -> None:
+        """Session-confined abort from outside the session's own
+        rank-threads: poison its world and KV namespace so every
+        blocking fence/rendezvous of THIS session unwinds — the same
+        machinery SessionRTE.abort uses, never os._exit."""
+        from ompi_tpu.runtime.kvstore import KVClient
+        w = sess.world
+        if w is not None:
+            if w.aborted is None:
+                w.aborted = (-1, code, why)
+            for st in sess.states:
+                if st is not None and getattr(st, "progress",
+                                              None) is not None:
+                    st.progress.wakeup()
+        try:
+            kvc = KVClient(self.kv_server.addr, ns=sess.ns)
+            kvc.abort(-1, code, why)
+            kvc.close()
+        except OSError:
+            pass
+
+    def _preempt(self, victim: _Session, by_priority: int) -> None:
+        """Evict `victim` for a higher-priority attach.  Running: its
+        world is poisoned and its own _run thread parks and resumes it
+        (restoring from checkpoint) — the victim's client sees a
+        slower run, never a failed one.  Idle: parked here directly;
+        its next run re-admits and re-brings-up transparently."""
+        _pv_preempts.add(1)
+        _obs.record_event(_obs.EV_DVM_PREEMPT, victim.sid, by_priority,
+                          victim.priority)
+        tr = trace.global_tracer()
+        if tr is not None:
+            tr.instant("dvm_preempt", "serve", sid=victim.sid,
+                       prio=victim.priority, by=by_priority)
+        with victim.lock:
+            if victim.running:
+                self._poison_session(victim, 75,
+                                     "preempted by higher-priority "
+                                     "attach")
+                return
+            if victim.parked or victim.dead:
+                return
+            # idle path: the park is consumed HERE, not by a _run
+            # thread — clear the request so the next run doesn't
+            # re-park a session that was already preempted
+            victim.preempt_requested = False
+            victim.parked = True
+        self._park(victim)
+
+    def _park(self, sess: _Session) -> None:
+        """Tear down a parked session's world and return its ranks.
+        The session object (sid, ns, jobid, priority) stays in the
+        table; _unpark re-admits and re-brings it up."""
+        sess.preempt_count += 1
+        self._destroy(sess)
+        sess.world = None
+        sess.states = []
+        with self.lock:
+            self.active_ranks -= sess.np
+        self._write_proctable()
+        self._pump()
+
+    def _unpark(self, sess: _Session) -> None:
+        """Wait for re-admission of a parked session, then bring its
+        world back up (fresh rank-threads, same sid/cid-band/KV ns).
+        Runs on the owning connection's dispatch thread — the client
+        keeps getting heartbeats while we wait."""
+        w = _Waiter(sess.np, sess.conn, sess.priority,
+                    sess.preemptible, resume=sess)
+        with self.lock:
+            if self._draining:
+                raise DvmError("pool is halting")
+            self._enqueue_waiter_locked(w)
+        self._pump()
+        qt = _queue_timeout_var.value
+        w.event.wait(timeout=max(60.0, qt * 4) if qt else None)
+        with self.lock:
+            if w.sess is None and w.error is None:
+                w.abandoned = True
+        if w.error is not None:
+            raise DvmError(w.error)
+        if w.sess is None:
+            self._pump()
+            raise DvmError(f"preempted session s{sess.sid} could not "
+                           "be re-admitted (pool saturated)")
+        self._bringup(sess)
+        self._write_proctable()
+
+    def _shed_check(self, sess: _Session, deadline_ms: int) -> None:
+        """Deadline admission: against the pool's EWMA run-wall
+        estimator widened by the controller's shed margin — infeasible
+        work is rejected here in microseconds instead of burning
+        rank-time and missing its deadline anyway."""
+        est = self.est_wall_us
+        if est <= 0:
+            return  # no completed run yet: nothing to estimate from
+        ctrl = self.ctrl
+        if ctrl is not None:
+            margin = ctrl.shed_margin_pct
+        else:
+            margin = 100 + 25 * len(self._waiters)
+            if margin > 400:
+                margin = 400
+        if est * margin // 100 <= deadline_ms * 1000:
+            return
+        _pv_sheds.add(1)
+        _obs.record_event(_obs.EV_DVM_SHED, sess.sid, deadline_ms,
+                          est // 1000)
+        raise DvmDeadline(
+            f"deadline {deadline_ms}ms infeasible: pool estimates "
+            f"~{est // 1000}ms wall at {margin}% margin — shed at "
+            "admission")
+
+    def resize(self, new_cap: int):
+        """Live pool resize: change resident rank capacity WITHOUT
+        draining.  Grow admits queued waiters immediately; shrink
+        only parks ranks between runs — in-flight sessions finish on
+        the old capacity, over-capacity idle warm sessions are
+        evicted, and admission simply stops filling beyond the new
+        bound.  Each resize opens a pool epoch: sessions admitted
+        after it band their derived comm cids on the new epoch
+        (ft/respawn.epoch_cid_floor), so executables and cid spaces
+        never collide across the boundary.  Returns (old, epoch)."""
+        if new_cap < 1:
+            raise DvmError(f"resize to {new_cap} ranks: capacity must "
+                           "be >= 1")
+        with self.lock:
+            if self._draining:
+                raise DvmError("pool is halting")
+            old = self.capacity
+            self.capacity = new_cap
+            self.pool_epoch += 1
+            epoch = self.pool_epoch
+        _pv_resizes.add(1)
+        _obs.record_event(_obs.EV_DVM_RESIZE, old, new_cap, epoch)
+        tr = trace.global_tracer()
+        if tr is not None:
+            tr.instant("dvm_resize", "serve", old=old, new=new_cap,
+                       epoch=epoch)
+        sys.stderr.write(f"tpu-dvm: resize {old} -> {new_cap} ranks "
+                         f"(epoch {epoch})\n")
+        if new_cap < old:
+            # reclaim idle warm one-shot sessions until we fit (never
+            # a running or attached-and-driven session: those park
+            # only between runs, via normal detach/admission flow)
+            while True:
+                with self.lock:
+                    if self.active_ranks <= new_cap:
+                        break
+                    victim = next(
+                        (s for s in self.sessions.values()
+                         if s.legacy_idle and not s.detaching), None)
+                    if victim is None:
+                        break
+                    victim.legacy_idle = False
+                try:
+                    self._detach(victim.sid)
+                except DvmError:
+                    break
+        self._pump()
+        self._write_proctable()
+        return old, epoch
 
     def _session_for(self, sid: int) -> _Session:
         with self.lock:
@@ -913,7 +1272,21 @@ class DVMServer:
                 st = statemod.ProcState(rank, sess.np, rte)
                 st.cid_band = sess.sid
                 st.serve_resident = True
+                # pool-resize epoch rides the respawn epoch machinery
+                # (ft/respawn.epoch_cid_floor): derived comm cids of a
+                # session admitted after a live resize band on the new
+                # epoch, so they can never collide with executables or
+                # cid spaces from before the boundary
+                from ompi_tpu.comm.communicator import \
+                    MAX_RESPAWN_EPOCHS
+                st.respawn_epoch = sess.epoch % MAX_RESPAWN_EPOCHS
                 mpi_init(st, device=rte.default_device)
+                if self.ctrl is not None and getattr(
+                        st, "progress", None) is not None:
+                    # resident rank-threads drive the FleetController
+                    # on their sampled progress sweeps (same gating as
+                    # obs.Scraper); the hb loop covers idle periods
+                    st.progress.ctrl = self.ctrl
                 sess.states[rank] = st
             except BaseException as e:  # noqa: BLE001
                 errs.append((rank, e))
@@ -956,11 +1329,53 @@ class DVMServer:
                 raise DvmError(f"session s{sess.sid} already has a "
                                "run in progress")
             sess.running = True
+            parked = sess.parked
+        try:
+            if parked:
+                # preempted while idle: re-admit + fresh bring-up
+                # before the program starts — invisible to the client
+                # beyond latency
+                self._unpark(sess)
+            while True:
+                code, out, err, wall = self._run_once(sess, prog, args)
+                with sess.lock:
+                    preempted = sess.preempt_requested
+                    sess.preempt_requested = False
+                    if preempted:
+                        sess.parked = True
+                    elif code:
+                        sess.dead = True
+                if preempted:
+                    # retreat: the world is poisoned either way —
+                    # tear it down, hand the ranks to the preemptor,
+                    # then resume from checkpoint.  The victim's
+                    # client sees ONE slower successful run, never a
+                    # failed job.
+                    self._park(sess)
+                    if code and not self._draining:
+                        self._unpark(sess)
+                        continue
+                    if code:  # pool is halting: nowhere to resume
+                        with sess.lock:
+                            sess.dead = True
+                break
+        finally:
+            with sess.lock:
+                sess.running = False
+        if sess.dead:
+            # a dead session is exactly the moment the flight record
+            # must outlive the process that wrote it
+            self._persist_events(f"s{sess.sid} failed")
+        return (code, out, err, wall)
+
+    def _run_once(self, sess: _Session, prog: str, args: List[str]):
         import runpy
 
         from ompi_tpu.runtime import state as statemod
         from ompi_tpu.runtime.rte import set_thread_rte
+        from ompi_tpu.serve import quota as _squota
 
+        _squota.begin_run(sess.sid)  # quotas are per run
         t0 = time.perf_counter()
         _ensure_stdio()  # per run, not just at pool start: the host
         # may have swapped sys.stdout since (pytest capture does)
@@ -1024,20 +1439,18 @@ class DVMServer:
         for t in threads:
             t.join()
         wall = time.perf_counter() - t0
-        with sess.lock:
-            sess.running = False
-            if failure[0]:
-                sess.dead = True
         with self.lock:
             self._jobs += 1
+        wus = int(wall * 1e6)
+        # EWMA (alpha=1/4) run-wall estimator feeding deadline sheds
+        if self.est_wall_us <= 0:
+            self.est_wall_us = wus
+        else:
+            self.est_wall_us += (wus - self.est_wall_us) >> 2
         _pv_jobs.add(1, sess.sid)
-        _pv_job_wall_us.add(int(wall * 1e6), sess.sid)
+        _pv_job_wall_us.add(wus, sess.sid)
         _obs.record_event(_obs.EV_DVM_RUN, sess.sid, failure[0] or 0,
                           int(wall * 1000))
-        if failure[0]:
-            # a dead session is exactly the moment the flight record
-            # must outlive the process that wrote it
-            self._persist_events(f"s{sess.sid} failed")
         tr = trace.global_tracer()
         if tr is not None:
             tr.instant("dvm_run", "serve", sid=sess.sid,
@@ -1222,24 +1635,36 @@ class DvmClient:
                 f"lost connection to the DVM pool: {e}") from None
         return self._await(deadline)
 
+    @staticmethod
+    def _raise_typed(resp: dict) -> None:
+        if resp.get("shed"):
+            raise DvmDeadline(resp["error"])
+        raise (DvmBusy if resp.get("busy") else DvmError)(
+            resp["error"])
+
     def attach(self, np_: int, wait: bool = True,
-               timeout: Optional[float] = None) -> dict:
+               timeout: Optional[float] = None, priority: int = 0,
+               preemptible: bool = False) -> dict:
         resp = self._rpc(
             {"op": "attach", "np": np_, "wait": wait,
-             "timeout": timeout},
+             "timeout": timeout, "priority": priority,
+             "preemptible": preemptible},
             deadline=(time.monotonic() + timeout + 30.0)
             if timeout else None)
         if "error" in resp:
-            raise (DvmBusy if resp.get("busy") else DvmError)(
-                resp["error"])
+            self._raise_typed(resp)
         return resp
 
     def run(self, sid: int, prog: str, args=(),
-            timeout: Optional[float] = None) -> dict:
+            timeout: Optional[float] = None,
+            deadline_ms: Optional[int] = None) -> dict:
+        msg: Dict[str, Any] = {"op": "run", "sid": sid,
+                               "prog": os.path.abspath(prog),
+                               "args": list(args)}
+        if deadline_ms is not None:
+            msg["deadline_ms"] = int(deadline_ms)
         try:
-            _send(self.sock, {"op": "run", "sid": sid,
-                              "prog": os.path.abspath(prog),
-                              "args": list(args)})
+            _send(self.sock, msg)
         except OSError as e:
             raise DvmError(
                 f"lost connection to the DVM pool: {e}") from None
@@ -1253,8 +1678,14 @@ class DvmClient:
         resp = self._await(
             time.monotonic() + timeout if timeout else None)
         if "error" in resp:
-            raise (DvmBusy if resp.get("busy") else DvmError)(
-                resp["error"])
+            self._raise_typed(resp)
+        return resp
+
+    def resize(self, np_: int) -> dict:
+        """Live-resize the pool's rank capacity (no drain)."""
+        resp = self._rpc({"op": "resize", "np": np_})
+        if "error" in resp:
+            self._raise_typed(resp)
         return resp
 
     def detach(self, sid: int) -> dict:
@@ -1463,9 +1894,31 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "(dvm_batch_window_us; 0 disables)")
     ap.add_argument("--halt", default=None, metavar="URI_FILE",
                     help="stop a running DVM")
+    ap.add_argument("--resize", type=int, default=None, metavar="N",
+                    help="live-resize a running DVM (named by "
+                         "--uri-file) to N ranks, no drain")
+    ap.add_argument("--ctrl", action="store_true",
+                    help="enable the FleetController closed loop "
+                         "(dvm_ctrl=1)")
     opts = ap.parse_args(argv)
     if opts.halt:
         return halt(opts.halt)
+    if opts.resize is not None:
+        if not opts.uri_file:
+            ap.error("--resize needs --uri-file to find the pool")
+        try:
+            client = DvmClient(opts.uri_file)
+            try:
+                resp = client.resize(opts.resize)
+            finally:
+                client.close()
+        except DvmError as e:
+            sys.stderr.write(f"tpu-dvm: {e}\n")
+            return 1
+        sys.stderr.write(
+            f"tpu-dvm: resized {resp.get('was')} -> "
+            f"{resp.get('capacity')} (epoch {resp.get('epoch')})\n")
+        return 0
     if not opts.uri_file:
         ap.error("--uri-file is required to serve")
     if opts.session_max is not None:
@@ -1474,6 +1927,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         registry.set("dvm_queue_max", opts.queue_max)
     if opts.batch_window_us is not None:
         registry.set("dvm_batch_window_us", opts.batch_window_us)
+    if opts.ctrl:
+        registry.set("dvm_ctrl", 1)
     return serve(opts)
 
 

@@ -63,6 +63,13 @@ HOT_FUNCTIONS: Dict[str, Tuple[str, ...]] = {
     "ompi_tpu/cr/ckpt.py": (
         "Engine.tick",
     ),
+    # the fleet-controller decision tick rides the same sampled
+    # progress sweeps as Scraper.tick on every resident pool
+    # rank-thread (ISSUE 12): gate-first, integer decisions only —
+    # resizes and event recording happen in apply(), off this path
+    "ompi_tpu/serve/controller.py": (
+        "FleetController.tick",
+    ),
 }
 
 _BANNED_BUILTIN_CALLS = ("dict", "list", "set", "tuple", "frozenset")

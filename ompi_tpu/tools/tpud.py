@@ -284,7 +284,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             from ompi_tpu import obs as _obs
             try:
                 m = _obs.local_metrics(
-                    events=int(msg.get("events", 16)))
+                    events=int(msg.get("events", 16)),
+                    prefix=msg.get("prefix"))
             except Exception as e:  # noqa: BLE001
                 m = {"error": str(e)[:200]}
             report({"op": "metrics", "node": opts.node,

@@ -1,0 +1,53 @@
+"""Checkpointing DVM session workload (run by test_fleet.py and the
+fleet probe): a deterministic stepped allreduce accumulation that
+checkpoints EVERY step to the filesystem tier and restores at start —
+so a preempted run resumes where it stopped and its final digest is
+byte-identical to an unpreempted run.
+
+argv: tag store_dir steps [sleep_s]
+
+Rank 0 prints ``DIGEST {tag} {sha256}`` and ``STEPS {tag} {resumed_at}``
+so tests can assert both the value and that a resume actually happened.
+"""
+import hashlib
+import sys
+import time
+
+import numpy as np
+
+import ompi_tpu
+from ompi_tpu.cr import ckpt
+from ompi_tpu.op import op as mpi_op
+
+tag = sys.argv[1]
+store = sys.argv[2]
+steps = int(sys.argv[3])
+sleep_s = float(sys.argv[4]) if len(sys.argv) > 4 else 0.0
+
+comm = ompi_tpu.init()
+rank, size = comm.rank, comm.size
+
+snap = ckpt.restore(comm, store_dir=store)
+if snap is None:
+    start = 0
+    vec = np.zeros(32, np.float64)
+else:
+    start = int(snap["step"])
+    vec = np.asarray(snap["vec"], np.float64)
+
+for step in range(start, steps):
+    contrib = np.full(32, float((step + 1) * (rank + 1)), np.float64)
+    r = np.empty_like(contrib)
+    comm.Allreduce(contrib, r, mpi_op.SUM)
+    vec = vec + r
+    ckpt.checkpoint(comm, {"step": step + 1, "vec": vec},
+                    store_dir=store, fs=True)
+    if sleep_s:
+        time.sleep(sleep_s)
+
+ckpt.flush(comm)  # commit the last epoch before the digest
+dig = hashlib.sha256(vec.tobytes()).hexdigest()
+if rank == 0:
+    print(f"STEPS {tag} {start}", flush=True)
+    print(f"DIGEST {tag} {dig}", flush=True)
+ompi_tpu.finalize()
