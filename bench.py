@@ -191,6 +191,21 @@ def main() -> None:
                          "'probe_fleet' in BENCH_DETAIL.json, and "
                          "FAIL (exit 1) if any of the three "
                          "invariants breaks")
+    ap.add_argument("--regress", action="store_true",
+                    help="Perf-regression sentry: pure file analysis "
+                         "of the BENCH_r*/BENCH_DETAIL history (no "
+                         "probes run) with noise-aware tolerances; "
+                         "appends a trajectory row to "
+                         "BENCH_DETAIL.json and exits 1 on a "
+                         "regression, 2 on unusable history")
+    ap.add_argument("--dry", action="store_true",
+                    help="With --regress: evaluate and report but "
+                         "append nothing (the tier-1 history-parsing "
+                         "smoke)")
+    ap.add_argument("--bench-dir", default=None,
+                    help="With --regress: directory holding the "
+                         "BENCH_r*.json history (default: this "
+                         "file's directory)")
     ap.add_argument("--probe-obs", action="store_true",
                     help="Measure the telemetry plane: scrape-tick "
                          "overhead on the progress sweep (interleaved "
@@ -206,6 +221,15 @@ def main() -> None:
 
     detail_path = os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAIL.json")
+
+    if opts.regress:
+        from benchmarks.regress import run_regress
+
+        bench_dir = opts.bench_dir or os.path.dirname(
+            os.path.abspath(__file__))
+        if opts.bench_dir:
+            detail_path = os.path.join(bench_dir, "BENCH_DETAIL.json")
+        sys.exit(run_regress(bench_dir, detail_path, dry=opts.dry))
 
     if opts.probe_dispatch:
         from benchmarks.probe_dispatch import persist, run_probe
@@ -252,18 +276,23 @@ def main() -> None:
             "on_us_per_op": probe["on_us_per_op"],
             "host_cores": probe["host_cores"],
             "gil_enabled": probe["gil_enabled"],
+            "phase_overhead_pct": probe["phase_overhead_pct"],
+            "phase_within_budget": probe["phase_within_budget"],
             "within_budget": probe["within_budget"],
         }
         line.update({k: v for k, v in notes.items() if "error" in k})
         sys.stderr.write(json.dumps(probe, indent=1) + "\n")
         print(json.dumps(line))
-        if not probe["within_budget"]:
+        if not probe["within_budget"] or \
+                not probe["phase_within_budget"]:
             # the acceptance contract: >5% MEDIAN tracing overhead is
             # a regression, and it fails LOUDLY, never as a footnote
-            # (best-of is reported for context but never gates)
+            # (best-of is reported for context but never gates); the
+            # phase profiler rides the SAME budget
             sys.stderr.write(
                 f"FAIL: median tracing overhead "
-                f"{probe['overhead_pct']}% exceeds the "
+                f"{probe['overhead_pct']}% / phase overhead "
+                f"{probe['phase_overhead_pct']}% exceeds the "
                 f"{probe['budget_pct']}% budget\n")
             sys.exit(1)
         return
@@ -606,7 +635,7 @@ def main() -> None:
                                     "probe_recovery", "probe_respawn",
                                     "probe_pipeline", "probe_ckpt",
                                     "probe_serve", "probe_obs",
-                                    "probe_fleet")
+                                    "probe_fleet", "regress_trajectory")
                           if isinstance(prior, dict) and k in prior},
                        "device_us": dev, "software_us": sw,
                        "software_tuned_tcp_us": sw_tcp,

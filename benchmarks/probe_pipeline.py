@@ -118,12 +118,69 @@ def run_probe(nranks: int = 8, reps: int = 7,
                 curve[alg][str(nb)] = round(_time_loop(
                     comm, lambda: comm.allreduce_arr(x, SUM), r), 1)
                 del x
+
+        # per-phase breakdown (ISSUE 13): a short pass per alg x size
+        # with the phase profiler armed, so BENCH_DETAIL tracks WHERE
+        # a segmented op's time goes (rendezvous / pack / dispatch /
+        # execute / unpack) round over round — the dispatch-tax number
+        # with a trajectory, not a guess.  The timing sweep above ran
+        # untraced; knobs are restored before returning.
+        from ompi_tpu import trace
+        from ompi_tpu.mca.params import registry
+        saved = {k: registry.get(k) for k in
+                 ("trace_phase_enable", "trace_sample_auto")}
+        registry.set("trace_phase_enable", True)
+        registry.set("trace_sample_auto", 0)
+        tr = trace.force_attach(comm.state)
+        raw: Dict[str, Dict[str, Dict[str, List[float]]]] = {}
+        for alg in ALGS:
+            raw[alg] = {}
+            for nb in sizes:
+                _apply(comm, alg, comm.size)
+                x = jax.device_put(
+                    jnp.arange(nb // 4, dtype=jnp.float32) + comm.rank,
+                    comm.device)
+                comm.allreduce_arr(x, SUM)  # warm (compile spans out)
+                comm.Barrier()
+                mark = time.time() - 1e-3
+                for _ in range(2):
+                    comm.allreduce_arr(x, SUM)
+                comm.Barrier()
+                acc: Dict[str, List[float]] = {}
+                for ev in tr.snapshot():
+                    if ev.get("ph") != "X" or ev["ts"] < mark:
+                        continue
+                    label = trace.PHASE_LABELS.get(ev["name"])
+                    if label is None or ev["cat"] != "phase":
+                        continue
+                    acc.setdefault(label, []).append(ev["dur"] * 1e6)
+                raw[alg][str(nb)] = acc
+                del x
+        comm.state.tracer = None
+        comm.state.progress.tracer = None
+        for k, v in saved.items():
+            registry.set(k, v)
         _apply(comm, "fused", comm.size)  # leave the world at defaults
-        return {"lat_us": curve,
+        return {"lat_us": curve, "phase_raw": raw,
                 "segments": pipeline.pv_segments.read() - seg_before}
 
     res = run_ranks(nranks, fn, devices=True, timeout=1800)
     lat = res[0]["lat_us"]
+    # phase medians merged over EVERY rank's recorded spans: dispatch/
+    # execute land on whichever rank arrived last at each rendezvous,
+    # so a single rank's view would usually miss them entirely
+    phase_us: Dict[str, Dict[str, Dict[str, float]]] = {}
+    for alg in ALGS:
+        phase_us[alg] = {}
+        for s in (res[0].get("phase_raw") or {}).get(alg, {}):
+            merged: Dict[str, List[float]] = {}
+            for r in res:
+                for label, durs in ((r.get("phase_raw") or {})
+                                    .get(alg, {}).get(s) or {}).items():
+                    merged.setdefault(label, []).extend(durs)
+            phase_us[alg][s] = {
+                label: round(_median_us([d * 1e-6 for d in durs]), 1)
+                for label, durs in sorted(merged.items())}
     probe: Dict = {
         "nranks": nranks,
         "sizes": sizes,
@@ -131,6 +188,7 @@ def run_probe(nranks: int = 8, reps: int = 7,
         "busbw_gbs": {a: {s: _busbw_gbs(int(s), us, nranks)
                           for s, us in lat[a].items()}
                       for a in ALGS},
+        "phase_us": phase_us,
         "segments_rank0": res[0]["segments"],
     }
     # measured crossovers: smallest probed size where the tier wins

@@ -1,0 +1,278 @@
+"""regress: the perf-regression sentry over the BENCH_r* history.
+
+``bench.py --regress`` is pure file analysis — it runs NO probes.  It
+loads the per-round driver records (``BENCH_r*.json``: the parsed
+headline metric plus the captured stdout tail) and the full-sweep
+``BENCH_DETAIL.json``, compares the newest round against the history
+with **noise-aware tolerances**, appends a trajectory row so probe
+metrics become comparable round over round, and exits nonzero when a
+metric regressed beyond what the history's own noise can explain.
+
+Noise model: for each metric the baseline is the MEDIAN of the prior
+samples and the tolerance is::
+
+    tol = max(base_tol, NOISE_K * MAD / median)
+
+where MAD is the median absolute deviation of the prior samples — a
+flat history (74.4, 74.5, 74.3) keeps the tight base tolerance and a
+20% drop trips the sentry; a history whose own scatter dwarfs any
+plausible regression (74 -> 10 -> 12 across reworked sweeps) widens
+the band automatically, because claiming a regression noisier than
+the noise floor would be a lie.  Lower-is-better metrics (overhead
+percentages) use the same model with the comparison flipped and an
+absolute floor (percentages near zero make relative bands useless).
+
+Rounds whose metric is missing or nonpositive (a failed sweep) are
+excluded from baselines — a crashed round must not poison the noise
+estimate OR hide as a fake regression.
+
+``--dry`` evaluates everything but appends nothing: the tier-1 smoke
+validates history parsing without mutating BENCH_DETAIL.json.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+#: scale factor on MAD when widening a tolerance band
+NOISE_K = 3.0
+
+#: cap on retained trajectory rows (oldest dropped first)
+TRAJECTORY_CAP = 100
+
+#: metric -> (direction, base tolerance).  Direction "higher" metrics
+#: regress by dropping (relative tolerance); "lower" metrics regress
+#: by rising (absolute tolerance, percentage points).
+TOLERANCES: Dict[str, Tuple[str, float]] = {
+    "headline_busbw_gbs": ("higher", 0.10),
+    "pipeline_fused_busbw_gbs": ("higher", 0.25),
+    "pipeline_segring_busbw_gbs": ("higher", 0.25),
+    "trace_overhead_pct": ("lower", 2.0),
+    "obs_overhead_pct": ("lower", 2.0),
+    "dispatch_const_us": ("lower", 50.0),
+}
+
+
+def _json_lines(text: str):
+    for line in reversed(text.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                yield json.loads(line)
+            except ValueError:
+                continue
+
+
+def round_headline(doc: dict) -> Optional[float]:
+    """GB/s of the headline metric for one BENCH_r record: the
+    driver-parsed value, else the last parseable JSON line of the
+    captured stdout tail (the r2 failure mode — a tail outgrowing the
+    capture — leaves parsed null with the line still in the text)."""
+    parsed = doc.get("parsed") or {}
+    v = parsed.get("value")
+    if isinstance(v, (int, float)) and v > 0:
+        return float(v)
+    for obj in _json_lines(doc.get("tail", "") or ""):
+        if obj.get("unit") == "GB/s" and \
+                isinstance(obj.get("value"), (int, float)) and \
+                obj["value"] > 0:
+            return float(obj["value"])
+    return None
+
+
+def load_rounds(bench_dir: str) -> List[Tuple[int, dict]]:
+    """(round number, record) sorted ascending from BENCH_r*.json."""
+    out = []
+    for path in glob.glob(os.path.join(bench_dir, "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if not m:
+            continue
+        try:
+            with open(path) as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        out.append((int(m.group(1)), doc))
+    out.sort()
+    return out
+
+
+def _detail_metrics(detail: dict) -> Dict[str, float]:
+    """Flatten the probe blocks of BENCH_DETAIL.json into the sentry's
+    comparable scalar metrics (missing probes simply absent)."""
+    out: Dict[str, float] = {}
+    to = detail.get("trace_overhead") or {}
+    if isinstance(to.get("overhead_pct"), (int, float)):
+        out["trace_overhead_pct"] = float(to["overhead_pct"])
+    ob = detail.get("probe_obs") or {}
+    if isinstance(ob.get("overhead_pct"), (int, float)):
+        out["obs_overhead_pct"] = float(ob["overhead_pct"])
+    pd = detail.get("probe_dispatch") or {}
+    const = (pd.get("fused") or {}).get("dispatch_const_us") \
+        if isinstance(pd.get("fused"), dict) else None
+    if const is None:
+        const = pd.get("dispatch_const_us")
+    if isinstance(const, (int, float)):
+        out["dispatch_const_us"] = float(const)
+    pp = detail.get("probe_pipeline") or {}
+    bus = pp.get("busbw_gbs") or {}
+    for alg in ("fused", "segring"):
+        curve = bus.get(alg) or {}
+        sizes = [k for k, v in curve.items()
+                 if isinstance(v, (int, float)) and v > 0]
+        if sizes:
+            top = max(sizes, key=int)
+            out[f"pipeline_{alg}_busbw_gbs"] = float(curve[top])
+    return out
+
+
+def current_metrics(rounds: List[Tuple[int, dict]],
+                    detail: dict) -> Dict[str, float]:
+    out = _detail_metrics(detail)
+    if rounds:
+        v = round_headline(rounds[-1][1])
+        if v is not None:
+            out["headline_busbw_gbs"] = v
+    return out
+
+
+def _median(vals: List[float]) -> float:
+    s = sorted(vals)
+    n = len(s)
+    m = n // 2
+    return s[m] if n % 2 else 0.5 * (s[m - 1] + s[m])
+
+
+def check_metric(name: str, current: float,
+                 history: List[float]) -> Optional[dict]:
+    """One finding dict when ``current`` regressed vs ``history``
+    beyond the noise-aware band, else None.  Needs >= 2 valid prior
+    samples — a single point has no noise estimate."""
+    direction, base = TOLERANCES.get(name, ("higher", 0.10))
+    hist = [v for v in history if isinstance(v, (int, float)) and
+            (v > 0 or direction == "lower")]
+    if len(hist) < 2:
+        return None
+    med = _median(hist)
+    mad = _median([abs(v - med) for v in hist])
+    if direction == "higher":
+        if med <= 0:
+            return None
+        tol = max(base, NOISE_K * mad / med)
+        floor = med * (1.0 - tol)
+        if current < floor:
+            return {"metric": name, "current": round(current, 3),
+                    "baseline_median": round(med, 3),
+                    "floor": round(floor, 3),
+                    "tolerance": round(tol, 3),
+                    "n_history": len(hist)}
+        return None
+    # lower-is-better: absolute band in the metric's own units
+    band = max(base, NOISE_K * mad)
+    ceil = med + band
+    if current > ceil:
+        return {"metric": name, "current": round(current, 3),
+                "baseline_median": round(med, 3),
+                "ceiling": round(ceil, 3), "tolerance": round(band, 3),
+                "n_history": len(hist)}
+    return None
+
+
+def evaluate(rounds: List[Tuple[int, dict]],
+             detail: dict) -> Dict[str, Any]:
+    """The sentry verdict document: current metrics, per-metric
+    findings, and the trajectory row a non-dry run appends."""
+    cur = current_metrics(rounds, detail)
+    findings: List[dict] = []
+
+    # headline: newest round vs the prior rounds' own records
+    if "headline_busbw_gbs" in cur and len(rounds) >= 3:
+        hist = []
+        for _n, doc in rounds[:-1]:
+            v = round_headline(doc)
+            if v is not None:
+                hist.append(v)
+        f = check_metric("headline_busbw_gbs",
+                         cur["headline_busbw_gbs"], hist)
+        if f:
+            findings.append(f)
+
+    # probe metrics: current BENCH_DETAIL vs the recorded trajectory
+    traj = detail.get("regress_trajectory") or []
+    for name, val in cur.items():
+        if name == "headline_busbw_gbs":
+            continue
+        hist = [row["metrics"][name] for row in traj
+                if isinstance(row, dict) and
+                name in (row.get("metrics") or {})]
+        f = check_metric(name, val, hist)
+        if f:
+            findings.append(f)
+
+    row = {"round": rounds[-1][0] if rounds else None, "metrics": cur}
+    return {"metrics": cur, "findings": findings, "trajectory_row": row,
+            "rounds_seen": len(rounds),
+            "trajectory_len": len(traj)}
+
+
+def append_trajectory(detail_path: str, row: dict) -> None:
+    """Read-modify-write the trajectory list in BENCH_DETAIL.json,
+    capped so the file never grows without bound."""
+    try:
+        with open(detail_path) as fh:
+            detail = json.load(fh)
+        if not isinstance(detail, dict):
+            detail = {}
+    except (OSError, ValueError):
+        detail = {}
+    traj = detail.get("regress_trajectory")
+    if not isinstance(traj, list):
+        traj = []
+    traj.append(row)
+    detail["regress_trajectory"] = traj[-TRAJECTORY_CAP:]
+    with open(detail_path, "w") as fh:
+        json.dump(detail, fh, indent=1)
+
+
+def run_regress(bench_dir: str, detail_path: str,
+                dry: bool = False) -> int:
+    """The ``bench.py --regress`` entry: 0 = no regression, 1 =
+    regression detected, 2 = no usable history (CI treats that as a
+    configuration error, not a pass)."""
+    rounds = load_rounds(bench_dir)
+    try:
+        with open(detail_path) as fh:
+            detail = json.load(fh)
+        if not isinstance(detail, dict):
+            detail = {}
+    except (OSError, ValueError):
+        detail = {}
+    if not rounds and not detail:
+        print(json.dumps({"regress": "no history",
+                          "bench_dir": bench_dir}))
+        return 2
+    res = evaluate(rounds, detail)
+    if not dry:
+        append_trajectory(detail_path, res["trajectory_row"])
+    line = {
+        "metric": f"perf-regression sentry over {res['rounds_seen']} "
+                  f"round(s) + {res['trajectory_len']} trajectory "
+                  f"row(s)",
+        "value": len(res["findings"]),
+        "unit": "regressions",
+        "dry": dry,
+        "metrics": res["metrics"],
+    }
+    if res["findings"]:
+        line["findings"] = res["findings"]
+    print(json.dumps(line))
+    if res["findings"]:
+        import sys
+        for f in res["findings"]:
+            sys.stderr.write(f"REGRESSION: {json.dumps(f)}\n")
+        return 1
+    return 0

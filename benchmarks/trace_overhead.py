@@ -30,6 +30,12 @@ which land in BENCH_DETAIL.json under ``trace_overhead``.
 
 The 5%% budget is enforced LOUDLY: ``bench.py --trace-overhead``
 exits nonzero when the MEDIAN overhead exceeds it.
+
+The phase profiler (DESIGN.md §18) rides the same budget: the block
+rotation is three-way (off / on / on+phase spans), so the JSON also
+reports ``phase_overhead_pct`` — the cost of per-op rendezvous /
+pack / dispatch / execute sub-spans measured against the SAME
+untraced blocks, judged against the SAME 5%% bound.
 """
 
 from __future__ import annotations
@@ -47,7 +53,7 @@ RAMP_OPS = 8000    # traced ops to carry the adaptive sampler to its
                    # steady state (period doubles every
                    # trace_sample_auto seen, to trace_sample_max)
 BLOCK_OPS = 2000   # allreduces per measured block
-BLOCKS = 5         # interleaved off/on block pairs
+BLOCKS = 5         # interleaved off/on/phase block triples
 BUDGET_PCT = 5.0   # acceptance bound for the ON path (median)
 
 
@@ -71,30 +77,46 @@ def _probe_world() -> Dict:
             comm.Allreduce(sbuf, rbuf, SUM)
         for _ in range(RAMP_OPS):
             comm.Allreduce(sbuf, rbuf, SUM)
-        off_blocks, on_blocks = [], []
-        for b in range(BLOCKS * 2):
-            traced = bool(b & 1)
+        phase0 = tr.phase
+        # ramp the PHASE category's adaptive sampler too: its period
+        # starts at 1 (every op pays a device fence for the execute
+        # span) and doubles to trace_sample_max — the budget is the
+        # steady state, with the transient disclosed via RAMP_OPS
+        tr.phase = True
+        for _ in range(RAMP_OPS):
+            comm.Allreduce(sbuf, rbuf, SUM)
+        tr.phase = phase0
+        off_blocks, on_blocks, phase_blocks = [], [], []
+        for b in range(BLOCKS * 3):
+            mode = b % 3  # 0 = off, 1 = on, 2 = on + phase spans
             comm.Barrier()
             # every rank flips ITS OWN state: the shim and the device
             # dispatch read state.tracer per call, so None here is
-            # exactly the trace-off contract (one is-None check)
-            comm.state.tracer = tr if traced else None
+            # exactly the trace-off contract (one is-None check).
+            # Mode 2 additionally arms the per-op phase profiler via
+            # the same attribute the trace_phase_enable knob sets at
+            # attach — the hot-path gate is ``tr.phase``, read per op.
+            comm.state.tracer = tr if mode else None
+            tr.phase = mode == 2
             comm.Barrier()
             t0 = time.perf_counter()
             for _ in range(BLOCK_OPS):
                 comm.Allreduce(sbuf, rbuf, SUM)
             dt = time.perf_counter() - t0
-            (on_blocks if traced else off_blocks).append(
+            (off_blocks, on_blocks, phase_blocks)[mode].append(
                 dt / BLOCK_OPS * 1e6)
         comm.state.tracer = tr
+        tr.phase = phase0
         comm.Barrier()
         out: Dict = {"off_us_blocks": off_blocks,
-                     "on_us_blocks": on_blocks}
+                     "on_us_blocks": on_blocks,
+                     "phase_us_blocks": phase_blocks}
         if comm.rank != 0:
             return out
         from ompi_tpu import mpit, trace
         out["spans"] = {cat: tr.span_count(cat)
-                        for cat in ("coll", "coll_dispatch", "p2p")}
+                        for cat in ("coll", "coll_dispatch", "p2p",
+                                    "phase")}
         out["recorded"] = tr.recorded
         out["dropped"] = tr.dropped
         # snapshot through MPI_T itself (not the Tracer object): the
@@ -138,12 +160,15 @@ def run_probe() -> Dict:
         registry.set("trace_enable", "0")
     off_times = snap["off_us_blocks"]
     on_times = snap["on_us_blocks"]
+    phase_times = snap["phase_us_blocks"]
     off_us = min(off_times)
     on_us = min(on_times)
     off_med = statistics.median(off_times)
     on_med = statistics.median(on_times)
+    phase_med = statistics.median(phase_times)
     overhead_best = (on_us - off_us) / off_us * 100.0
     overhead_med = (on_med - off_med) / off_med * 100.0
+    phase_overhead_med = (phase_med - off_med) / off_med * 100.0
     gil = getattr(sys, "_is_gil_enabled", lambda: True)()
     return {
         "nranks": NRANKS,
@@ -169,6 +194,12 @@ def run_probe() -> Dict:
         # its historical name so BENCH_DETAIL consumers stay working,
         # but it now carries the median — the honest figure)
         "overhead_pct": round(overhead_med, 2),
+        # phase profiler (DESIGN.md §18): trace ON + per-op phase
+        # sub-spans, vs the same untraced blocks, same budget
+        "phase_us_median": round(phase_med, 2),
+        "phase_us_all": [round(x, 2) for x in phase_times],
+        "phase_overhead_pct": round(phase_overhead_med, 2),
+        "phase_within_budget": bool(phase_overhead_med <= BUDGET_PCT),
         "budget_pct": BUDGET_PCT,
         "within_budget": bool(overhead_med <= BUDGET_PCT),
         "traced_spans": snap.get("spans", {}),

@@ -53,6 +53,11 @@ _CAT_DISP = _trace.CAT_COLL_DISPATCH
 _CAT_SEG = _trace.CAT_COLL_SEGMENT
 _NAME_MEET = _trace.NAME_MEET
 _NAME_SEG_MEET = _trace.NAME_SEG_MEET
+_CAT_PHASE = _trace.CAT_PHASE
+_NAME_PH_RDV = _trace.NAME_PH_RDV
+_NAME_PH_DISPATCH = _trace.NAME_PH_DISPATCH
+_NAME_PH_EXECUTE = _trace.NAME_PH_EXECUTE
+_HIST_RDV = _trace.HIST_RDV_WAIT
 
 _prio_tpu = registry.register(
     "coll", "tpu", "priority", 80, int,
@@ -279,6 +284,62 @@ def _coll_delay_injector(state):
     return inj
 
 
+# -- phase profiler helpers (docs/DESIGN.md §18) ----------------------------
+# A "ph ctx" is the tuple (tracer, cid, seq, nbytes) a traced op builds
+# ONCE (only when tracer.phase is armed — the zero-cost-when-off gate
+# everywhere else is a single attribute check) and threads through the
+# rendezvous so the waits, the dispatch, and the fenced device execute
+# decompose the op span into named phases.  Each phase span samples
+# independently through the 'phase' category, so the exactness
+# invariant (kept + sampled_out == seen) holds per category.
+
+def _ph_rdv_start(ph):
+    """Open a rendezvous-wait phase span (0 when the ctx is absent or
+    the phase category sampled this one out)."""
+    if ph is None:
+        return 0
+    return ph[0].start_sampled(_CAT_PHASE)
+
+
+def _ph_rdv_end(ph, t0) -> None:
+    """Close a rendezvous-wait phase span and feed the straggler-skew
+    histogram (rdv_wait is the one phase with its own gauge — it IS
+    the cross-rank skew signal)."""
+    tr = ph[0]
+    dur = tr.end(t0, _NAME_PH_RDV, _CAT_PHASE, ph[1], ph[2], ph[3])
+    tr.hist_add(_HIST_RDV, dur * 1e-9)
+
+
+def _phase_fn(fn, shards, ph):
+    """Run a meeting's computation with dispatch/execute phases
+    recorded against the triggering rank's tracer.  The execute fence
+    (block_until_ready) runs ONLY when that phase span was sampled in
+    — an unsampled op keeps XLA's async dispatch untouched."""
+    if ph is None:
+        return fn(shards)
+    tr = ph[0]
+    t0 = tr.start_sampled(_CAT_PHASE)
+    res = fn(shards)
+    if t0:
+        tr.end(t0, _NAME_PH_DISPATCH, _CAT_PHASE, ph[1], ph[2], ph[3])
+    t1 = tr.start_sampled(_CAT_PHASE)
+    if t1:
+        _block_ready(res)
+        tr.end(t1, _NAME_PH_EXECUTE, _CAT_PHASE, ph[1], ph[2], ph[3])
+    return res
+
+
+def _block_ready(res) -> None:
+    """Fence a dispatched computation to device completion (the
+    device-execute phase boundary); never raises — a non-jax result
+    (host fallback payloads) just means a zero-length execute span."""
+    try:
+        import jax
+        jax.block_until_ready(res)
+    except Exception:
+        pass
+
+
 class Rendezvous:
     """Per-communicator meeting point for device collectives.
 
@@ -373,7 +434,8 @@ class Rendezvous:
               fn: Callable[[List[Any]], List[Any]],
               abort_check: Optional[Callable[[], None]] = None,
               progress: Any = None,
-              dispatch_async: Optional[bool] = None) -> int:
+              dispatch_async: Optional[bool] = None,
+              ph: Optional[tuple] = None) -> int:
         """Deposit `value` for the next generation; the last arriver
         triggers fn(slots) -> outputs.  Returns the generation token
         to collect with ``finish``.
@@ -394,9 +456,12 @@ class Rendezvous:
             dispatch_async = _dispatcher_var.value
         with self.cv:
             # wait until my slot from the previous generation is consumed
+            tw = _ph_rdv_start(ph)
             self._wait_for(lambda: self.slots[rank] is self._SENTINEL,
                            "previous generation unconsumed",
                            abort_check, progress)
+            if tw:
+                _ph_rdv_end(ph, tw)
             gen = self.gen
             self.slots[rank] = value
             self.count += 1
@@ -413,7 +478,7 @@ class Rendezvous:
 
                     def work() -> None:
                         try:
-                            res = fn(shards)
+                            res = _phase_fn(fn, shards, ph)
                             err = None
                         except BaseException as e:  # noqa: BLE001
                             res = [None] * rv.size
@@ -435,7 +500,7 @@ class Rendezvous:
                     # last arriver computes inline (under the cv, as
                     # before the r5 dispatcher experiment)
                     try:
-                        self.results[gen] = fn(shards)
+                        self.results[gen] = _phase_fn(fn, shards, ph)
                     except BaseException as e:  # noqa: BLE001
                         self.errors[gen] = e
                         self.results[gen] = [None] * self.size
@@ -448,15 +513,19 @@ class Rendezvous:
 
     def finish(self, rank: int, gen: int,
                abort_check: Optional[Callable[[], None]] = None,
-               progress: Any = None) -> Any:
+               progress: Any = None,
+               ph: Optional[tuple] = None) -> Any:
         """Collect this rank's output of generation ``gen`` (a token
         from ``begin``).  Each member must finish every generation it
         begins, exactly once — results are refcounted away after the
         last reader."""
         with self.cv:
+            tw = _ph_rdv_start(ph)
             self._wait_for(lambda: gen in self.results,
                            f"waiting for peers (gen {gen})",
                            abort_check, progress)
+            if tw:
+                _ph_rdv_end(ph, tw)
             err = self.errors.get(gen)
             out = self.results[gen][rank]
             self.readers[gen] -= 1
@@ -470,11 +539,11 @@ class Rendezvous:
 
     def run(self, rank: int, value: Any, fn: Callable[[List[Any]], List[Any]],
             abort_check: Optional[Callable[[], None]] = None,
-            progress: Any = None) -> Any:
+            progress: Any = None, ph: Optional[tuple] = None) -> Any:
         """Deposit `value`; last arriver runs fn(slots) -> outputs;
         block until this rank's output is ready (begin + finish)."""
-        gen = self.begin(rank, value, fn, abort_check, progress)
-        return self.finish(rank, gen, abort_check, progress)
+        gen = self.begin(rank, value, fn, abort_check, progress, ph=ph)
+        return self.finish(rank, gen, abort_check, progress, ph=ph)
 
 
 def meet(comm, value, fn, abort_check) -> Any:
@@ -511,8 +580,11 @@ def meet(comm, value, fn, abort_check) -> Any:
         t0 = 0
     else:
         t0 = tr.start_sampled(_CAT_DISP)
+    # phase ctx (docs/DESIGN.md §18): one tuple per op ONLY when the
+    # profiler is armed — off, this is a single attribute check
+    ph = (tr, comm.cid, seq, nbytes) if tr.phase else None
     out = rv.run(comm.rank, value, fn, abort_check,
-                 progress=comm.state.progress)
+                 progress=comm.state.progress, ph=ph)
     if t0:
         tr.end(t0, _NAME_MEET, _CAT_DISP, comm.cid, seq, nbytes)
     return out
@@ -536,19 +608,28 @@ def meet_begin(comm, value, fn, abort_check):
     nbytes = int(getattr(value, "nbytes", 0) or 0)
     count_offload(comm, nbytes)
     tr = comm.state.tracer
-    t0 = tr.start_sampled(_CAT_SEG) if tr is not None else 0
+    t0 = 0
+    ph = None
+    if tr is not None:
+        t0 = tr.start_sampled(_CAT_SEG)
+        if tr.phase:
+            # the final seq is assigned at meet_finish; the CURRENT
+            # _dev_seq is close enough for critpath's containment-
+            # based attribution (exact keys ride the seg_meet span)
+            ph = (tr, comm.cid, comm._dev_seq, nbytes)
     gen = rv.begin(comm.rank, value, fn, abort_check,
-                   progress=comm.state.progress, dispatch_async=True)
-    return (rv, gen, t0, nbytes)
+                   progress=comm.state.progress, dispatch_async=True,
+                   ph=ph)
+    return (rv, gen, t0, nbytes, ph)
 
 
 def meet_finish(comm, handle, abort_check) -> Any:
     """Collect one ``meet_begin`` handle.  The deposit→collect span is
     recorded under cat ``coll_segment`` (its own latency histogram —
     per-segment latency, unlike coll_dispatch's whole-op latency)."""
-    rv, gen, t0, nbytes = handle
+    rv, gen, t0, nbytes, ph = handle
     out = rv.finish(comm.rank, gen, abort_check,
-                    progress=comm.state.progress)
+                    progress=comm.state.progress, ph=ph)
     tr = comm.state.tracer
     if tr is not None:
         # the seq ticks on EVERY traced segment (sampled out or not)

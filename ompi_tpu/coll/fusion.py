@@ -513,6 +513,11 @@ class _FusionEngine:
         comm = self.comm
         tr = comm.state.tracer
         t0 = tr.start_sampled(_trace.CAT_COLL) if tr is not None else 0
+        # phase profiler (docs/DESIGN.md §18): the fused pack is the
+        # host-pack phase of the op the following meet() dispatches —
+        # comm._dev_seq is exactly the seq that meet will record
+        tp = tr.start_sampled(_trace.CAT_PHASE) \
+            if tr is not None and tr.phase else 0
         mesh = comm.mesh()
         my_dev = mesh.devices.reshape(-1)[comm.rank]
         groups, folds = _group_plan(sig)
@@ -533,6 +538,9 @@ class _FusionEngine:
                 deposit.append(packfn(*[jax.device_put(a, my_dev)
                                         for a in args]))
         deposit.extend(batch[i].x for i in folds)
+        if tp:
+            tr.end(tp, _trace.NAME_PH_PACK, _trace.CAT_PHASE,
+                   comm.cid, comm._dev_seq, 0)
         if t0:
             tr.end(t0, _trace.NAME_FUSED_PACK, _trace.CAT_COLL,
                    comm.cid, len(groups), len(sig))

@@ -70,6 +70,12 @@ _PIPE_NAME = {
     for kind in ("allreduce", "bcast", "alltoall")
 }
 
+# phase-profiler aliases (docs/DESIGN.md §18): host pack (segment
+# slicing) and unpack (trim + concat) sub-op phases
+_CAT_PHASE = _trace.CAT_PHASE
+_NAME_PH_PACK = _trace.NAME_PH_PACK
+_NAME_PH_UNPACK = _trace.NAME_PH_UNPACK
+
 _seg_size_var = registry.register(
     "coll", "seg", "size", 1 << 20, int,
     help="Segment size (bytes) for the segmented/pipelined large-"
@@ -310,6 +316,22 @@ def segment_elems(comm, itemsize: int) -> int:
     return elems + (comm.size - rem) if rem else elems
 
 
+def _pull_segment(it, ph):
+    """Pack stage: pull one (value, fn) job from the segment
+    generator.  The slice+pad work happens inside next(), so the span
+    around it IS the host-pack phase.  Hot (once per segment, per
+    rank): audited by hotpath_audit.  The exhausted-iterator probe
+    records one ~0 span, keeping kept+dropped==seen exact."""
+    if ph is None:
+        return next(it, None)
+    tr = ph[0]
+    t0 = tr.start_sampled(_CAT_PHASE)
+    job = next(it, None)
+    if t0:
+        tr.end(t0, _NAME_PH_PACK, _CAT_PHASE, ph[1], ph[2], ph[3])
+    return job
+
+
 def _run_pipelined(module, comm, jobs) -> List[Any]:
     """Drive (value, fn) segment jobs through the async rendezvous
     with bounded depth.  Every begun handle is finished even on error
@@ -317,10 +339,17 @@ def _run_pipelined(module, comm, jobs) -> List[Any]:
     from ompi_tpu.coll import device
     depth = max(1, _depth_var.value)
     check = module._abort_check(comm)
+    tr = comm.state.tracer
+    ph = (tr, comm.cid, 0, 0) if tr is not None and tr.phase else None
+    it = iter(jobs)
     handles: deque = deque()
     outs: List[Any] = []
     try:
-        for value, fn in jobs:
+        while True:
+            job = _pull_segment(it, ph)
+            if job is None:
+                break
+            value, fn = job
             handles.append(device.meet_begin(comm, value, fn, check))
             pv_segments.add(1)
             if len(handles) > depth:
@@ -360,6 +389,19 @@ def _concat_trim(outs: List[Any], n: int, seg: int):
     return outs[0] if len(outs) == 1 else jnp.concatenate(outs)
 
 
+def _unpack_trim(comm, outs: List[Any], n: int, seg: int):
+    """Unpack stage: trim the padded tail and concatenate, wrapped in
+    a ph_unpack phase span when the phase profiler is armed."""
+    tr = comm.state.tracer
+    if tr is None or not tr.phase:
+        return _concat_trim(outs, n, seg)
+    t0 = tr.start_sampled(_CAT_PHASE)
+    out = _concat_trim(outs, n, seg)
+    if t0:
+        tr.end(t0, _NAME_PH_UNPACK, _CAT_PHASE, comm.cid, 0, 0)
+    return out
+
+
 # -- mesh (coll/tpu) algorithms ---------------------------------------------
 
 def _mesh_seg_reduce(module, comm, x, op, alg: str):
@@ -386,7 +428,7 @@ def _mesh_seg_reduce(module, comm, x, op, alg: str):
     outs = _run_pipelined(module, comm,
                           ((p, fn) for p in _flat_segments(flat, n, seg,
                                                            pad)))
-    return _concat_trim(outs, n, seg).reshape(shape)
+    return _unpack_trim(comm, outs, n, seg).reshape(shape)
 
 
 def _mesh_seg_bcast(module, comm, x, root: int):
@@ -408,7 +450,7 @@ def _mesh_seg_bcast(module, comm, x, root: int):
     outs = _run_pipelined(module, comm,
                           ((p, fn) for p in _flat_segments(flat, n, seg,
                                                            dtype.type(0))))
-    return _concat_trim(outs, n, seg).reshape(shape)
+    return _unpack_trim(comm, outs, n, seg).reshape(shape)
 
 
 def _mesh_seg_alltoall(module, comm, x):
@@ -476,7 +518,7 @@ def _hbm_seg_reduce(module, comm, x, op):
     outs = _run_pipelined(module, comm,
                           ((p, fn) for p in _flat_segments(flat, n, seg,
                                                            pad)))
-    return _concat_trim(outs, n, seg).reshape(shape)
+    return _unpack_trim(comm, outs, n, seg).reshape(shape)
 
 
 def _hbm_seg_alltoall(module, comm, x):

@@ -581,6 +581,47 @@ def register_pvars() -> None:
             "obs", "", "scrapes", var_class="counter",
             getter=_scrapes_getter,
             help="Histogram snapshots taken by this rank's scraper")
+        # critical-path profiler gauges (DESIGN.md §18): live view of
+        # the phase-span totals tools/critpath.py analyzes offline
+        registry.register_pvar(
+            "obs", "critpath", "phase_us", var_class="level",
+            getter=_critpath_phase_us,
+            help="Cumulative us recorded per dispatch phase "
+                 "(rendezvous/pack/dispatch/execute/unpack/compile) "
+                 "by the phase profiler (trace_phase_enable)")
+        registry.register_pvar(
+            "obs", "critpath", "gating_phase", var_class="level",
+            getter=_gating_phase,
+            help="Phase with the largest cumulative recorded time on "
+                 "this rank — the local dispatch-tax leader")
+        registry.register_pvar(
+            "obs", "straggler", "skew_us", var_class="level",
+            getter=_straggler_skew_us,
+            help="p90 of the rendezvous-wait histogram (us): how long "
+                 "this rank typically waits for its slowest peer")
+
+
+def _critpath_phase_us() -> Dict[str, int]:
+    st = _statemod.maybe_current()
+    tr = st.tracer if st is not None else None
+    return tr.phase_totals() if tr is not None else {}
+
+
+def _gating_phase() -> str:
+    best = ""
+    best_v = -1
+    for label, us in _critpath_phase_us().items():
+        if us > best_v:
+            best, best_v = label, us
+    return best
+
+
+def _straggler_skew_us() -> int:
+    st = _statemod.maybe_current()
+    tr = st.tracer if st is not None else None
+    if tr is None:
+        return 0
+    return int(hist_percentiles(tr.hists[_trace.HIST_RDV_WAIT])["p90"])
 
 
 def _scrapes_getter() -> int:

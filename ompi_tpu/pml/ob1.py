@@ -46,6 +46,9 @@ pml_framework = frameworks.create("ompi", "pml")
 _CAT_P2P = _trace.CAT_P2P
 _NAME_SEND = _trace.NAME_SEND
 _NAME_RECV = _trace.NAME_RECV
+_CAT_PHASE = _trace.CAT_PHASE
+_NAME_PH_RDV = _trace.NAME_PH_RDV
+_HIST_RDV = _trace.HIST_RDV_WAIT
 
 registry.register(
     "pml", "ob1", "rsend_is_standard", True, bool,
@@ -672,6 +675,16 @@ class PmlOb1:
         req = self._send_reqs.pop(sreq_id, None)
         if req is None:
             return
+        tr = self._tracer
+        if tr is not None and tr.phase and req.tr is not None:
+            # host-path rendezvous wait (RNDV sent at isend, ACK just
+            # arrived): rides the p2p span's sampling decision — no
+            # second start_sampled, req.tr stays armed for the send
+            # span closed below (docs/DESIGN.md §18)
+            t0, cid, src, tag, seq = req.tr
+            dur = tr.end(t0, _NAME_PH_RDV, _CAT_PHASE, cid, seq,
+                         req.total)
+            tr.hist_add(_HIST_RDV, dur * 1e-9)
         ep = self._ep(req.dst)
         btl = ep.btl
         conv = req.conv

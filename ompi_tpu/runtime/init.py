@@ -240,6 +240,12 @@ def mpi_finalize(state: ProcState) -> None:
     # BEFORE the fence: a flush may need one last cross-rank
     # rendezvous, so peers must still be alive and symmetric here
     state.progress.run_finalize_hooks()
+    # mpisync clock-offset measurement BEFORE the fence (it is itself
+    # collective — Barrier/Send/Recv/Bcast need a live pml): embeds
+    # the offset table into every rank's trace dump so traceview /
+    # critpath align timelines without a hand-plumbed --sync file
+    from ompi_tpu import trace as _trace
+    _trace.sync_state(state)
     # pml/monitoring traffic-matrix dump BEFORE the fence: every
     # rank's .prof file must exist by the time the fence releases
     # rank 0 to aggregate them (profile2mat semantics)
@@ -269,8 +275,7 @@ def mpi_finalize(state: ProcState) -> None:
     from ompi_tpu import obs as _obs_fin
     _obs_fin.detach(state)
     # trace dump LAST: teardown spans (flush rendezvous, btl close)
-    # are part of the timeline
-    from ompi_tpu import trace as _trace
+    # are part of the timeline (_trace imported above for sync_state)
     _trace.dump_state(state)
     state.finalized = True
     clear_current(state)

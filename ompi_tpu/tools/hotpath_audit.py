@@ -44,6 +44,19 @@ HOT_FUNCTIONS: Dict[str, Tuple[str, ...]] = {
     "ompi_tpu/pml/ob1.py": (
         "PmlOb1._trace_p2p_end",
     ),
+    # phase-profiler record points (ISSUE 13 / DESIGN.md §18): they
+    # run once per rendezvous wait / segment / dispatched op whenever
+    # trace_phase_enable is on, so they obey the same no-allocation
+    # rules as the tracer itself — the ph context tuple is built ONCE
+    # per op at the gate, never inside these
+    "ompi_tpu/coll/device.py": (
+        "_ph_rdv_start",
+        "_ph_rdv_end",
+        "_phase_fn",
+    ),
+    "ompi_tpu/coll/pipeline.py": (
+        "_pull_segment",
+    ),
     # the progress sweep runs on every blocking wait iteration; the
     # checkpoint drain tick rides every 8th sweep for the rest of the
     # job once one checkpoint has been taken — neither may allocate

@@ -67,6 +67,20 @@ def render(m: dict, events: int = 8) -> str:
                          f"  p90 {p.get('p90', 0):>9.0f}"
                          f"  p99 {p.get('p99', 0):>9.0f}"
                          f"  (n={total})")
+    # critical-path profiler gauges (DESIGN.md §18): what phase is
+    # eating the dispatch budget right now, and how skewed arrivals are
+    pv = m.get("pvars", {})
+    gating = pv.get("obs_critpath_gating_phase")
+    phase_us = pv.get("obs_critpath_phase_us")
+    if gating or phase_us:
+        skew = pv.get("obs_straggler_skew_us", 0)
+        parts = ""
+        if isinstance(phase_us, dict) and phase_us:
+            parts = "  " + " ".join(
+                f"{k}={v}us" for k, v in sorted(
+                    phase_us.items(), key=lambda kv: -kv[1]))
+        lines.append(f"  critpath: gating={gating or '-'}  "
+                     f"straggler p90 skew {skew} us{parts}")
     evs = m.get("events", [])
     if events > 0:
         lines.append(f"  flight recorder (last {min(events, len(evs))} "

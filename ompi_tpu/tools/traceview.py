@@ -20,8 +20,10 @@ Usage:
     python -m ompi_tpu.tools.traceview trace-r*.json \
         [--sync mpisync.json] [-o merged.json] [--top 5]
 
-Without --sync the raw (uncorrected) clocks are used — fine for
-thread-rank worlds sharing one system clock, wrong across hosts.
+Without --sync the offsets auto-embedded into the dumps at finalize
+(``trace.sync_state`` runs mpisync before the fence) are used; when
+neither is present the raw clocks pass through — fine for thread-rank
+worlds sharing one system clock, wrong across hosts.
 """
 
 from __future__ import annotations
@@ -75,6 +77,19 @@ def load_offsets(path: Optional[str]) -> List[float]:
         raise ValueError(f"{path}: missing offsets_us (not an mpisync "
                          f"summary?)")
     return [float(o) for o in data["offsets_us"]]
+
+
+def embedded_offsets(dumps: List[dict]) -> List[float]:
+    """Per-rank offsets (us) auto-embedded into the dumps at finalize
+    (trace.sync_state runs mpisync before the finalize fence).  The
+    first dump carrying a table wins — every rank embeds the same
+    Bcast-distributed table, so any copy is authoritative.  Empty when
+    the run predates embedding or synced fewer than 2 ranks."""
+    for d in dumps:
+        sync = d.get("mpisync")
+        if sync and sync.get("offsets_us"):
+            return [float(o) for o in sync["offsets_us"]]
+    return []
 
 
 def corrected_events(dumps: List[dict],
@@ -276,7 +291,8 @@ def main(argv=None) -> int:
                     help="per-rank trace dump files (globs ok)")
     ap.add_argument("--sync", default=None,
                     help="mpisync JSON (offsets_us) for clock "
-                         "correction")
+                         "correction (overrides the offsets embedded "
+                         "in the dumps at finalize)")
     ap.add_argument("-o", "--out", default=None,
                     help="write Chrome trace-event JSON here")
     ap.add_argument("--top", type=int, default=5,
@@ -290,7 +306,8 @@ def main(argv=None) -> int:
     opts = ap.parse_args(argv)
 
     dumps = load_dumps(opts.dumps)
-    offsets = load_offsets(opts.sync)
+    offsets = load_offsets(opts.sync) if opts.sync \
+        else embedded_offsets(dumps)
     metrics = None
     if opts.metrics:
         with open(opts.metrics) as fh:

@@ -106,6 +106,27 @@ sample_max_var = registry.register(
     "trace", "", "sample_max", 64, int,
     help="Ceiling for adaptive per-category sampling periods "
          "(keep at least 1-in-N)")
+phase_enable_var = registry.register(
+    "trace", "phase", "enable", False, bool,
+    help="Record sub-op PHASE spans inside traced collectives "
+         "(rendezvous wait, host pack, dispatch, device execute, "
+         "unpack) for tools/critpath.py dispatch-tax attribution.  "
+         "Needs trace_enable; off = one extra attribute check per "
+         "traced op.  Device-execute spans fence with "
+         "block_until_ready on SAMPLED ops only")
+phase_sample_var = registry.register(
+    "trace", "phase", "sample", 1, int,
+    help="Initial 1-in-N sampling period of the 'phase' category "
+         "(1 = record every phase of every op — what critpath wants; "
+         "adaptive sampling still backs busy runs off toward "
+         "trace_sample_max, keeping steady-state cost inside the "
+         "trace budget)")
+sync_rounds_var = registry.register(
+    "trace", "sync", "rounds", 8, int,
+    help="Ping-pong rounds of the finalize-time mpisync measurement "
+         "auto-embedded into trace dumps (multi-rank worlds with "
+         "trace_dump_path set); 0 disables — traceview/critpath then "
+         "need the hand-plumbed --sync file again")
 
 # Fixed log2 latency buckets in microseconds: bucket i holds durations
 # in [2^(i-1), 2^i) us (bucket 0 = sub-microsecond), plus one overflow
@@ -119,8 +140,9 @@ HIST_COLL_DISPATCH = 1
 HIST_P2P_COMPLETE = 2
 HIST_COLL_SEGMENT = 3  # per-segment rendezvous latency (pipeline tier)
 HIST_SERVE_ATTACH = 4  # DVM session-attach latency (tools/dvm)
+HIST_RDV_WAIT = 5      # rendezvous-wait phase (straggler-skew gauge)
 HIST_NAMES = ("progress_tick", "coll_dispatch", "p2p_complete",
-              "coll_segment", "serve_attach")
+              "coll_segment", "serve_attach", "rdv_wait")
 
 
 def bucket_upper_us(b: int) -> float:
@@ -194,10 +216,14 @@ CAT_FT = intern_cat("ft")
 CAT_OOB = intern_cat("oob")
 CAT_FAULT = intern_cat("fault")
 CAT_SERVE = intern_cat("serve", HIST_SERVE_ATTACH)
+# sub-op phase spans (critpath dispatch-tax attribution): NOT bound to
+# a histogram — only the rendezvous-wait phase feeds HIST_RDV_WAIT,
+# via an explicit hist_add at its call sites
+CAT_PHASE = intern_cat("phase")
 
 # categories whose spans are sampled / drop-accounted (pvar surface)
 SPAN_CATS = ("p2p", "coll", "nbc", "coll_dispatch", "coll_segment",
-             "compile")
+             "compile", "phase")
 
 NAME_SEND = intern_name("send", ("cid", "src", "tag", "seq", "bytes"))
 NAME_RECV = intern_name("recv", ("cid", "src", "tag", "seq", "bytes"))
@@ -207,6 +233,29 @@ NAME_SEG_MEET = intern_name("seg_meet", ("cid", "seq", "nbytes"))
 NAME_FUSED_FLUSH = intern_name("fused_flush", ("cid", "ops"))
 NAME_FUSED_PACK = intern_name("fused_pack", ("cid", "groups", "slots"))
 NAME_XLA_COMPILE = intern_name("xla_compile", ("key$",))
+
+# phase-span names share one arg schema: the op correlation keys.
+# (cid, seq) line phases up with their enclosing meet/seg_meet span;
+# critpath additionally attributes by time containment, so sites that
+# cannot know the final seq (pack/unpack of a pipelined segment) pass
+# their best approximation or 0.
+NAME_PH_RDV = intern_name("ph_rdv_wait", ("cid", "seq", "nbytes"))
+NAME_PH_PACK = intern_name("ph_pack", ("cid", "seq", "nbytes"))
+NAME_PH_DISPATCH = intern_name("ph_dispatch", ("cid", "seq", "nbytes"))
+NAME_PH_EXECUTE = intern_name("ph_execute", ("cid", "seq", "nbytes"))
+NAME_PH_UNPACK = intern_name("ph_unpack", ("cid", "seq", "nbytes"))
+
+#: span name -> human phase label (tools/critpath.py keeps its own
+#: copy so it stays runnable against dump files alone)
+PHASE_LABELS = {
+    "ph_rdv_wait": "rendezvous",
+    "ph_pack": "pack",
+    "fused_pack": "pack",
+    "ph_dispatch": "dispatch",
+    "ph_execute": "execute",
+    "ph_unpack": "unpack",
+    "xla_compile": "compile",
+}
 
 _NO_ADAPT = 1 << 62  # _nxt sentinel when adaptation is disabled
 
@@ -267,6 +316,7 @@ class Tracer:
         "_a0", "_a1", "_a2", "_a3", "_a4", "_argobj",
         "_nrec", "_period", "_ctr", "_skipped", "_cnt", "_nxt",
         "_over", "_auto", "_max_period",
+        "phase", "sync_offsets_us",
     )
 
     def __init__(self, rank: int, capacity: int = 8192) -> None:
@@ -299,6 +349,14 @@ class Tracer:
         self._max_period = max(1, int(sample_max_var.value))
         nxt = self._auto if self._auto else _NO_ADAPT
         self._nxt = [nxt] * ncat     # seen-count at next period double
+        # phase spans: single-attribute gate for every instrumented
+        # site (the zero-cost-when-off contract), initial period from
+        # its own knob (trace_sample_spec 'phase:N' still overrides)
+        self.phase = bool(phase_enable_var.value)
+        self._period[CAT_PHASE] = max(1, int(phase_sample_var.value))
+        # mpisync offsets measured at finalize (sync_state) ride the
+        # dump so traceview/critpath need no hand-plumbed --sync file
+        self.sync_offsets_us: Optional[List[float]] = None
         for cid, per in _parse_sample_spec(sample_spec_var.value).items():
             self._ensure_cat(cid)
             self._period[cid] = min(per, self._max_period)
@@ -532,6 +590,25 @@ class Tracer:
             out.append(e)
         return out
 
+    def phase_totals(self) -> Dict[str, int]:
+        """Total recorded microseconds per phase label (plus compile
+        spans, which ARE the compile phase) from the live ring — the
+        obs_critpath_phase_us gauge.  Cold path: pvar reads and the
+        probe harness only."""
+        compile_cid = _cat_ids.get("compile", -1)
+        out: Dict[str, int] = {}
+        for i in self._live_range():
+            if self._ph[i] != 0:
+                continue
+            cid = self._cat[i]
+            if cid == CAT_PHASE or cid == compile_cid:
+                name = _names[self._name[i]]
+                label = PHASE_LABELS.get(name)
+                if label is not None:
+                    out[label] = out.get(label, 0) \
+                        + int(self._dur[i] // 1000)
+        return out
+
     def span_count(self, cat) -> int:
         cid = _cat_ids.get(cat, -1) if isinstance(cat, str) else cat
         n = 0
@@ -562,6 +639,10 @@ class Tracer:
             "hists": {n: list(h) for n, h in zip(HIST_NAMES, self.hists)},
             "events": self.snapshot(),
         }
+        if self.sync_offsets_us is not None:
+            # auto-embedded clock correction (sync_state): traceview
+            # and critpath use it when no --sync file is given
+            doc["mpisync"] = {"offsets_us": list(self.sync_offsets_us)}
         with open(path, "w") as fh:
             json.dump(doc, fh)
 
@@ -609,6 +690,29 @@ def dump_state(state) -> Optional[str]:
     except OSError:
         return None
     return path
+
+
+def sync_state(state) -> None:
+    """Finalize-time mpisync: measure cross-rank clock offsets while
+    the pml is still alive (BEFORE the finalize fence) and stash them
+    on the tracer so every rank's dump carries the correction table —
+    traceview/critpath then merge multi-host timelines with no
+    hand-plumbed --sync file.  Collective (every rank of a dumping
+    world must enter); any failure just leaves the dumps uncorrected,
+    diagnostics never take a rank down."""
+    tr = getattr(state, "tracer", None)
+    rounds = sync_rounds_var.value
+    if tr is None or not dump_var.value or rounds <= 0:
+        return
+    comm = getattr(state, "comm_world", None)
+    if comm is None or comm.size < 2:
+        return
+    try:
+        from ompi_tpu.tools import mpisync
+        table = mpisync.measure_offsets(comm, rounds=rounds)
+        tr.sync_offsets_us = [round(off * 1e6, 3) for off, _rtt in table]
+    except Exception:
+        tr.sync_offsets_us = None
 
 
 def instant_state(state, name: str, cat: str, **args) -> None:
@@ -735,6 +839,12 @@ registry.register_pvar(
     help="DVM service-plane session-attach latency histogram "
          "(log2 us buckets; fed by the pool's global tracer)",
     getter=_tr_hist(HIST_SERVE_ATTACH))
+registry.register_pvar(
+    "trace", "", "hist_rdv_wait", var_class="size",
+    help="Rendezvous-wait phase latency histogram (log2 us buckets; "
+         "fed by the phase profiler's ph_rdv_wait spans — device "
+         "meeting waits and pml RNDV->ACK windows)",
+    getter=_tr_hist(HIST_RDV_WAIT))
 
 
 # -- shared collective/nbc instrumentation points ---------------------------

@@ -1,25 +1,32 @@
-"""Fleet telemetry plane (ompi_tpu/obs + the DVM metrics RPC;
+"""Observability stack (ompi_tpu/obs + the DVM metrics RPC;
 docs/DESIGN.md §16): MPI_T index stability when the obs gauges
 register, ScopedPvar attribution (global == sum of bands, proven both
 as a unit and under four concurrent DVM sessions), flight-recorder
 ring accounting + persistence + the traceview merge, idempotent
 scrape registration across looped worlds, the attach --events and
-ompi_tpu-top operator tools, and the hotpath_audit coverage of the
-scrape tick."""
+ompi_tpu-top operator tools, the hotpath_audit coverage of the
+scrape tick — plus the classic observability surface (merged from the
+old test_observability.py): PERUSE-analog request events, memchecker
+buffer-validity checks, the MPIR-analog proctable + stack attach,
+mpisync clock offsets, pstat /proc pvars, and the notifier sinks."""
 
 import json
 import os
+import subprocess
+import sys
 import threading
 
+import numpy as np
 import pytest
 
-from ompi_tpu import mpit, obs, trace
+from ompi_tpu import memchecker, mpit, obs, peruse, trace
 from ompi_tpu.mca.params import registry
 from ompi_tpu.testing import run_ranks
 from ompi_tpu.tools import traceview
 
 HERE = os.path.dirname(__file__)
 PROG = os.path.join(HERE, "_dvm_session_prog.py")
+REPO = os.path.dirname(HERE)
 
 
 @pytest.fixture(autouse=True)
@@ -452,3 +459,211 @@ def test_traceview_cli_metrics_flag(tmp_path, capsys):
     assert traceview.main([dpath, "--metrics", mpath]) == 0
     out = capsys.readouterr().out
     assert "progress_tick" in out and "p50       256 us" in out
+
+
+# -- classic observability surface (merged from test_observability.py) ------
+
+@pytest.fixture(autouse=True)
+def _clean_peruse():
+    yield
+    peruse.unsubscribe_all()
+    registry.set("opal_memchecker_enable", False)
+
+
+def test_peruse_request_lifecycle_events():
+    events = []
+    for ev in peruse.EVENTS:
+        peruse.subscribe(ev, lambda e, **kw: events.append((e, kw)))
+
+    def fn(comm):
+        x = np.array([comm.rank], np.int64)
+        y = np.empty(1, np.int64)
+        nxt = (comm.rank + 1) % comm.size
+        prv = (comm.rank - 1) % comm.size
+        rq = comm.Irecv(y, prv, tag=5)
+        comm.Send(x, nxt, tag=5)
+        rq.wait()
+
+    run_ranks(2, fn)
+    kinds = {e for e, _ in events}
+    assert "req_activate" in kinds
+    assert "req_complete" in kinds
+    # both send and recv activations observed, with byte counts
+    acts = [kw for e, kw in events if e == "req_activate"]
+    assert {a["kind"] for a in acts} == {"send", "recv"}
+    assert all(a["bytes"] == 8 for a in acts)
+    # a message arriving before its recv is posted queues unexpected
+    assert any(e == "req_match_unex" for e, _ in events) or \
+        any(e == "req_match" for e, _ in events)
+
+
+def test_peruse_disabled_costs_nothing():
+    assert not peruse.enabled
+    fired = []
+    peruse.subscribe("req_complete", lambda e, **kw: fired.append(1))
+    peruse.unsubscribe_all()
+    assert not peruse.enabled
+
+
+def test_memchecker_poisons_recv_buffer():
+    registry.set("opal_memchecker_enable", True)
+
+    def fn(comm):
+        if comm.rank == 0:
+            y = np.zeros(4, np.uint8)
+            rq = comm.Irecv(y, 1, tag=9)
+            # posted but unmatched: buffer must hold the poison
+            # pattern, not stale zeros
+            poisoned = bytes(y) == bytes([memchecker.POISON] * 4)
+            comm.Send(np.zeros(1, np.uint8), 1, tag=8)  # release peer
+            rq.wait()
+            assert bytes(y) == b"\x07\x07\x07\x07"
+            return poisoned
+        comm.Recv(np.empty(1, np.uint8), 0, tag=8)
+        comm.Send(np.full(4, 7, np.uint8), 0, tag=9)
+        return True
+
+    assert all(run_ranks(2, fn))
+
+
+def test_memchecker_catches_modified_send_buffer():
+    registry.set("opal_memchecker_enable", True)
+    big = 1024 * 1024  # above inproc eager limit: rendezvous
+
+    def fn(comm):
+        if comm.rank == 0:
+            x = np.zeros(big, np.uint8)
+            rq = comm.state.pml.isend(
+                x, big, _u8(), 1, 11, comm)
+            x[0] = 99  # illegal: buffer owned by an active request
+            try:
+                while not rq.complete:
+                    comm.state.progress.progress()
+                return False  # memchecker should have raised
+            except RuntimeError as e:
+                return "modified" in str(e)
+        y = np.empty(big, np.uint8)
+        comm.Recv(y, 0, tag=11)
+        return True
+
+    def _u8():
+        from ompi_tpu.datatype import engine as dt
+        return dt.BYTE
+
+    assert all(run_ranks(2, fn))
+
+
+def test_proctable_and_stack_attach():
+    """mpirun publishes the MPIR-analog proctable; attach --stacks
+    makes a hung rank dump its threads."""
+    import tempfile
+    import textwrap
+    import time
+
+    with tempfile.TemporaryDirectory() as d:
+        prog = os.path.join(d, "hang.py")
+        with open(prog, "w") as f:
+            f.write(textwrap.dedent("""
+                import os, sys, time
+                import ompi_tpu
+                comm = ompi_tpu.init()
+                print("SESSION", os.environ["TPUMPI_SESSION_DIR"],
+                      flush=True)
+                time.sleep(30)
+                ompi_tpu.finalize()
+            """))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        p = subprocess.Popen(
+            [sys.executable, "-m", "ompi_tpu.tools.mpirun", "-np", "2",
+             "--timeout", "25", prog],
+            env=env, cwd=REPO, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True)
+        try:
+            session = None
+            for _ in range(200):
+                line = p.stdout.readline()
+                if line.startswith("SESSION"):
+                    session = line.split()[1]
+                    break
+            assert session, "ranks never reported their session dir"
+            table_path = os.path.join(session, "proctable.json")
+            for _ in range(100):
+                if os.path.exists(table_path):
+                    break
+                time.sleep(0.05)
+            table = json.load(open(table_path))
+            assert len(table) == 2
+            assert all("pid" in e and "tag" in e for e in table)
+            # attach --stacks: every rank dumps its stacks to stderr
+            r = subprocess.run(
+                [sys.executable, "-m", "ompi_tpu.tools.attach",
+                 session, "--stacks"],
+                capture_output=True, text=True, timeout=30, env=env,
+                cwd=REPO)
+            assert r.returncode == 0, r.stderr
+            assert "signalled 2/2" in r.stdout
+        finally:
+            p.terminate()
+            out, err = p.communicate(timeout=30)
+        # the SIGUSR1 faulthandler wrote tracebacks into job stderr
+        assert "Traceback" in err or "Current thread" in err, err
+
+
+def test_mpisync_reports_offsets():
+    r = subprocess.run(
+        [sys.executable, "-m", "ompi_tpu.tools.mpirun", "-np", "3",
+         "--timeout", "90",
+         os.path.join(REPO, "ompi_tpu", "tools", "mpisync.py"),
+         "--rounds", "10"],
+        capture_output=True, text=True, timeout=150,
+        env={**os.environ,
+             "PYTHONPATH": REPO + os.pathsep
+             + os.environ.get("PYTHONPATH", "")},
+        cwd=REPO)
+    assert r.returncode == 0, r.stderr
+    last = r.stdout.strip().splitlines()[-1]
+    data = json.loads(last)
+    assert len(data["offsets_us"]) == 3
+    assert data["rtts_us"][1] > 0 and data["rtts_us"][2] > 0
+    # same-host clocks: offsets bounded by a loose sanity envelope
+    assert all(abs(o) < 5e6 for o in data["offsets_us"])
+
+
+@pytest.mark.skipif(sys.platform != "linux",
+                    reason="pstat scrapes Linux /proc")
+def test_pstat_snapshot_and_pvars():
+    """opal/mca/pstat analog: /proc stats + live MPI_T pvars."""
+    from ompi_tpu.runtime import pstat
+
+    st = pstat.snapshot()
+    assert st, "Linux /proc scrape failed"
+    assert st["rss_mb"] > 0 and st["threads"] >= 1
+    assert st["utime_s"] >= 0
+
+    def fn(comm):
+        pv = next(p for p in registry.all_pvars()
+                  if p.full_name == f"opal_pstat_rss_mb_r{comm.rank}")
+        return pv.read() > 0
+
+    assert all(run_ranks(2, fn))
+
+
+def test_notifier_file_sink(tmp_path):
+    """orte/mca/notifier analog: events route to configured sinks;
+    default is off."""
+    from ompi_tpu.runtime import notifier
+
+    log = tmp_path / "events.log"
+    registry.set("orte_notifier_sinks", f"file:{log}")
+    try:
+        notifier.notify("error", "job-x", "rank 3 exploded")
+        notifier.notify("bogus-severity", "job-x", "still logged")
+    finally:
+        registry.set("orte_notifier_sinks", "")
+    lines = log.read_text().splitlines()
+    assert len(lines) == 2
+    assert "error job=job-x rank 3 exploded" in lines[0]
+    assert "notice" in lines[1]  # unknown severity mapped to notice
+    # default (empty) sinks: no-op, never raises
+    notifier.notify("error", "job-x", "dropped")

@@ -1,0 +1,171 @@
+"""Perf-regression sentry (bench.py --regress / benchmarks/regress):
+headline parsing with the stdout-tail fallback, the noise-aware
+tolerance model (flat history trips on a 20% drop, a history whose own
+scatter dwarfs the drop does not), trajectory append semantics
+(--dry appends nothing), exit codes, and the tier-1 smoke over the
+repo's REAL BENCH_r* history — which must stay green."""
+
+import json
+import os
+import subprocess
+import sys
+
+from benchmarks import regress
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _round_doc(v):
+    return {"parsed": {"value": v, "unit": "GB/s"}, "tail": ""}
+
+
+def _write_rounds(d, values):
+    for i, v in enumerate(values, start=1):
+        with open(os.path.join(d, f"BENCH_r{i:02d}.json"), "w") as fh:
+            json.dump(_round_doc(v), fh)
+
+
+# -- parsing ----------------------------------------------------------------
+
+def test_round_headline_tail_fallback():
+    """A round whose driver-side parse failed (the r2 failure mode)
+    still yields its headline from the captured stdout tail."""
+    doc = {"parsed": {"value": None},
+           "tail": 'noise\n{"metric": "x", "value": 42.5, '
+                   '"unit": "GB/s"}\ntrailer'}
+    assert regress.round_headline(doc) == 42.5
+    assert regress.round_headline({"parsed": {}, "tail": ""}) is None
+    # nonpositive values are a failed sweep, not a headline
+    assert regress.round_headline(_round_doc(0.0)) is None
+
+
+def test_load_rounds_sorted(tmp_path):
+    _write_rounds(str(tmp_path), [10.0, 20.0, 30.0])
+    rounds = regress.load_rounds(str(tmp_path))
+    assert [n for n, _ in rounds] == [1, 2, 3]
+
+
+# -- the noise model --------------------------------------------------------
+
+def test_flat_history_trips_on_20pct_drop():
+    f = regress.check_metric("headline_busbw_gbs", 59.5,
+                             [74.4, 74.5, 74.3, 74.4])
+    assert f is not None
+    assert f["current"] == 59.5
+    assert f["tolerance"] == 0.1           # the tight base band held
+
+
+def test_flat_history_passes_small_wobble():
+    assert regress.check_metric("headline_busbw_gbs", 71.0,
+                                [74.4, 74.5, 74.3, 74.4]) is None
+
+
+def test_noisy_history_widens_band():
+    """Scatter like the repo's real history (74 -> 10 -> 12) must
+    widen the tolerance: flagging a 'regression' smaller than the
+    noise floor would be a lie."""
+    assert regress.check_metric("headline_busbw_gbs", 11.0,
+                                [74.4, 10.5, 12.3]) is None
+
+
+def test_single_prior_sample_never_judges():
+    assert regress.check_metric("headline_busbw_gbs", 1.0,
+                                [74.4]) is None
+
+
+def test_lower_is_better_absolute_band():
+    # overhead pct: rising beyond median + band regresses
+    f = regress.check_metric("trace_overhead_pct", 9.0, [1.0, 1.2, 0.8])
+    assert f is not None and "ceiling" in f
+    assert regress.check_metric("trace_overhead_pct", 2.5,
+                                [1.0, 1.2, 0.8]) is None
+
+
+# -- evaluate + trajectory --------------------------------------------------
+
+def test_synthetic_regression_exits_nonzero(tmp_path):
+    """The ISSUE acceptance case: flat history, newest round 20% down
+    -> exit 1 with a finding on stderr-facing JSON."""
+    _write_rounds(str(tmp_path), [74.4, 74.5, 74.3, 74.4, 59.5])
+    detail = str(tmp_path / "BENCH_DETAIL.json")
+    rc = regress.run_regress(str(tmp_path), detail, dry=True)
+    assert rc == 1
+
+
+def test_green_history_exits_zero_and_appends(tmp_path):
+    _write_rounds(str(tmp_path), [74.4, 74.5, 74.3, 74.2])
+    detail = str(tmp_path / "BENCH_DETAIL.json")
+    with open(detail, "w") as fh:
+        json.dump({"trace_overhead": {"overhead_pct": 1.0}}, fh)
+    rc = regress.run_regress(str(tmp_path), detail, dry=False)
+    assert rc == 0
+    doc = json.loads(open(detail).read())
+    traj = doc["regress_trajectory"]
+    assert len(traj) == 1
+    assert traj[0]["round"] == 4
+    assert traj[0]["metrics"]["headline_busbw_gbs"] == 74.2
+    assert traj[0]["metrics"]["trace_overhead_pct"] == 1.0
+    # other sections survive the read-modify-write
+    assert doc["trace_overhead"]["overhead_pct"] == 1.0
+
+
+def test_dry_appends_nothing(tmp_path):
+    _write_rounds(str(tmp_path), [74.4, 74.5, 74.3])
+    detail = str(tmp_path / "BENCH_DETAIL.json")
+    with open(detail, "w") as fh:
+        json.dump({}, fh)
+    assert regress.run_regress(str(tmp_path), detail, dry=True) == 0
+    assert "regress_trajectory" not in json.loads(open(detail).read())
+
+
+def test_probe_metric_regression_via_trajectory(tmp_path):
+    """Probe metrics compare against the recorded trajectory, not the
+    BENCH_r files: a segring busbw collapse trips the sentry."""
+    _write_rounds(str(tmp_path), [74.4, 74.5, 74.3])
+    detail = str(tmp_path / "BENCH_DETAIL.json")
+    traj = [{"round": i, "metrics":
+             {"pipeline_segring_busbw_gbs": 10.0 + 0.1 * i}}
+            for i in range(3)]
+    with open(detail, "w") as fh:
+        json.dump({"regress_trajectory": traj,
+                   "probe_pipeline": {"busbw_gbs": {
+                       "segring": {"65536": 2.0, "262144": 3.0}}}}, fh)
+    rc = regress.run_regress(str(tmp_path), detail, dry=True)
+    assert rc == 1                          # 3.0 << 10.x median
+
+
+def test_no_history_is_config_error(tmp_path):
+    assert regress.run_regress(
+        str(tmp_path), str(tmp_path / "BENCH_DETAIL.json"),
+        dry=True) == 2
+
+
+def test_trajectory_capped(tmp_path):
+    detail = str(tmp_path / "BENCH_DETAIL.json")
+    with open(detail, "w") as fh:
+        json.dump({"regress_trajectory":
+                   [{"round": i, "metrics": {}}
+                    for i in range(regress.TRAJECTORY_CAP)]}, fh)
+    regress.append_trajectory(detail, {"round": 999, "metrics": {}})
+    traj = json.loads(open(detail).read())["regress_trajectory"]
+    assert len(traj) == regress.TRAJECTORY_CAP
+    assert traj[-1]["round"] == 999
+
+
+# -- the tier-1 smoke over the real repo history ----------------------------
+
+def test_bench_regress_dry_smoke_real_history():
+    """``bench.py --regress --dry`` over the repo's own BENCH_r*
+    history: parses, judges, appends nothing, and stays GREEN — the
+    real history's scatter is noise, not a regression (the ISSUE
+    acceptance bar)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--regress", "--dry"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120)
+    assert p.returncode == 0, p.stdout + p.stderr
+    line = json.loads(p.stdout.strip().splitlines()[-1])
+    assert line["unit"] == "regressions"
+    assert line["value"] == 0
+    assert line["dry"] is True
