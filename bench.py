@@ -191,6 +191,18 @@ def main() -> None:
                          "'probe_fleet' in BENCH_DETAIL.json, and "
                          "FAIL (exit 1) if any of the three "
                          "invariants breaks")
+    ap.add_argument("--probe-rma", action="store_true",
+                    help="Measure one-sided RMA for BOTH osc "
+                         "components (device vs pt2pt host-AM): "
+                         "OSU-style put/get busbw ladders, accumulate "
+                         "rate and fetch_and_op latency; persist "
+                         "under 'probe_rma' in BENCH_DETAIL.json, "
+                         "and FAIL (exit 1) if device put/get busbw "
+                         "is not >=5x pt2pt at the 1 MiB tier")
+    ap.add_argument("--rma-max-bytes", type=int, default=None,
+                    help="Cap the --probe-rma size ladder (the full "
+                         "64 MiB curve wants real accelerator "
+                         "memory; the default fits a CI box)")
     ap.add_argument("--regress", action="store_true",
                     help="Perf-regression sentry: pure file analysis "
                          "of the BENCH_r*/BENCH_DETAIL history (no "
@@ -318,6 +330,38 @@ def main() -> None:
         line.update({k: v for k, v in notes.items() if "error" in k})
         sys.stderr.write(json.dumps(probe, indent=1) + "\n")
         print(json.dumps(line))
+        return
+
+    if opts.probe_rma:
+        from benchmarks.probe_rma import (DEFAULT_MAX_BYTES, persist,
+                                          run_probe)
+
+        probe = run_probe(
+            max_bytes=opts.rma_max_bytes or DEFAULT_MAX_BYTES)
+        notes = persist(probe, detail_path)
+        mib = str(1 << 20)
+        comps = probe["components"]
+        line = {
+            "metric": f"osc put/get busbw at 1 MiB, "
+                      f"{probe['nranks']} ranks, device vs pt2pt",
+            "value": {c: {"put": comps[c]["put_busbw_gbs"].get(mib),
+                          "get": comps[c]["get_busbw_gbs"].get(mib)}
+                      for c in comps},
+            "unit": "GB/s_busbw",
+            "put_ratio": probe["put_ratio_device_over_pt2pt"].get(mib),
+            "get_ratio": probe["get_ratio_device_over_pt2pt"].get(mib),
+            "device_5x_at_1mib": probe["device_5x_at_1mib"],
+        }
+        line.update({k: v for k, v in notes.items() if "error" in k})
+        sys.stderr.write(json.dumps(probe, indent=1) + "\n")
+        print(json.dumps(line))
+        if not probe["device_5x_at_1mib"]:
+            # the ISSUE acceptance gate: a device-memory window must
+            # beat the host-AM component where it claims to
+            sys.stderr.write(
+                "FAIL: device osc busbw is not >=5x pt2pt at the "
+                "1 MiB tier\n")
+            sys.exit(1)
         return
 
     if opts.probe_recovery:
@@ -635,7 +679,8 @@ def main() -> None:
                                     "probe_recovery", "probe_respawn",
                                     "probe_pipeline", "probe_ckpt",
                                     "probe_serve", "probe_obs",
-                                    "probe_fleet", "regress_trajectory")
+                                    "probe_fleet", "probe_rma",
+                                    "regress_trajectory")
                           if isinstance(prior, dict) and k in prior},
                        "device_us": dev, "software_us": sw,
                        "software_tuned_tcp_us": sw_tcp,

@@ -1,0 +1,216 @@
+"""--probe-rma microbench: OSU-style one-sided ladders for BOTH osc
+components — put/get busbw over the 64 KiB .. 64 MiB size ladder
+(CI default caps at 4 MiB), accumulate rate, fetch_and_op latency —
+device (HBM shards, whole-mesh kernels) versus pt2pt (host AM over
+the pml).
+
+One thread-rank device world runs both components: the pt2pt side is
+forced with ``--mca osc pt2pt`` (``registry.set``) plus a per-comm
+``_osc_pick`` drop, exactly the override path users have, so the
+probe measures the same selection machinery it benchmarks.  Rank 0
+is the origin; every other rank is parked in a Barrier whose wait
+loop drives progress, so the pt2pt target still applies AMs — and
+the device side needs no target participation at all, which is the
+point.
+
+put/get busbw is the unidirectional OSU convention nbytes*reps/t,
+with OSU's windowed issue (osu_put_bw posts a window of 64 ops per
+sync; we use 32) — the flush that completes the window is inside the
+timed region, so deferred-completion paths pay their copy where OSU
+would charge it.  Each window is timed individually and the MEDIAN
+is reported, as in probe_pipeline.  Results persist under ``probe_rma`` in
+BENCH_DETAIL.json (read-modify-write) and feed --regress through
+``rma_*`` metrics.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, List
+
+SIZES = tuple((64 << 10) * 4 ** k for k in range(6))  # 64K .. 64M
+DEFAULT_MAX_BYTES = 4 << 20
+
+COMPONENTS = ("device", "pt2pt")
+
+
+def _median_us(samples: List[float]) -> float:
+    samples = sorted(samples)
+    mid = len(samples) // 2
+    med = samples[mid] if len(samples) % 2 else \
+        (samples[mid - 1] + samples[mid]) / 2
+    return med * 1e6
+
+
+def _page_aligned(nbytes: int, seed: int):
+    """Random payload in a page-aligned buffer — the OSU benchmark
+    convention (posix_memalign to page size), and what lets the
+    device component's zero-copy put path engage."""
+    import numpy as np
+    raw = np.empty(nbytes + 4096, dtype=np.uint8)
+    off = (-raw.ctypes.data) % 4096
+    buf = raw[off: off + nbytes]
+    buf[:] = np.random.default_rng(seed).integers(
+        0, 256, nbytes, dtype=np.uint8)
+    return buf
+
+
+def _force(comm, comp: str) -> None:
+    """Restrict osc selection to one component and drop the cached
+    per-comm verdict (the --mca osc override path)."""
+    from ompi_tpu.mca.params import registry
+    registry.set("osc", "" if comp == "device" else comp)
+    comm.__dict__.pop("_osc_pick", None)
+
+
+def run_probe(nranks: int = 4, reps: int = 32,
+              max_bytes: int = DEFAULT_MAX_BYTES) -> Dict:
+    # the device component needs DISTINCT devices per rank (a window
+    # commits to the comm's mesh): fan the host platform out before
+    # jax initializes.  bench.py never imports jax itself, so a
+    # standalone --probe-rma run always gets here first.
+    if "xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") +
+            f" --xla_force_host_platform_device_count={nranks}")
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from ompi_tpu.testing import run_ranks
+
+    sizes = [nb for nb in SIZES if nb <= max_bytes] or [SIZES[0]]
+
+    def fn(comm):
+        import numpy as np
+        from ompi_tpu import osc
+        from ompi_tpu.mca.params import registry
+        from ompi_tpu.op.op import SUM
+
+        me = comm.rank
+        out: Dict[str, Dict] = {
+            c: {"put_us": {}, "get_us": {},
+                "put_busbw_gbs": {}, "get_busbw_gbs": {}}
+            for c in COMPONENTS}
+        try:
+            for comp in COMPONENTS:
+                for nb in sizes:
+                    _force(comm, comp)
+                    win = osc.allocate(comm, nb, name=f"rma-{comp}")
+                    assert type(win).__name__ == (
+                        "DeviceWindow" if comp == "device" else
+                        "Window"), type(win)
+                    blob = _page_aligned(nb, seed=nb)
+                    r = max(4, min(reps, (256 << 20) // nb))
+                    if me == 0:
+                        win.lock(1, osc.LOCK_SHARED)
+                        for _ in range(2):  # warm: compile + route
+                            win.put(blob, 1)
+                            win.flush(1)
+                        ps: List[float] = []
+                        for _ in range(3):
+                            t0 = time.perf_counter()
+                            for _ in range(r):
+                                win.put(blob, 1)
+                            win.flush(1)
+                            ps.append((time.perf_counter() - t0) / r)
+                        back = np.empty(nb, dtype=np.uint8)
+                        win.get(back, 1)  # warm
+                        gs: List[float] = []
+                        for _ in range(3):
+                            t0 = time.perf_counter()
+                            for _ in range(r):
+                                win.get(back, 1)
+                            gs.append((time.perf_counter() - t0) / r)
+                        win.unlock(1)
+                        assert bytes(back) == bytes(blob), \
+                            f"{comp} {nb}B roundtrip corrupt"
+                        s = str(nb)
+                        pu, gu = _median_us(ps), _median_us(gs)
+                        out[comp]["put_us"][s] = round(pu, 1)
+                        out[comp]["get_us"][s] = round(gu, 1)
+                        out[comp]["put_busbw_gbs"][s] = round(
+                            nb / (pu * 1e-6) / 1e9, 3)
+                        out[comp]["get_busbw_gbs"][s] = round(
+                            nb / (gu * 1e-6) / 1e9, 3)
+                    comm.Barrier()
+                    win.free()
+
+                # small-op ladder: accumulate rate + fetch_and_op
+                # latency (int32: the device component's jitted
+                # typed-kernel path)
+                _force(comm, comp)
+                win = osc.allocate(comm, 64, disp_unit=4,
+                                   name=f"acc-{comp}")
+                one = np.ones(8, dtype=np.int32)
+                res = np.empty(1, dtype=np.int32)
+                if me == 0:
+                    win.lock(1, osc.LOCK_SHARED)
+                    for _ in range(4):
+                        win.accumulate(one, 1, op=SUM)
+                    t0 = time.perf_counter()
+                    for _ in range(200):
+                        win.accumulate(one, 1, op=SUM)
+                    win.flush(1)
+                    dt = time.perf_counter() - t0
+                    out[comp]["acc_rate_kops"] = round(0.2 / dt, 2)
+                    for _ in range(4):
+                        win.fetch_and_op(1, res, 1, op=SUM)
+                    fs = []
+                    for _ in range(64):
+                        t0 = time.perf_counter()
+                        win.fetch_and_op(1, res, 1, op=SUM)
+                        fs.append(time.perf_counter() - t0)
+                    out[comp]["fao_us"] = round(_median_us(fs), 1)
+                    win.unlock(1)
+                comm.Barrier()
+                win.free()
+        finally:
+            registry.set("osc", "")
+            comm.__dict__.pop("_osc_pick", None)
+        return out if me == 0 else None
+
+    res = run_ranks(nranks, fn, devices=True, timeout=1800)
+    data = res[0]
+    probe: Dict = {"nranks": nranks, "sizes": sizes,
+                   "components": data}
+    # the ISSUE acceptance ratios: device over pt2pt busbw per size,
+    # for put and get.  The gate takes the worst of put/get at the
+    # 1 MiB tier (the name says exactly what it checks); the full
+    # curves stay in the JSON — above cache residency a single-stream
+    # host memcpy converges toward DRAM bandwidth and the ratio
+    # honestly narrows.
+    gate: List[float] = []
+    for kind in ("put", "get"):
+        ratios = {}
+        for s in map(str, sizes):
+            p = data["pt2pt"][f"{kind}_busbw_gbs"].get(s)
+            d = data["device"][f"{kind}_busbw_gbs"].get(s)
+            if p and d:
+                ratios[s] = round(d / p, 2)
+                if int(s) == (1 << 20):
+                    gate.append(ratios[s])
+        probe[f"{kind}_ratio_device_over_pt2pt"] = ratios
+    probe["device_5x_at_1mib"] = bool(gate) and min(gate) >= 5.0
+    return probe
+
+
+def persist(probe: Dict, detail_path: str) -> Dict:
+    """Merge under 'probe_rma' in BENCH_DETAIL.json."""
+    notes: Dict = {}
+    try:
+        with open(detail_path) as fh:
+            detail = json.load(fh)
+        if not isinstance(detail, dict):
+            detail = {}
+    except (OSError, ValueError):
+        detail = {}
+    detail["probe_rma"] = probe
+    try:
+        tmp = f"{detail_path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as fh:
+            json.dump(detail, fh, indent=1)
+        os.replace(tmp, detail_path)
+    except OSError as e:
+        notes["detail_error"] = str(e)[:120]
+    return notes

@@ -54,6 +54,12 @@ TOLERANCES: Dict[str, Tuple[str, float]] = {
     "trace_overhead_pct": ("lower", 2.0),
     "obs_overhead_pct": ("lower", 2.0),
     "dispatch_const_us": ("lower", 50.0),
+    # one-sided busbw at the 1 MiB acceptance tier (ISSUE 14): same
+    # noise band as the pipeline curves — thread-rank timing on a
+    # shared host core is jittery, real drops are way past 25%
+    "rma_device_put_busbw_gbs": ("higher", 0.25),
+    "rma_device_get_busbw_gbs": ("higher", 0.25),
+    "rma_pt2pt_put_busbw_gbs": ("higher", 0.25),
 }
 
 
@@ -127,6 +133,16 @@ def _detail_metrics(detail: dict) -> Dict[str, float]:
         if sizes:
             top = max(sizes, key=int)
             out[f"pipeline_{alg}_busbw_gbs"] = float(curve[top])
+    rma = (detail.get("probe_rma") or {}).get("components") or {}
+    mib = str(1 << 20)
+    for comp in ("device", "pt2pt"):
+        for kind in ("put", "get"):
+            if comp == "pt2pt" and kind == "get":
+                continue  # pt2pt get ~= put; three metrics suffice
+            v = ((rma.get(comp) or {}).get(f"{kind}_busbw_gbs")
+                 or {}).get(mib)
+            if isinstance(v, (int, float)) and v > 0:
+                out[f"rma_{comp}_{kind}_busbw_gbs"] = float(v)
     return out
 
 

@@ -11,8 +11,10 @@ shmem.init()
 me, n = shmem.my_pe(), shmem.n_pes()
 counter = shmem.malloc(1, np.int64)
 acc = shmem.malloc(1, np.int64)
-counter.local[0] = 0
-acc.local[0] = 0
+# self-puts, not .local stores: a device heap has no writable host
+# alias, so local initialization goes through the data plane too
+shmem.p(counter, 0, 0, me)
+shmem.p(acc, 0, 0, me)
 shmem.barrier_all()
 
 ticket = shmem.atomic_fetch_inc(counter, 0, 0)  # unique 0..n-1
@@ -27,7 +29,8 @@ if me == 0:
 # every PE got a distinct ticket
 all_t = shmem.malloc(n, np.int64)
 mine = shmem.malloc(1, np.int64)
-mine.local[0] = ticket
+shmem.p(mine, 0, ticket, me)
+shmem.barrier_all()  # complete the self-put before the collective
 shmem.collect(all_t, mine)
 assert sorted(all_t.local.tolist()) == list(range(n))
 shmem.finalize()

@@ -11,7 +11,9 @@ from ompi_tpu import shmem
 shmem.init()
 me, n = shmem.my_pe(), shmem.n_pes()
 flag = shmem.malloc(1, np.int64)
-flag.local[0] = -1
+# self-put, not a .local store: a device heap has no writable host
+# alias, so local initialization goes through the data plane too
+shmem.p(flag, 0, -1, me)
 shmem.barrier_all()
 
 if me == 0:

@@ -564,6 +564,9 @@ _COMM_CACHE_KEYS = (
     # epochs
     "_pipeline_pick", "_hier_eligible", "_hier_plan",
     "_cart_device_mesh",
+    # osc framework: the per-window component verdict keys on the old
+    # mesh (device eligibility), so a shrunk comm must re-decide
+    "_osc_pick",
 )
 
 # the subset safe to purge while a comm stays LIVE: pure routing
@@ -571,7 +574,7 @@ _COMM_CACHE_KEYS = (
 # them online when the calibrate profile moves).  _hier_plan and the
 # rendezvous caches are NOT here — their rebuild is collective
 # (subcomm construction) and may only happen at epoch boundaries.
-SELECTION_CACHE_KEYS = ("_pipeline_pick",)
+SELECTION_CACHE_KEYS = ("_pipeline_pick", "_osc_pick")
 
 
 def purge_comm_caches(comm, keys=_COMM_CACHE_KEYS) -> None:
@@ -599,9 +602,18 @@ def _invalidate(comm) -> None:
     purge_comm_caches(comm)
     world = getattr(comm.state.rte, "world", None)
     if world is not None and hasattr(world, "shared"):
+        group = tuple(comm.group)
         with world.shared_lock:
-            world.shared.pop(
-                ("coll_rv", comm.cid, tuple(comm.group)), None)
+            world.shared.pop(("coll_rv", comm.cid, group), None)
+            # device-osc shard tables of windows on the dying comm:
+            # the shards belong to the old mesh/group and must not be
+            # resurrected by a cid reuse after recovery
+            dead = [k for k in world.shared
+                    if isinstance(k, tuple) and k and
+                    k[0] == "osc_devwin" and k[1] == comm.cid and
+                    k[2] == group]
+            for k in dead:
+                world.shared.pop(k, None)
 
 
 # -- store hygiene ----------------------------------------------------------

@@ -44,7 +44,8 @@ _plan_var = registry.register(
          "class:rate — e.g. 'drop:0.05,sever:0.01'.  Classes: drop, "
          "delay, dup, reorder, corrupt, sever, daemon_kill, "
          "oob_sever, kv_partition, rank_kill, io_stall, io_partial, "
-         "io_enospc, dvm_disconnect.  Empty = framework disabled")
+         "io_enospc, dvm_disconnect, rma_delay.  Empty = framework "
+         "disabled")
 _rate_var = registry.register(
     "ft", "inject", "rate", 0.02, float,
     help="Default per-event injection probability for plan entries "
@@ -94,6 +95,10 @@ RANK_CLASSES = ("rank_kill",)
 # pool, so this exercises the client-death-mid-collective cleanup
 # (the pool must finish or poison ONLY that session, never peers)
 DVM_CLASSES = ("dvm_disconnect",)
+# one-sided RMA faults (osc window AM handler): rma_delay holds the
+# target's active-message apply — lock grants, unlock acks and pt2pt
+# payload application all slow down, surfacing in osc_lock_wait_us
+RMA_CLASSES = ("rma_delay",)
 
 
 def plan() -> Dict[str, float]:
@@ -205,6 +210,26 @@ def coll_injector(rank: int) -> Optional[CollInjector]:
     if not p:
         return None
     return CollInjector("coll", rank, p)
+
+
+class RmaInjector(_Scoped):
+    """AM-handler delay for one-sided windows: a 'rma_delay' roll
+    holds the target's apply loop, so passive-target lock waits and
+    pt2pt op application see slow targets (the osc analog of the
+    coll rendezvous straggler)."""
+
+    def maybe_delay(self) -> float:
+        """Returns seconds the AM apply sleeps (0 = clean)."""
+        if self._roll() == "rma_delay":
+            return max(0, _delay_ms_var.value) / 1000.0
+        return 0.0
+
+
+def rma_injector(rank: int) -> Optional[RmaInjector]:
+    p = {c: r for c, r in plan().items() if c == "rma_delay"}
+    if not p:
+        return None
+    return RmaInjector("rma", rank, p)
 
 
 class IoInjector(_Scoped):

@@ -1,7 +1,30 @@
-"""One-sided RMA (MPI-3 windows) — see window.py."""
+"""One-sided RMA (MPI-3 windows).
 
-from .window import (LOCK_EXCLUSIVE, LOCK_SHARED, Window, allocate,
-                     create)
+Two components behind a real osc framework decision (framework.py):
+``pt2pt`` — host AM over the pml (window.py) — and ``device`` —
+windows backed by device shards on the comm's mesh (device.py).
+``create``/``allocate`` route through ``osc_select``; the host-only
+entry points (dynamic/shared windows) stay pt2pt."""
 
-__all__ = ["Window", "create", "allocate", "LOCK_SHARED",
-           "LOCK_EXCLUSIVE"]
+from .window import (LOCK_EXCLUSIVE, LOCK_SHARED, Window,
+                     allocate_shared, create_dynamic, shared_query)
+from .framework import osc_framework, osc_select
+
+
+def create(comm, memory, disp_unit=None, name: str = "", info=None):
+    """MPI_Win_create through component selection: a device-committed
+    buffer on a mesh-capable comm gets the device window."""
+    return _fw.win_create(comm, memory, disp_unit, name, info)
+
+
+def allocate(comm, nbytes: int, disp_unit: int = 1, name: str = ""):
+    """MPI_Win_allocate through component selection: mints a
+    mesh-committed shard when the comm has a device mesh."""
+    return _fw.win_allocate(comm, nbytes, disp_unit, name)
+
+
+from ompi_tpu.osc import framework as _fw  # noqa: E402
+
+__all__ = ["Window", "create", "allocate", "create_dynamic",
+           "allocate_shared", "shared_query", "osc_framework",
+           "osc_select", "LOCK_SHARED", "LOCK_EXCLUSIVE"]

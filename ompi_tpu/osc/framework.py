@@ -1,0 +1,123 @@
+"""osc framework: per-window component selection (``osc_select``).
+
+Mirrors the coll framework's device reroute at window granularity
+(ref: ompi/mca/osc/base/osc_base_init.c ompi_osc_base_select — every
+component is queried per window and the highest priority wins):
+
+    device   priority 40   the window COMMITS TO THE MESH — either
+                           Win_create over a device-committed buffer
+                           or Win_allocate minting one — and the
+                           comm's ranks own distinct devices
+    pt2pt    priority 10   always usable (host AM over the pml)
+
+``--mca osc <list>`` (``registry.set("osc", "pt2pt")``) restricts the
+candidates exactly like ``--mca coll``.  The verdict is cached per
+comm under ``comm.__dict__["_osc_pick"]`` and registered in
+``ulfm.SELECTION_CACHE_KEYS`` so shrink/respawn epochs re-decide.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ompi_tpu.mca.base import Component, frameworks
+from ompi_tpu.mca.params import registry
+
+osc_framework = frameworks.create("ompi", "osc")
+
+
+def _is_device_committed(memory) -> bool:
+    """True when the window memory is already a device array (the
+    Win_create-over-hbm case)."""
+    if memory is None or isinstance(memory, np.ndarray):
+        return False
+    from ompi_tpu.coll.device import _is_jax_array
+    return _is_jax_array(memory)
+
+
+class Pt2ptComponent(Component):
+    name = "pt2pt"
+    priority = 10
+
+    def register_params(self, framework) -> None:
+        self._pri_var = registry.register(
+            "osc", "pt2pt", "priority", 10, int,
+            help="Selection priority of the host AM osc component")
+
+    def query(self, comm, memory, mint):  # noqa: ARG002
+        return (self._pri_var.value, self)
+
+    def build(self, comm, memory, disp_unit, name, info, mint):
+        from ompi_tpu.osc import window as _w
+        if mint:
+            return _w.allocate(comm, memory, disp_unit or 1, name)
+        if memory is not None and not isinstance(memory, np.ndarray):
+            # device buffer routed here by --mca osc pt2pt: snapshot
+            # to host so the AM window still works
+            memory = np.ascontiguousarray(np.asarray(memory))
+        if disp_unit is None:
+            disp_unit = memory.dtype.itemsize \
+                if memory is not None and memory.size else 1
+        return _w.Window(comm, memory, disp_unit, name, info=info)
+
+
+class DeviceComponent(Component):
+    name = "device"
+    priority = 40
+
+    def register_params(self, framework) -> None:
+        self._pri_var = registry.register(
+            "osc", "device", "priority", 40, int,
+            help="Selection priority of the device-memory osc "
+                 "component (wins when the window commits to the "
+                 "comm's mesh)")
+
+    def query(self, comm, memory, mint):
+        if comm.mesh() is None:
+            return None
+        if not mint and not _is_device_committed(memory):
+            return None
+        return (self._pri_var.value, self)
+
+    def build(self, comm, memory, disp_unit, name, info, mint):
+        from ompi_tpu.osc import device as _d
+        if mint:
+            return _d.allocate(comm, memory, disp_unit or 1, name)
+        if disp_unit is None:
+            itemsize = getattr(
+                getattr(memory, "dtype", None), "itemsize", 1)
+            disp_unit = itemsize if getattr(memory, "size", 0) else 1
+        return _d.DeviceWindow(comm, memory, disp_unit, name, info=info)
+
+
+osc_framework.add_component(Pt2ptComponent())
+osc_framework.add_component(DeviceComponent())
+
+
+def osc_select(comm, memory=None, mint: bool = False) -> Component:
+    """The per-window component decision, cached per (mint, committed)
+    shape on the comm (ulfm purges ``_osc_pick`` across epochs)."""
+    pick = comm.__dict__.get("_osc_pick")
+    if pick is None:
+        pick = {}
+        comm.__dict__["_osc_pick"] = pick
+    key = (bool(mint), _is_device_committed(memory))
+    comp = pick.get(key)
+    if comp is None:
+        comp, _payload = osc_framework.select_one(comm, memory, mint)
+        pick[key] = comp
+    return comp
+
+
+def win_create(comm, memory, disp_unit=None, name: str = "",
+               info=None):
+    comp = osc_select(comm, memory, mint=False)
+    return comp.build(comm, memory, disp_unit, name, info, mint=False)
+
+
+def win_allocate(comm, nbytes: int, disp_unit: int = 1,
+                 name: str = ""):
+    comp = osc_select(comm, None, mint=True)
+    return comp.build(comm, nbytes, disp_unit, name, None, mint=True)

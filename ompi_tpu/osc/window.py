@@ -23,12 +23,15 @@ messages serially in the target's progress loop.
 
 from __future__ import annotations
 
+import time
 from collections import deque
 from typing import Any, Deque, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ompi_tpu import obs as _obs
 from ompi_tpu.datatype import engine as dtmod
+from ompi_tpu.mca.params import registry
 from ompi_tpu.op import op as opmod
 
 # message types
@@ -61,6 +64,28 @@ _WIRE_DTYPES = [np.dtype(t) for t in (
     np.int64, np.uint64, np.float32, np.float64, np.complex64,
     np.complex128, np.bool_)]
 _DT_CODE = {dt: i for i, dt in enumerate(_WIRE_DTYPES)}
+
+
+# osc pvar surface, shared by BOTH components (pt2pt and device):
+# band-scoped so dvm sessions get exact per-session attribution
+pv_puts = _obs.scoped_pvar(
+    "osc", "", "puts", help="RMA put/rput operations issued")
+pv_gets = _obs.scoped_pvar(
+    "osc", "", "gets", help="RMA get/rget operations issued")
+pv_accs = _obs.scoped_pvar(
+    "osc", "", "accs",
+    help="RMA accumulate/get_accumulate/fetch_and_op operations issued")
+pv_cas = _obs.scoped_pvar(
+    "osc", "", "cas", help="RMA compare_and_swap operations issued")
+pv_bytes_put = _obs.scoped_pvar(
+    "osc", "", "bytes_put", help="Origin bytes moved by put/rput")
+pv_bytes_got = _obs.scoped_pvar(
+    "osc", "", "bytes_got", help="Origin bytes moved by get/rget")
+pv_lock_wait = registry.register_pvar(
+    "osc", "", "lock_wait_us", var_class="highwatermark",
+    help="Worst time (us) an origin waited for a passive-target lock "
+         "grant — contention and rma_delay injection both surface "
+         "here")
 
 
 def _op_code(op: opmod.Op) -> int:
@@ -127,6 +152,11 @@ class Window:
         self._start_group: Optional[List[int]] = None
         self._freed = False
         self._progress = base.state.progress
+        try:
+            from ompi_tpu import ft_inject as _fi
+            self._inj = _fi.rma_injector(base.rank)
+        except Exception:  # noqa: BLE001 — fault plan optional
+            self._inj = None
         self._post_hdr_recv()
         self._progress.register(self._am_progress)
         base.Barrier()  # window exists everywhere before any op
@@ -238,6 +268,10 @@ class Window:
 
     def _apply(self, hdr: np.ndarray, src: int,
                payload: Optional[np.ndarray]) -> None:
+        if self._inj is not None:
+            d = self._inj.maybe_delay()
+            if d:
+                time.sleep(d)  # ft_inject rma_delay: slow AM handler
         mtype = int(hdr[0])
         origin, disp, count = int(hdr[1]), int(hdr[2]), int(hdr[3])
         dtnum, opcode = int(hdr[4]), int(hdr[5])
@@ -362,11 +396,14 @@ class Window:
         a, count, code = self._as_wire(arr)
         self._send_hdr(target, PUT, disp, count, code, payload=a)
         self._ops_sent[target] += 1
+        band = _obs.current_band()
+        pv_puts.add(1, band)
+        pv_bytes_put.add(a.nbytes, band)
 
     def get(self, arr, target: int, disp: int = 0) -> None:
         """Fills `arr` (completes before return — stronger than MPI
         requires; rget gives the deferred form)."""
-        self.rget(arr, target, disp).wait()
+        self._wait_req(self.rget(arr, target, disp))
 
     def rget(self, arr, target: int, disp: int = 0):
         self._check_target(target)
@@ -380,6 +417,9 @@ class Window:
         self._send_hdr(target, GET, disp, arr.size, code, reply_tag=tag)
         self._ops_sent[target] += 1
         self._out_reqs.append(req)
+        band = _obs.current_band()
+        pv_gets.add(1, band)
+        pv_bytes_got.add(arr.nbytes, band)
         return req
 
     def accumulate(self, arr, target: int, disp: int = 0,
@@ -389,6 +429,7 @@ class Window:
         self._send_hdr(target, ACC, disp, count, code, _op_code(op),
                        payload=a)
         self._ops_sent[target] += 1
+        pv_accs.add(1, _obs.current_band())
 
     # request-form RMA (ref: ompi/mpi/c/rput.c, raccumulate.c): the AM
     # payload is snapshotted at issue, so local completion is
@@ -418,11 +459,12 @@ class Window:
         self._send_hdr(target, GET_ACC, disp, count, code, _op_code(op),
                        reply_tag=tag, payload=a)
         self._ops_sent[target] += 1
+        pv_accs.add(1, _obs.current_band())
         return req
 
     def get_accumulate(self, arr, result: np.ndarray, target: int,
                        disp: int = 0, op: opmod.Op = opmod.SUM) -> None:
-        self.rget_accumulate(arr, result, target, disp, op).wait()
+        self._wait_req(self.rget_accumulate(arr, result, target, disp, op))
 
     def fetch_and_op(self, value, result: np.ndarray, target: int,
                      disp: int = 0, op: opmod.Op = opmod.SUM) -> None:
@@ -444,9 +486,34 @@ class Window:
         self._send_hdr(target, CAS, disp, 1, code, reply_tag=tag,
                        payload=payload)
         self._ops_sent[target] += 1
-        req.wait()
+        pv_cas.add(1, _obs.current_band())
+        self._wait_req(req)
 
     # -- synchronization -------------------------------------------------
+
+    def _check_alive(self) -> None:
+        """Raise ERR_PROC_FAILED / ERR_REVOKED instead of spinning
+        when a peer of the window's comm died or the epoch was
+        revoked — every blocking RMA wait loop polls this, so a
+        window on a dead comm raises rather than hangs."""
+        ulfm = self.state.ulfm
+        if ulfm is not None and ulfm.active:
+            ulfm.poll()
+            ulfm.check_comm(self.comm)
+
+    def _wait_req(self, req) -> None:
+        """Reply/ack wait that stays failure-aware: a peer death
+        error-completes the request (or surfaces via check_comm), and
+        either way the caller gets an exception, never a hang."""
+        while not req.complete:
+            self._check_alive()
+            if self._progress.progress() == 0:
+                self._progress.idle_tick()
+        if getattr(req.status, "error", 0):
+            from ompi_tpu import errhandler as _eh
+            raise _eh.MPIException(
+                _eh.ERR_PROC_FAILED,
+                "RMA peer failed while a reply was outstanding")
 
     def _drain_out(self) -> None:
         for r in self._out_reqs:
@@ -455,12 +522,14 @@ class Window:
 
     def _wait_applied(self, goal: int) -> None:
         while self._applied_total < goal:
+            self._check_alive()
             if self._progress.progress() == 0:
                 self._progress.idle_tick()
 
     def fence(self) -> None:
         """Collective epoch boundary (osc/pt2pt fence: alltoall the
         per-target op counts, wait for the cumulative expectation)."""
+        self._check_alive()
         counts = self._ops_sent.copy()
         expected = np.empty(self.size, dtype=np.int64)
         self.comm.Alltoall(counts, expected)
@@ -475,13 +544,15 @@ class Window:
         tag = self._new_reply_tag()
         buf, req = self._recv_reply(0, target, tag)
         self._send_hdr(target, LOCK, opcode=mode, reply_tag=tag)
-        req.wait()
+        t0 = time.perf_counter()
+        self._wait_req(req)
+        pv_lock_wait.update_max(int((time.perf_counter() - t0) * 1e6))
 
     def unlock(self, target: int) -> None:
         tag = self._new_reply_tag()
         buf, req = self._recv_reply(0, target, tag)
         self._send_hdr(target, UNLOCK, reply_tag=tag)
-        req.wait()  # ack ⇒ every prior op at this target is applied
+        self._wait_req(req)  # ack ⇒ every prior op at target applied
         self._drain_out()
         # _ops_sent is NOT reset: fence counting must stay consistent
         # with the target's _applied_total, which includes passive ops
@@ -498,7 +569,7 @@ class Window:
         tag = self._new_reply_tag()
         buf, req = self._recv_reply(0, target, tag)
         self._send_hdr(target, FLUSH, reply_tag=tag)
-        req.wait()
+        self._wait_req(req)
 
     def flush_all(self) -> None:
         for t in range(self.size):
@@ -519,6 +590,7 @@ class Window:
         self._start_group = list(group_ranks)
         while any(self._pscw_posted.get(t, 0) < 1
                   for t in self._start_group):
+            self._check_alive()
             if self._progress.progress() == 0:
                 self._progress.idle_tick()
         for t in self._start_group:
@@ -543,6 +615,7 @@ class Window:
         need = {o: 1 for o in self._post_group}
         while any(self._pscw_complete.get(o, 0) < n
                   for o, n in need.items()):
+            self._check_alive()
             if self._progress.progress() == 0:
                 self._progress.idle_tick()
         for o in need:

@@ -10,6 +10,13 @@ is the window's memory**, scoll reuses the per-communicator coll
 stack, and remote atomics are window fetch-ops (applied serially in
 the target's progress loop — the atomic/basic contract).
 
+The backing window comes from real osc component selection: on a
+mesh-capable comm ``osc.allocate`` mints a device-committed shard, so
+the symmetric heap lives in HBM and puts/gets/atomics lower to the
+device component's one-sided kernels (``ctx.device`` is True, the
+heap has no host alias, and ``SymArray.local`` is a read-only
+snapshot); otherwise it is the host AM window over a numpy heap.
+
 Symmetry: every PE performs the same allocation sequence
 (shmem_malloc is collective in OpenSHMEM), so a deterministic
 first-fit allocator yields identical offsets everywhere — a remote
@@ -60,9 +67,10 @@ class SymArray:
 
     @property
     def local(self) -> np.ndarray:
-        """My PE's backing memory (writable view into the heap)."""
-        raw = self.ctx.heap[self.offset: self.offset + self.nbytes]
-        return raw.view(self.dtype).reshape(self.shape)
+        """My PE's backing memory: a writable view into the host heap,
+        or a read-only snapshot of the device shard (a device heap has
+        no live host alias — stores go through put/atomics)."""
+        return self.ctx._read_sym(self)
 
     def _disp(self, index: int = 0) -> int:
         return self.offset + index * self.dtype.itemsize
@@ -73,13 +81,19 @@ class ShmemCtx:
 
     def __init__(self, comm=None, heap_size: Optional[int] = None) -> None:
         import ompi_tpu
-        from ompi_tpu.osc import window as oscmod
+        from ompi_tpu import osc as oscmod
 
         self.comm = comm if comm is not None else ompi_tpu.init()
         self.heap_size = heap_size or _heap_var.value
-        self.heap = np.zeros(self.heap_size, dtype=np.uint8)
-        self.win = oscmod.Window(self.comm, self.heap, disp_unit=1,
-                                 name="shmem-heap")
+        # sshmem backing segment through real osc selection: a
+        # mesh-capable comm mints a device-committed shard, so the
+        # symmetric heap LIVES in device memory and every put/get/
+        # atomic below lowers to the device component's kernels;
+        # otherwise the host AM window over a numpy heap, as before
+        self.win = oscmod.allocate(self.comm, self.heap_size,
+                                   disp_unit=1, name="shmem-heap")
+        self.device = hasattr(self.win, "read_local")
+        self.heap = None if self.device else self.win.memory
         self.win.lock_all()  # passive epoch for the life of the ctx
         # MCA-selected components: the memheap allocator (buddy by
         # default, ref oshmem/mca/memheap/buddy) and the scoll module
@@ -113,6 +127,30 @@ class ShmemCtx:
 
     def free(self, arr: SymArray) -> None:
         self.memheap.free(arr.offset)
+
+    # -- local symmetric-memory access ----------------------------------
+    # Host heap: the block is a live writable numpy view.  Device heap:
+    # the block is rank-local HBM behind the window — reads are jitted
+    # local slices (Window.read_local) and writes are self-puts, so
+    # they serialize with remote ops under the same window machinery.
+    def _read_sym(self, arr: SymArray) -> np.ndarray:
+        if self.heap is not None:
+            raw = self.heap[arr.offset: arr.offset + arr.nbytes]
+            return raw.view(arr.dtype).reshape(arr.shape)
+        raw = self.win.read_local(arr.offset, arr.nbytes)
+        out = raw.view(arr.dtype).reshape(arr.shape)
+        out.flags.writeable = False
+        return out
+
+    def _write_sym(self, arr: SymArray, values) -> None:
+        a = np.ascontiguousarray(
+            np.asarray(values, dtype=arr.dtype)).reshape(-1)
+        self._check_fit(arr, a.nbytes)
+        if self.heap is not None:
+            self._read_sym(arr).reshape(-1)[: a.size] = a
+            return
+        self.win.put(a, self.comm.rank, disp=arr.offset)
+        self.win.flush_local(self.comm.rank)
 
     # -- spml data plane (ref: oshmem/mca/spml) -------------------------
     @staticmethod
@@ -291,7 +329,10 @@ class ShmemCtx:
             return None
         peer_ctx = getattr(world, "shared", {}).get(
             ("shmem_ctx", self.comm.cid, self.comm.group[pe]))
-        if peer_ctx is None:
+        if peer_ctx is None or peer_ctx.heap is None:
+            # device heaps have no host alias to hand out (the
+            # reference likewise returns NULL without a mapped
+            # segment); use put/get
             return None
         raw = peer_ctx.heap[arr.offset: arr.offset + arr.nbytes]
         return raw.view(arr.dtype).reshape(arr.shape)

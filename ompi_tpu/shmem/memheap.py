@@ -9,7 +9,13 @@ requires every PE to compute the same offset from the same call
 sequence.
 
 State is capturable (checkpoint/restart snapshots the allocator
-alongside the heap bytes)."""
+alongside the heap bytes).
+
+The allocator is storage-agnostic: it deals in OFFSETS only, so the
+same component serves a host heap (numpy segment behind the pt2pt
+window) and a device heap (HBM shard behind osc/device, where the
+offsets feed ``Window.put/get`` displacements and ``read_local``
+slices instead of pointer math)."""
 
 from __future__ import annotations
 

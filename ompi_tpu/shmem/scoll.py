@@ -22,7 +22,11 @@ scoll_framework = frameworks.create("shmem", "scoll")
 
 class MpiScollModule:
     """PE collectives delegated to the context comm's merged coll
-    vtable (scoll/mpi analog)."""
+    vtable (scoll/mpi analog).  Symmetric blocks are staged through
+    the ctx accessors rather than touched as live views: a device
+    heap has no writable host alias, so results land via
+    ``ctx._write_sym`` (a self-put on the window) and sources come
+    from ``ctx._read_sym`` (a heap view or a jitted local slice)."""
 
     name = "mpi"
 
@@ -34,26 +38,32 @@ class MpiScollModule:
 
     def broadcast(self, dest, src, root: int) -> None:
         comm = self.ctx.comm
-        buf = src.local.copy() if comm.rank == root \
+        buf = np.array(self.ctx._read_sym(src)) if comm.rank == root \
             else np.empty(src.shape, dtype=src.dtype)
         comm.Bcast(buf, root=root)
-        dest.local[...] = buf
+        self.ctx._write_sym(dest, buf)
 
     def collect(self, dest, src) -> None:
         """fcollect: concatenation of every PE's src block."""
+        out = np.empty(dest.shape, dtype=dest.dtype).reshape(-1)
         self.ctx.comm.Allgather(
-            np.ascontiguousarray(src.local.reshape(-1)),
-            dest.local.reshape(-1))
+            np.ascontiguousarray(self.ctx._read_sym(src).reshape(-1)),
+            out)
+        self.ctx._write_sym(dest, out)
 
     def alltoall(self, dest, src) -> None:
+        out = np.empty(dest.shape, dtype=dest.dtype).reshape(-1)
         self.ctx.comm.Alltoall(
-            np.ascontiguousarray(src.local.reshape(-1)),
-            dest.local.reshape(-1))
+            np.ascontiguousarray(self.ctx._read_sym(src).reshape(-1)),
+            out)
+        self.ctx._write_sym(dest, out)
 
     def to_all(self, dest, src, op) -> None:
+        out = np.empty(dest.shape, dtype=dest.dtype).reshape(-1)
         self.ctx.comm.Allreduce(
-            np.ascontiguousarray(src.local.reshape(-1)),
-            dest.local.reshape(-1), op)
+            np.ascontiguousarray(self.ctx._read_sym(src).reshape(-1)),
+            out, op)
+        self.ctx._write_sym(dest, out)
 
 
 class MpiScollComponent(Component):

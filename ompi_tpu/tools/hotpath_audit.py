@@ -83,6 +83,16 @@ HOT_FUNCTIONS: Dict[str, Tuple[str, ...]] = {
     "ompi_tpu/serve/controller.py": (
         "FleetController.tick",
     ),
+    # device-osc data-plane entries (ISSUE 14): the trace/pvar shells
+    # around every one-sided op — a sampled span start, the impl call,
+    # and integer pvar adds.  All argument building (bucket keys,
+    # padded staging, kernel lookups) lives in the _impl tier below
+    # these, off the audited path
+    "ompi_tpu/osc/device.py": (
+        "DeviceWindow.put",
+        "DeviceWindow.get",
+        "DeviceWindow._acc_entry",
+    ),
 }
 
 _BANNED_BUILTIN_CALLS = ("dict", "list", "set", "tuple", "frozenset")

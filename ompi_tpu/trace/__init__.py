@@ -220,10 +220,13 @@ CAT_SERVE = intern_cat("serve", HIST_SERVE_ATTACH)
 # a histogram — only the rendezvous-wait phase feeds HIST_RDV_WAIT,
 # via an explicit hist_add at its call sites
 CAT_PHASE = intern_cat("phase")
+# one-sided ops (osc put/get/accumulate) — both the host AM component
+# and the device ppermute component stamp the same category
+CAT_RMA = intern_cat("rma")
 
 # categories whose spans are sampled / drop-accounted (pvar surface)
 SPAN_CATS = ("p2p", "coll", "nbc", "coll_dispatch", "coll_segment",
-             "compile", "phase")
+             "compile", "phase", "rma")
 
 NAME_SEND = intern_name("send", ("cid", "src", "tag", "seq", "bytes"))
 NAME_RECV = intern_name("recv", ("cid", "src", "tag", "seq", "bytes"))
@@ -233,6 +236,9 @@ NAME_SEG_MEET = intern_name("seg_meet", ("cid", "seq", "nbytes"))
 NAME_FUSED_FLUSH = intern_name("fused_flush", ("cid", "ops"))
 NAME_FUSED_PACK = intern_name("fused_pack", ("cid", "groups", "slots"))
 NAME_XLA_COMPILE = intern_name("xla_compile", ("key$",))
+NAME_RMA_PUT = intern_name("rma_put", ("cid", "target", "nbytes"))
+NAME_RMA_GET = intern_name("rma_get", ("cid", "target", "nbytes"))
+NAME_RMA_ACC = intern_name("rma_acc", ("cid", "target", "nbytes"))
 
 # phase-span names share one arg schema: the op correlation keys.
 # (cid, seq) line phases up with their enclosing meet/seg_meet span;

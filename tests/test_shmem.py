@@ -14,8 +14,10 @@ from ompi_tpu.testing import run_ranks
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def shmem_ranks(n, fn):
-    """Thread-rank harness with a per-thread shmem ctx."""
+def shmem_ranks(n, fn, devices=False):
+    """Thread-rank harness with a per-thread shmem ctx.  devices=True
+    gives each rank a jax device, so osc selection mints the
+    device-heap ctx (ctx.device True)."""
     def wrapped(comm):
         ctx = shmem.init(comm)
         try:
@@ -23,7 +25,7 @@ def shmem_ranks(n, fn):
         finally:
             shmem.finalize()
 
-    return run_ranks(n, wrapped)
+    return run_ranks(n, wrapped, devices=devices)
 
 
 # ---- memheap --------------------------------------------------------
@@ -424,3 +426,155 @@ def test_shmem_ptr():
         return True
 
     assert all(shmem_ranks(4, fn))
+
+
+# ---- promoted examples: byte-identity across osc components ---------
+# (ISSUE 14) The SAME workload — API-only, no .local stores — must
+# return identical bytes whether the symmetric heap is the pt2pt
+# window's numpy segment or the device component's HBM shard.
+
+def _ring_workload(ctx, comm):
+    """examples/shmem_ring.py: a token injected by PE 0 circles the
+    ring via shmem_p + wait_until, incremented at every hop."""
+    me, n = comm.rank, comm.size
+    flag = ctx.malloc(1, np.int64)
+    ctx._write_sym(flag, np.full(1, -1, np.int64))
+    ctx.barrier_all()
+    if me == 0:
+        ctx.p(flag, 0, 42, (me + 1) % n)
+    ctx.wait_until(flag, 0, "ge", 0)
+    token = int(flag.local[0])
+    if me != 0:
+        ctx.p(flag, 0, token + 1, (me + 1) % n)
+    ctx.barrier_all()
+    if me == 0:
+        assert token == 42 + n - 1, token
+    return {"device": ctx.device, "token": token,
+            "final": np.asarray(flag.local).tobytes()}
+
+
+def _atomics_workload(ctx, comm):
+    """examples/shmem_atomics.py: fetch-inc ticketing + atomic
+    accumulator on PE 0, distinct tickets proven via fcollect."""
+    me, n = comm.rank, comm.size
+    counter = ctx.malloc(1, np.int64)
+    acc = ctx.malloc(1, np.int64)
+    ctx._write_sym(counter, np.zeros(1, np.int64))
+    ctx._write_sym(acc, np.zeros(1, np.int64))
+    ctx.barrier_all()
+    ticket = int(ctx.atomic_fetch_inc(counter, 0, 0))
+    ctx.atomic_add(acc, 0, me + 1, 0)
+    ctx.barrier_all()
+    all_t = ctx.malloc(n, np.int64)
+    mine = ctx.malloc(1, np.int64)
+    ctx._write_sym(mine, np.full(1, ticket, np.int64))
+    ctx.barrier_all()
+    ctx.collect(all_t, mine)
+    tickets = sorted(np.asarray(all_t.local).tolist())
+    assert tickets == list(range(n)), tickets
+    return {"device": ctx.device,
+            "counter": int(ctx.g(counter, 0, 0)),
+            "acc": int(ctx.g(acc, 0, 0)),
+            "tickets": np.asarray(tickets, np.int64).tobytes()}
+
+
+@pytest.mark.parametrize(
+    "workload", [_ring_workload, _atomics_workload],
+    ids=["shmem_ring", "shmem_atomics"])
+def test_promoted_examples_byte_identical(workload):
+    n = 4
+    host = shmem_ranks(n, workload)
+    dev = shmem_ranks(n, workload, devices=True)
+    assert all(not r["device"] for r in host)
+    assert all(r["device"] for r in dev)
+    for r in range(n):
+        for k in host[r]:
+            if k != "device":
+                assert host[r][k] == dev[r][k], (r, k)
+
+
+def test_device_heap_local_readonly_and_ptr_none():
+    """A device heap has no live host alias: SymArray.local is a
+    read-only snapshot and ptr() refuses to hand out peer views."""
+    def fn(ctx, comm):
+        assert ctx.device and ctx.heap is None
+        x = ctx.malloc(4, np.int32)
+        ctx._write_sym(x, np.arange(4, dtype=np.int32))
+        loc = x.local
+        assert not loc.flags.writeable
+        with pytest.raises(ValueError):
+            loc[0] = 9
+        assert (ctx.ptr(x, comm.rank) == np.arange(4)).all()
+        peer = (comm.rank + 1) % comm.size
+        assert ctx.ptr(x, peer) is None
+        ctx.barrier_all()
+        return True
+
+    assert all(shmem_ranks(2, fn, devices=True))
+
+
+def test_scoll_on_device_heap():
+    """PE collectives stage through the ctx accessors, so they work
+    when the symmetric blocks live in HBM."""
+    def fn(ctx, comm):
+        me, n = comm.rank, comm.size
+        src = ctx.malloc(3, np.int32)
+        dst = ctx.malloc(3, np.int32)
+        ctx._write_sym(src, np.full(3, me + 1, np.int32))
+        ctx.barrier_all()
+        ctx.sum_to_all(dst, src)
+        assert (dst.local == n * (n + 1) // 2).all(), dst.local
+        b = ctx.malloc(4, np.int32)
+        if me == 1:
+            ctx._write_sym(b, np.arange(4, dtype=np.int32) * 7)
+        ctx.barrier_all()
+        ctx.broadcast(b, b, root=1)
+        assert (b.local == np.arange(4, dtype=np.int32) * 7).all()
+        ctx.barrier_all()
+        return True
+
+    assert all(shmem_ranks(4, fn, devices=True))
+
+
+def test_ring_byte_identity_across_shrink():
+    """Survivors of a ULFM shrink epoch rebuild a device-heap ctx on
+    the shrunken comm and the promoted ring workload is
+    byte-identical to a fresh world of the survivor size."""
+    import time
+
+    from ompi_tpu import errhandler as eh
+    from ompi_tpu.errhandler import MPIException
+    from ompi_tpu.ft import ulfm
+
+    codes = (eh.ERR_PROC_FAILED, eh.ERR_PROC_FAILED_PENDING,
+             eh.ERR_REVOKED)
+
+    def chaos(comm):
+        comm.Barrier()
+        if comm.rank == 0:
+            ulfm.kill_now(comm.state)
+        work = comm
+        while work is comm:
+            try:
+                work.Barrier()
+                time.sleep(0.05)
+            except MPIException as e:
+                assert e.code in codes, e.code
+                work = work.shrink(name="survivors")
+        ctx = shmem.ShmemCtx(work)
+        out = _ring_workload(ctx, work)
+        ctx.finalize()
+        return out
+
+    def fresh(comm):
+        ctx = shmem.ShmemCtx(comm)
+        out = _ring_workload(ctx, comm)
+        ctx.finalize()
+        return out
+
+    got = run_ranks(4, chaos, devices=True, allow_failures=True,
+                    timeout=180.0)
+    ref = run_ranks(3, fresh, devices=True)
+    assert got[0] is None
+    for i in range(1, 4):
+        assert got[i] == ref[i - 1], i
