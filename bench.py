@@ -199,6 +199,16 @@ def main() -> None:
                          "under 'probe_rma' in BENCH_DETAIL.json, "
                          "and FAIL (exit 1) if device put/get busbw "
                          "is not >=5x pt2pt at the 1 MiB tier")
+    ap.add_argument("--probe-ctrlplane", action="store_true",
+                    help="Chaos-close the control plane: kill the KV "
+                         "primary mid-fence (standby promotion must "
+                         "complete the fence) and hard-kill the DVM "
+                         "server mid-run (journal rehydration + "
+                         "jobid-idempotent replay), both under a "
+                         "4-session concurrent workload; persist "
+                         "under 'probe_ctrlplane' in "
+                         "BENCH_DETAIL.json, and FAIL (exit 1) on "
+                         "any failed job or hung worker")
     ap.add_argument("--rma-max-bytes", type=int, default=None,
                     help="Cap the --probe-rma size ladder (the full "
                          "64 MiB curve wants real accelerator "
@@ -528,6 +538,40 @@ def main() -> None:
             sys.exit(1)
         return
 
+    if opts.probe_ctrlplane:
+        from benchmarks.probe_ctrlplane import persist, run_probe
+
+        probe = run_probe()
+        notes = persist(probe, detail_path)
+        line = {
+            "metric": f"control-plane chaos, KV kill mid-fence + DVM "
+                      f"kill mid-run, {probe['kv']['workers']} "
+                      "concurrent sessions",
+            "value": probe["kv_failover_mttr_ms"],
+            "unit": "ms_kv_warm_failover",
+            "kv_fence_complete_ms": probe["kv_fence_complete_ms"],
+            "dvm_restart_mttr_ms": probe["dvm_restart_mttr_ms"],
+            "failed_jobs": probe["failed_jobs"],
+            "jobs_done": probe["dvm"]["jobs_done"],
+            "supervisor_restarts":
+                probe["dvm"]["supervisor_restarts"],
+            "kv_repl_overhead_pct": probe["kv_repl_overhead_pct"],
+            "within_budget": probe["within_budget"],
+        }
+        line.update({k: v for k, v in notes.items() if "error" in k})
+        sys.stderr.write(json.dumps(probe, indent=1) + "\n")
+        print(json.dumps(line))
+        if not probe["within_budget"]:
+            sys.stderr.write(
+                f"FAIL: ctrlplane probe — failed_jobs="
+                f"{probe['failed_jobs']}, kv hung="
+                f"{probe['kv']['hung_workers']}, dvm hung="
+                f"{probe['dvm']['hung_sessions']}, dvm killed="
+                f"{probe['dvm']['killed']}, jobs_done="
+                f"{probe['dvm']['jobs_done']}\n")
+            sys.exit(1)
+        return
+
     if opts.probe_obs:
         from benchmarks.probe_obs import persist, run_probe
 
@@ -680,6 +724,7 @@ def main() -> None:
                                     "probe_pipeline", "probe_ckpt",
                                     "probe_serve", "probe_obs",
                                     "probe_fleet", "probe_rma",
+                                    "probe_ctrlplane",
                                     "regress_trajectory")
                           if isinstance(prior, dict) and k in prior},
                        "device_us": dev, "software_us": sw,

@@ -44,8 +44,10 @@ _plan_var = registry.register(
          "class:rate — e.g. 'drop:0.05,sever:0.01'.  Classes: drop, "
          "delay, dup, reorder, corrupt, sever, daemon_kill, "
          "oob_sever, kv_partition, rank_kill, io_stall, io_partial, "
-         "io_enospc, dvm_disconnect, rma_delay.  Empty = framework "
-         "disabled")
+         "io_enospc, dvm_disconnect, rma_delay, kv_kill, dvm_kill "
+         "(for the kill classes the number is the armed OP COUNT the "
+         "control-plane process dies at, not a rate).  Empty = "
+         "framework disabled")
 _rate_var = registry.register(
     "ft", "inject", "rate", 0.02, float,
     help="Default per-event injection probability for plan entries "
@@ -99,6 +101,14 @@ DVM_CLASSES = ("dvm_disconnect",)
 # target's active-message apply — lock grants, unlock acks and pt2pt
 # payload application all slow down, surfacing in osc_lock_wait_us
 RMA_CLASSES = ("rma_delay",)
+# control-plane process-death scenarios: like rank_kill these fire
+# exactly once and deterministically — the plan number is the armed
+# OP COUNT (the victim dies serving its Nth op), not a probability,
+# so a chaos run kills the primary at a reproducible traffic point
+# (e.g. mid-fence).  kv_kill crashes the KV primary (standby
+# failover path); dvm_kill hard-exits the DVM server process
+# (journal rehydration path, subprocess runs only).
+KILL_CLASSES = ("kv_kill", "dvm_kill")
 
 
 def plan() -> Dict[str, float]:
@@ -268,6 +278,53 @@ def dvm_injector(rank: int = 0) -> Optional[DvmInjector]:
     if not p:
         return None
     return DvmInjector("dvm", rank, p)
+
+
+class KillInjector:
+    """One-shot deterministic control-plane death: ``op()`` counts the
+    victim's served ops and returns True exactly once, when the armed
+    count is reached.  No RNG — death at op N replays bit-for-bit."""
+
+    def __init__(self, scope: str, after_ops: float) -> None:
+        self.scope = scope
+        # plan rates below 1 (including the 0.02 default applied to a
+        # bare class name) mean "no explicit count": arm a mid-run
+        # default instead of dying on the first op
+        self.after_ops = int(after_ops) if after_ops >= 1 else 64
+        self._count = 0
+        self._fired = False
+
+    def op(self) -> bool:
+        if self._fired:
+            return False
+        self._count += 1
+        if self._count < self.after_ops:
+            return False
+        self._fired = True
+        from ompi_tpu import obs as _obs
+        from ompi_tpu import trace
+        tr = trace.current_tracer()
+        if tr is not None:
+            tr.instant("ft_inject", "fault", cls=self.scope + "_kill",
+                       scope=self.scope)
+        _obs.record_event(_obs.EV_FT_INJECT,
+                          _obs.intern(self.scope + "_kill"),
+                          _obs.intern(self.scope))
+        return True
+
+
+def kv_kill_injector() -> Optional[KillInjector]:
+    p = plan()
+    if "kv_kill" not in p:
+        return None
+    return KillInjector("kv", p["kv_kill"])
+
+
+def dvm_kill_injector() -> Optional[KillInjector]:
+    p = plan()
+    if "dvm_kill" not in p:
+        return None
+    return KillInjector("dvm", p["dvm_kill"])
 
 
 def node_faults(node_id: int) -> List[str]:

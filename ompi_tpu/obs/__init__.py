@@ -187,13 +187,17 @@ EV_DVM_SHED = 16
 EV_DVM_RESIZE = 17
 EV_DVM_QUOTA = 18
 EV_CTRL_ADJUST = 19
+EV_KV_FAILOVER = 20
+EV_DVM_REHYDRATE = 21
+EV_DVM_REPLAY = 22
 
 EVENT_NAMES = (
     "ulfm_detect", "ulfm_revoke", "ulfm_agree", "ulfm_shrink",
     "respawn_rejoin", "ckpt_commit", "ckpt_abort", "ckpt_crc_fallback",
     "dvm_reject", "dvm_queue_full", "ft_inject", "dvm_attach",
     "dvm_detach", "dvm_halt", "dvm_run", "dvm_preempt", "dvm_shed",
-    "dvm_resize", "dvm_quota", "ctrl_adjust",
+    "dvm_resize", "dvm_quota", "ctrl_adjust", "kv_failover",
+    "dvm_rehydrate", "dvm_replay",
 )
 
 # Per-type argument field names (positional a0..a3); a trailing "$"
@@ -220,6 +224,9 @@ EVENT_FIELDS = (
     ("old", "new", "epoch"),                 # dvm_resize
     ("sid", "kind$", "val"),                 # dvm_quota
     ("margin_pct", "qdepth", "p99_us"),      # ctrl_adjust
+    ("band", "ep$"),                         # kv_failover
+    ("sessions", "jobs_done", "inc$"),       # dvm_rehydrate
+    ("sid", "code"),                         # dvm_replay
 )
 
 # interned strings for event args (reason/cls/scope): the ring holds

@@ -32,6 +32,50 @@ _kv_retry_max_var = registry.register(
 _kv_retry_delay_var = registry.register(
     "rte", "base", "kv_retry_delay", 0.05, float,
     help="Base KV retry backoff (exponential, jittered, capped 2 s)")
+_kv_replicas_var = registry.register(
+    "rte", "base", "kv_replicas", 0, int,
+    help="Hot-standby replicas behind the KV server (0 = single "
+         "server, the default and the fast path; 1 = one in-process "
+         "standby fed by streaming op replication, advertised through "
+         "the kv2: multi-endpoint uri so clients fail over when the "
+         "primary dies)")
+
+# monotonic per-process client ids: fence arrivals are cid-tagged so a
+# re-sent arrival (lost reply, or failover to the promoted standby)
+# re-registers the waiter without double-counting its weight
+_cid_lock = threading.Lock()
+_cid_next = [0]
+
+
+def _next_cid() -> str:
+    with _cid_lock:
+        _cid_next[0] += 1
+        return f"{os.getpid()}.{_cid_next[0]}"
+
+
+_pv_kv = None  # lazy (retries, reconnects, failovers) scoped pvars
+
+
+def _kv_pvars():
+    """Client-side resilience counters, band-scoped so DVM sessions
+    (ns 's<sid>') get per-session attribution.  Lazy: obs pulls in
+    the MPI state module, which this leaf must not import eagerly."""
+    global _pv_kv
+    if _pv_kv is None:
+        from ompi_tpu import obs as _obs
+        _pv_kv = (
+            _obs.scoped_pvar(
+                "kv", "", "retries",
+                help="KV ops re-sent after a transient failure"),
+            _obs.scoped_pvar(
+                "kv", "", "reconnects",
+                help="KV client sockets re-established after a drop"),
+            _obs.scoped_pvar(
+                "kv", "", "failovers",
+                help="KV client endpoint rotations onto a standby "
+                     "after the current endpoint refused a connect"),
+        )
+    return _pv_kv
 
 
 def job_secret() -> Optional[str]:
@@ -136,10 +180,13 @@ class KVServer:
     """Runs inside the launcher (the HNP role)."""
 
     def __init__(self, nprocs: int, host: str = "127.0.0.1",
-                 advertise: Optional[str] = None) -> None:
+                 advertise: Optional[str] = None,
+                 replicas: Optional[int] = None) -> None:
         """``host`` is the bind address (0.0.0.0 for multi-host jobs);
         ``advertise`` is the address clients are told to dial (the
-        HNP's reachable IP when binding wildcard)."""
+        HNP's reachable IP when binding wildcard).  ``replicas``
+        overrides the rte_base_kv_replicas knob (the standby itself is
+        built with replicas=0 so the chain is exactly one deep)."""
         self.nprocs = nprocs
         self.secret = job_secret()
         self.data: Dict[str, Any] = {}
@@ -148,6 +195,15 @@ class KVServer:
         self.counters: Dict[str, int] = {}
         self.fences: Dict[str, int] = {}
         self.fence_waiters: Dict[str, List[socket.socket]] = {}
+        # fid -> {cid: weight}: which clients already arrived, so a
+        # re-sent arrival (lost reply / failover onto the standby)
+        # never double-counts its weight
+        self.fence_cids: Dict[str, Dict[str, int]] = {}
+        # completed-fence memory (bounded): a client whose fence_done
+        # reply was lost retries and must get fence_done again, not a
+        # fresh one-member fence that parks it forever
+        self.fence_done: Dict[str, bool] = {}
+        self._fence_done_order: List[str] = []
         # per-namespace aborts (the DVM serve plane: many resident
         # sessions share ONE long-lived server, each under a key
         # namespace).  An abort carrying "ns" poisons only that
@@ -176,10 +232,50 @@ class KVServer:
         self.addr = (f"{advertise or host}:"
                      f"{self.sock.getsockname()[1]}")
         self._threads: List[threading.Thread] = []
+        self._conns: set = set()  # accepted sockets, for crash()
         self._stop = False
+        # replication: the standby is a second KVServer fed a stream
+        # of normalized mutation records over one socket, applied in
+        # arrival order.  Replicate-before-reply: the record is in the
+        # standby's TCP receive buffer before the client sees its ack,
+        # so a promoted standby can only be MISSING ops the client
+        # never saw acknowledged (and will therefore retry).
+        self.standby: Optional["KVServer"] = None
+        self._repl: Optional[socket.socket] = None
+        self.repl_degraded = False
+        want_repl = _kv_replicas_var.value if replicas is None \
+            else replicas
+        if want_repl > 0:
+            self.standby = KVServer(nprocs, host=host,
+                                    advertise=advertise, replicas=0)
+            peer = ("127.0.0.1" if host in ("127.0.0.1", "0.0.0.0")
+                    else host, self.standby.sock.getsockname()[1])
+            self._repl = socket.create_connection(peer, timeout=10)
+            self._repl.setsockopt(socket.IPPROTO_TCP,
+                                  socket.TCP_NODELAY, 1)
+            if self.secret:
+                _send_msg(self._repl, {"op": "hello",
+                                       "secret": self.secret})
+                if not (_recv_msg(self._repl) or {}).get("ok"):
+                    raise ConnectionError("standby refused hello")
+            _send_msg(self._repl, {"op": "repl_stream"})
+        # chaos: kv_kill arms a deterministic op-count trigger that
+        # hard-crashes THIS server (the primary) mid-traffic
+        from ompi_tpu import ft_inject
+        self._kill = ft_inject.kv_kill_injector() if replicas != 0 \
+            else None
         self._accept_thread = threading.Thread(target=self._accept_loop,
                                                daemon=True)
         self._accept_thread.start()
+
+    @property
+    def uri(self) -> str:
+        """The address doc clients dial: the plain 'host:port' when
+        unreplicated, else the versioned multi-endpoint form
+        'kv2:<primary>,<standby>' (KVClient rotates through it)."""
+        if self.standby is not None:
+            return f"kv2:{self.addr},{self.standby.addr}"
+        return self.addr
 
     def _accept_loop(self) -> None:
         while not self._stop:
@@ -187,11 +283,191 @@ class KVServer:
                 conn, _ = self.sock.accept()
             except OSError:
                 return
+            if self._stop:
+                # crash()/close() raced our in-flight accept(): the
+                # kernel kept the listener alive through the syscall
+                # and handed us one more connection — a dead server
+                # must not serve it
+                try:
+                    conn.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                return
             self.connections_served += 1
+            self._conns.add(conn)
             t = threading.Thread(target=self._serve, args=(conn,),
                                  daemon=True)
             t.start()
             self._threads.append(t)
+
+    def _replicate(self, rec: dict) -> None:
+        """Stream one mutation record to the standby.  Called UNDER
+        self.cv so records hit the wire in apply order.  A dead
+        standby degrades the server to single mode permanently (no
+        failback: the standby's state is stale the moment the stream
+        breaks)."""
+        if self._repl is None:
+            return
+        try:
+            _send_msg(self._repl, rec)
+        except OSError:
+            self.repl_degraded = True
+            try:
+                self._repl.close()
+            except OSError:
+                pass
+            self._repl = None
+
+    def _apply_repl(self, conn: socket.socket) -> None:
+        """Standby side of the stream: apply records until EOF.  No
+        per-record replies — the ack domain is TCP delivery, and the
+        primary never waits on us."""
+        while True:
+            rec = _recv_msg(conn)
+            if rec is None:
+                return
+            op = rec.get("op")
+            with self.cv:
+                if op == "put":
+                    self.data[rec["key"]] = rec["value"]
+                elif op == "ctr":
+                    self.counters[rec["key"]] = rec["value"]
+                elif op == "del":
+                    self.data.pop(rec["key"], None)
+                elif op == "purge":
+                    self._purge_locked(rec["prefix"])
+                elif op == "fence":
+                    self._fence_arrive_locked(rec, None)
+                elif op == "abort":
+                    self._abort_locked(rec)
+                elif op == "spawn_state":
+                    self.universe = rec["universe"]
+                    self.spawn_requests.append(rec["req"])
+                self.cv.notify_all()
+
+    def _purge_locked(self, pfx: str) -> int:
+        nd = 0
+        for k in [k for k in self.data
+                  if isinstance(k, str) and k.startswith(pfx)]:
+            del self.data[k]
+            nd += 1
+        for k in [k for k in self.counters
+                  if isinstance(k, str)
+                  and (k.startswith(pfx) or
+                       k.startswith("claim:" + pfx))]:
+            del self.counters[k]
+            nd += 1
+        # a full-namespace purge ("ns/") is session teardown: clear
+        # the poison record and the completed-fence memory too so a
+        # reused server never haunts later lookups
+        if pfx.endswith("/"):
+            self.ns_aborted.pop(pfx[:-1], None)
+        for f in [f for f in self.fence_done if f.startswith(pfx)]:
+            del self.fence_done[f]
+        return nd
+
+    def _fence_done_add_locked(self, fid: str) -> None:
+        if fid not in self.fence_done:
+            self.fence_done[fid] = True
+            self._fence_done_order.append(fid)
+            while len(self._fence_done_order) > 4096:
+                self.fence_done.pop(self._fence_done_order.pop(0),
+                                    None)
+
+    def _fence_arrive_locked(self, msg: dict,
+                             conn: Optional[socket.socket]
+                             ) -> Optional[dict]:
+        """Register one (possibly re-sent) fence arrival.  Returns an
+        immediate reply dict for ``conn`` (error, or fence_done from
+        the completed-fence memory), or None when the arrival parked
+        or completed — completion broadcasts fence_done to every
+        registered waiter, including ``conn``.  Replicated arrivals
+        pass conn=None: the standby accumulates weights without
+        waiter sockets, and reconstructs the waiter side from the
+        clients' own re-sent arrivals after failover."""
+        fid = msg["id"]
+        if fid in self.fence_done:
+            return {"fence_done": fid} if conn is not None else None
+        want = int(msg.get("n") or self.nprocs)
+        ns = msg.get("ns")
+        ab = self.aborted
+        if ab is None and ns is not None:
+            ab = self.ns_aborted.get(ns)
+        if ab is None and self.ns_aborted:
+            # untagged late arrival (e.g. a proxied fence drops the
+            # ns tag): fence ids are ns-prefixed "ns/<id>" by
+            # KVClient, so recover the scope by prefix
+            for a_ns, rec in self.ns_aborted.items():
+                if fid.startswith(a_ns + "/"):
+                    ab = rec
+                    break
+        if ab is not None:
+            # the abort sweep only releases waiters already parked; a
+            # rank fencing AFTER its scope was poisoned must fail here
+            # — the aborting rank will never arrive, and re-registering
+            # the fence would park this client forever
+            if conn is not None:
+                return {"error": f"aborted by rank {ab[0]}: {ab[2]}"}
+            return None
+        cids = self.fence_cids.setdefault(fid, {})
+        cid = msg.get("cid")
+        if cid is None:  # legacy arrival: never dedups
+            cid = f"anon.{len(cids)}"
+        if cid not in cids:
+            cids[cid] = int(msg.get("weight", 1))
+            self.fences[fid] = self.fences.get(fid, 0) + cids[cid]
+        if conn is not None:
+            ws = self.fence_waiters.setdefault(fid, [])
+            if conn not in ws:
+                ws.append(conn)
+        if self.fences.get(fid, 0) >= want:
+            for c in self.fence_waiters.get(fid, []):
+                try:
+                    _send_msg(c, {"fence_done": fid})
+                except OSError:
+                    pass
+            self.fences.pop(fid, None)
+            self.fence_waiters.pop(fid, None)
+            self.fence_cids.pop(fid, None)
+            self._fence_done_add_locked(fid)
+            self.cv.notify_all()
+        return None
+
+    def _abort_locked(self, msg: dict) -> Tuple[bool, tuple]:
+        ns = msg.get("ns")
+        rec = (msg["rank"], msg["code"], msg.get("msg", ""))
+        if ns is not None:
+            first = ns not in self.ns_aborted
+            if first:
+                self.ns_aborted[ns] = rec
+            rec = self.ns_aborted[ns]
+        else:
+            first = self.aborted is None
+            if first:
+                self.aborted = rec
+            rec = self.aborted
+        # release fence waiters of the poisoned scope with an error:
+        # the aborting rank never arrives, so a parked peer must get
+        # a diagnosable failure, not a silent hang.  Fence ids are
+        # ns-prefixed ("ns/<id>") by KVClient, so the scope is a
+        # prefix match; a global abort releases every fence.
+        fpfx = f"{ns}/" if ns is not None else ""
+        for fid in [f for f in self.fences if f.startswith(fpfx)]:
+            for c in self.fence_waiters.get(fid, []):
+                try:
+                    _send_msg(c, {"error": f"aborted by rank "
+                                           f"{rec[0]}: {rec[2]}"})
+                except OSError:
+                    pass
+            self.fences.pop(fid, None)
+            self.fence_waiters.pop(fid, None)
+            self.fence_cids.pop(fid, None)
+        self.cv.notify_all()
+        return first, rec
 
     def _serve(self, conn: socket.socket) -> None:
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -204,14 +480,29 @@ class KVServer:
                 if msg is None:
                     return
                 op = msg.get("op") or ""
+                if self._kill is not None and op != "hello" \
+                        and self._kill.op():
+                    # armed kv_kill: die BEFORE processing, exactly
+                    # like a SIGKILL between recv and apply — the
+                    # client saw no reply and must retry elsewhere
+                    self.crash()
+                    return
                 if op == "hello":
                     # secretless server: ack so mixed configs work
                     _send_msg(conn, {"ok": True})
+                elif op == "repl_stream":
+                    # this connection IS the primary's replication
+                    # feed: we are the standby from here on
+                    self._apply_repl(conn)
+                    return
                 elif op.startswith("dfs_"):
                     _send_msg(conn, _dfs_serve(op, msg, dfs_fds))
                 elif op == "put":
                     with self.cv:
                         self.data[msg["key"]] = msg["value"]
+                        self._replicate({"op": "put",
+                                         "key": msg["key"],
+                                         "value": msg["value"]})
                         self.cv.notify_all()
                     _send_msg(conn, {"ok": True})
                 elif op == "get":
@@ -239,6 +530,11 @@ class KVServer:
                     with self.cv:
                         v = self.counters.get(msg["key"], 0)
                         self.counters[msg["key"]] = v + 1
+                        # replicated as the RESULT, not the op: a
+                        # re-applied absolute value is idempotent
+                        self._replicate({"op": "ctr",
+                                         "key": msg["key"],
+                                         "value": v + 1})
                     _send_msg(conn, {"value": v})
                 elif op == "uncr":
                     # compensating decrement: roll a ticket back only
@@ -249,6 +545,9 @@ class KVServer:
                         ok = cur == msg["expect"] + 1
                         if ok:
                             self.counters[msg["key"]] = msg["expect"]
+                            self._replicate({"op": "ctr",
+                                             "key": msg["key"],
+                                             "value": msg["expect"]})
                     _send_msg(conn, {"ok": ok})
                 elif op == "purge":
                     # prefix delete over data AND counters (including
@@ -258,23 +557,9 @@ class KVServer:
                     # respawn epoch rollover
                     pfx = msg["prefix"]
                     with self.cv:
-                        nd = 0
-                        for k in [k for k in self.data
-                                  if isinstance(k, str)
-                                  and k.startswith(pfx)]:
-                            del self.data[k]
-                            nd += 1
-                        for k in [k for k in self.counters
-                                  if isinstance(k, str)
-                                  and (k.startswith(pfx) or
-                                       k.startswith("claim:" + pfx))]:
-                            del self.counters[k]
-                            nd += 1
-                        # a full-namespace purge ("ns/") is session
-                        # teardown: clear the poison record too so a
-                        # reused server never haunts later lookups
-                        if pfx.endswith("/"):
-                            self.ns_aborted.pop(pfx[:-1], None)
+                        nd = self._purge_locked(pfx)
+                        self._replicate({"op": "purge",
+                                         "prefix": pfx})
                         self.cv.notify_all()
                     _send_msg(conn, {"ok": True, "n": nd})
                 elif op == "take":
@@ -297,94 +582,43 @@ class KVServer:
                         elif deadline_hit:
                             _send_msg(conn, {"timeout": True})
                         else:
-                            _send_msg(conn,
-                                      {"value": self.data.pop(msg["key"])})
+                            val = self.data.pop(msg["key"])
+                            self._replicate({"op": "del",
+                                             "key": msg["key"]})
+                            _send_msg(conn, {"value": val})
                 elif op == "fence":
                     # weighted arrival: a daemon KV proxy fences ONCE
                     # on behalf of its node's ranks (weight = local
                     # rank count); the fence completes when the summed
                     # weights reach n (grpcomm aggregation analog,
                     # ref: orte/mca/grpcomm — daemons collect their
-                    # local procs' contributions)
-                    fid = msg["id"]
-                    want = int(msg.get("n", self.nprocs))
-                    weight = int(msg.get("weight", 1))
-                    ns = msg.get("ns")
+                    # local procs' contributions).  cid-deduped, so a
+                    # retried arrival is safe and the standby rebuilds
+                    # in-flight fences from the replicated records.
                     with self.cv:
-                        ab = self.aborted
-                        if ab is None and ns is not None:
-                            ab = self.ns_aborted.get(ns)
-                        if ab is None and self.ns_aborted:
-                            # untagged late arrival (e.g. a proxied
-                            # fence drops the ns tag): fence ids are
-                            # ns-prefixed "ns/<id>" by KVClient, so
-                            # recover the scope by prefix
-                            for a_ns, rec in self.ns_aborted.items():
-                                if fid.startswith(a_ns + "/"):
-                                    ab = rec
-                                    break
-                        if ab is not None:
-                            # the abort sweep only releases waiters
-                            # already parked; a rank fencing AFTER its
-                            # scope was poisoned must fail here — the
-                            # aborting rank will never arrive, and
-                            # re-registering the fence would park this
-                            # client forever (KVClient sockets have no
-                            # read timeout)
-                            try:
-                                _send_msg(conn, {
-                                    "error": f"aborted by rank "
-                                             f"{ab[0]}: {ab[2]}"})
-                            except OSError:
-                                pass
-                            continue
-                        self.fences[fid] = self.fences.get(fid, 0) + weight
-                        self.fence_waiters.setdefault(fid, []).append(conn)
-                        if self.fences[fid] >= want:
-                            for c in self.fence_waiters[fid]:
-                                try:
-                                    _send_msg(c, {"fence_done": fid})
-                                except OSError:
-                                    pass
-                            del self.fences[fid]
-                            del self.fence_waiters[fid]
-                            self.cv.notify_all()
-                    # reply sent when fence completes (above)
+                        self._replicate({
+                            "op": "fence", "id": msg["id"],
+                            "cid": msg.get("cid"),
+                            "n": msg.get("n"),
+                            "weight": msg.get("weight", 1),
+                            "ns": msg.get("ns")})
+                        reply = self._fence_arrive_locked(msg, conn)
+                    if reply is not None:
+                        try:
+                            _send_msg(conn, reply)
+                        except OSError:
+                            pass
+                    # else: reply rides the completion broadcast
                 elif op == "abort":
-                    ns = msg.get("ns")
-                    rec = (msg["rank"], msg["code"], msg.get("msg", ""))
                     with self.cv:
-                        if ns is not None:
-                            first = ns not in self.ns_aborted
-                            if first:
-                                self.ns_aborted[ns] = rec
-                            rec = self.ns_aborted[ns]
-                        else:
-                            first = self.aborted is None
-                            if first:
-                                self.aborted = rec
-                            rec = self.aborted
-                        # release fence waiters of the poisoned scope
-                        # with an error: the aborting rank never
-                        # arrives, so a parked peer must get a
-                        # diagnosable failure, not a silent hang.
-                        # Fence ids are ns-prefixed ("ns/<id>") by
-                        # KVClient, so the scope is a prefix match;
-                        # a global abort releases every fence.
-                        fpfx = f"{ns}/" if ns is not None else ""
-                        for fid in [f for f in self.fences
-                                    if f.startswith(fpfx)]:
-                            for c in self.fence_waiters.get(fid, []):
-                                try:
-                                    _send_msg(c, {"error":
-                                                  f"aborted by rank "
-                                                  f"{rec[0]}: {rec[2]}"})
-                                except OSError:
-                                    pass
-                            self.fences.pop(fid, None)
-                            self.fence_waiters.pop(fid, None)
-                        self.cv.notify_all()
-                    if first and ns is None and self.on_abort is not None:
+                        self._replicate({
+                            "op": "abort", "rank": msg["rank"],
+                            "code": msg["code"],
+                            "msg": msg.get("msg", ""),
+                            "ns": msg.get("ns")})
+                        first, _rec = self._abort_locked(msg)
+                    if first and msg.get("ns") is None \
+                            and self.on_abort is not None:
                         self.on_abort(self.aborted)
                     _send_msg(conn, {"ok": True})
                 elif op == "spawn":
@@ -405,12 +639,16 @@ class KVServer:
                             continue
                         base = self.universe
                         self.universe += total
-                        self.spawn_requests.append({
+                        req = {
                             "base": base,
                             "maxprocs": total,
                             "segments": segments,
                             "parent_root": int(msg["parent_root"]),
-                        })
+                        }
+                        self.spawn_requests.append(req)
+                        self._replicate({"op": "spawn_state",
+                                         "universe": self.universe,
+                                         "req": req})
                         self.cv.notify_all()
                     if self.on_spawn is not None:
                         self.on_spawn()
@@ -418,6 +656,7 @@ class KVServer:
         except OSError:
             return
         finally:
+            self._conns.discard(conn)
             # a client gone without dfs_close must not leak this
             # long-lived process's descriptors (EMFILE would take
             # down the whole control plane)
@@ -427,12 +666,62 @@ class KVServer:
                 except OSError:
                     pass
 
+    def crash(self) -> None:
+        """Simulate process death for chaos runs: hard-close the
+        listener, every accepted connection and the replication
+        stream, with NO orderly teardown — exactly what clients of a
+        SIGKILLed server observe.  The standby (its own object with
+        its own listener) keeps running and becomes the acting
+        primary as clients fail over to it."""
+        self._stop = True
+        try:
+            # shutdown BEFORE close here too: the accept thread is
+            # parked in accept() on this listener, which pins the
+            # kernel socket past close() — a reconnecting client's
+            # handshake would still complete and the "dead" primary
+            # would keep serving it.
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+        if self._repl is not None:
+            try:
+                self._repl.close()
+            except OSError:
+                pass
+            self._repl = None
+        for c in list(self._conns):
+            # shutdown BEFORE close: a serving thread is parked in
+            # recv on this socket, which on Linux pins the open file
+            # past close() — no FIN would reach the client and parked
+            # fence waiters would never notice the death.  shutdown
+            # tears the connection down regardless.
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+
     def close(self) -> None:
         self._stop = True
         try:
             self.sock.close()
         except OSError:
             pass
+        if self._repl is not None:
+            try:
+                self._repl.close()
+            except OSError:
+                pass
+            self._repl = None
+        if self.standby is not None:
+            self.standby.close()
 
 
 class KVClient:
@@ -443,10 +732,13 @@ class KVClient:
 
     Transient-fault tolerance: ops ride ``_request``, which
     reconnects and retries with backoff against a restarted or
-    partitioned server.  A failed SEND is always retryable (the
-    server discards a partial frame on its read error); a lost REPLY
-    is retried only for idempotent ops — resending an ``incr`` or a
-    ``fence`` the server already applied would corrupt the job.
+    partitioned server, rotating through the kv2: endpoint list when
+    the current endpoint refuses the reconnect (standby failover).
+    A failed SEND is always retryable (the server discards a partial
+    frame on its read error); a lost REPLY is retried only for
+    idempotent ops — resending an ``incr`` the server already applied
+    would corrupt the job.  ``fence`` is retryable because arrivals
+    are cid-deduped server-side.
 
     ``ns`` scopes every key under "ns/" (put_once claim tickets under
     "claim:ns/", so the server's purge hygiene still sweeps them) and
@@ -457,17 +749,49 @@ class KVClient:
     dial the shared server directly on loopback, never a proxy."""
 
     def __init__(self, addr: str, ns: Optional[str] = None) -> None:
-        host, port = addr.rsplit(":", 1)
-        self.addr = (host, int(port))
+        # 'host:port', or the replicated multi-endpoint uri
+        # 'kv2:<primary>,<standby>' — endpoints tried in order, with
+        # rotation on connect failure (the failover path)
+        self.uri = addr
+        eps = addr[4:] if addr.startswith("kv2:") else addr
+        self._eps: List[Tuple[str, int]] = []
+        for ep in eps.split(","):
+            host, port = ep.rsplit(":", 1)
+            self._eps.append((host, int(port)))
+        self._ep_i = 0
+        self.addr = self._eps[0]
         self.ns = ns or None
+        # pvar attribution band: DVM session namespaces are "s<sid>"
+        self._band = int(ns[1:]) if ns and ns.startswith("s") \
+            and ns[1:].isdigit() else 0
+        # stable client id for fence-arrival dedup (per client object,
+        # monotonic so a recycled id never aliases an old arrival)
+        self._cid = _next_cid()
         self._lock = threading.Lock()
-        self._sock: Optional[socket.socket] = self._connect()
+        self._sock: Optional[socket.socket] = None
+        err: Optional[OSError] = None
+        for _ in range(len(self._eps)):
+            try:
+                self._sock = self._connect()
+                break
+            except PermissionError:
+                raise
+            except OSError as e:  # dead endpoint at dial time: rotate
+                err = e
+                self._ep_i = (self._ep_i + 1) % len(self._eps)
+                self.addr = self._eps[self._ep_i]
+        if self._sock is None:
+            raise err if err is not None else ConnectionError(
+                "kv server unreachable")
         from ompi_tpu import ft_inject
         self._inj = ft_inject.kv_injector(
             int(os.environ.get("TPUMPI_RANK", "0")))
 
     def _connect(self) -> socket.socket:
-        s = socket.create_connection(self.addr, timeout=60)
+        # with a standby available, fail a dead endpoint fast and
+        # rotate instead of waiting out the full single-server grace
+        timeout = 60 if len(self._eps) == 1 else 5
+        s = socket.create_connection(self.addr, timeout=timeout)
         # connect timeout only: blocking ops (fence with rank skew,
         # modex gets) must not inherit a 60s socket timeout — hang
         # protection is the server-side get timeout + mpirun --timeout
@@ -492,19 +816,55 @@ class KVClient:
             pass
         self._sock = None
 
+    def _note_failover(self) -> None:
+        """Count + trace one endpoint rotation (the standby-promotion
+        moment from this client's point of view).  Diagnostics only —
+        never allowed to fail the recovery path."""
+        try:
+            _kv_pvars()[2].add(1, self._band)
+            ep = f"{self.addr[0]}:{self.addr[1]}"
+            from ompi_tpu import obs as _obs
+            from ompi_tpu import trace
+            tr = trace.current_tracer()
+            if tr is not None:
+                tr.instant("kv_failover", "rte", ep=ep, ns=self.ns)
+            _obs.record_event(_obs.EV_KV_FAILOVER, self._band,
+                              _obs.intern(ep))
+        except Exception:  # noqa: BLE001
+            pass
+
     def _request(self, msg: dict, idempotent: bool = False) -> dict:
         """One request/reply with reconnect + jittered-backoff retry
         (see class docstring for the idempotency contract).
         PermissionError (an OSError subclass!) is never retried — a
-        refused job secret will not improve with patience."""
-        import random
-        tries = 1 + max(0, _kv_retry_max_var.value)
+        refused job secret will not improve with patience.
+
+        Failover: an endpoint that refuses the reconnect is rotated
+        out immediately (no backoff) until every endpoint has been
+        tried once — a warm standby is reached within one failed
+        connect, keeping kill→first-completed-op MTTR at connect
+        latency, not backoff latency.  Backoff applies only once a
+        whole rotation came up empty."""
+        nep = len(self._eps)
+        tries = (1 + max(0, _kv_retry_max_var.value)) * nep
         delay = max(0.005, _kv_retry_delay_var.value)
         last: Optional[Exception] = None
+        # with a standby, the first retries are SLEEPLESS — one per
+        # endpoint: reconnect-refused + rotate + standby send happen
+        # at connect latency, not backoff latency
+        fast = nep if nep > 1 else 0
+        backoffs = 0
         for attempt in range(tries):
             if attempt:
-                time.sleep(min(2.0, delay * (2 ** (attempt - 1)))
-                           * (0.5 + random.random()))
+                _kv_pvars()[0].add(1, self._band)
+                if fast > 0:
+                    fast -= 1
+                else:
+                    # shared control-plane pacing (oob.backoff_s);
+                    # lazy import — oob itself imports this module
+                    from ompi_tpu.runtime import oob
+                    time.sleep(oob.backoff_s(backoffs, delay, cap=2.0))
+                    backoffs += 1
             with self._lock:
                 if self._inj is not None and self._inj.sever():
                     # injected partition: close the socket under our
@@ -516,12 +876,20 @@ class KVClient:
                 try:
                     if self._sock is None:
                         self._sock = self._connect()
+                        _kv_pvars()[1].add(1, self._band)
                     _send_msg(self._sock, msg)
                 except PermissionError:
                     raise
                 except OSError as e:
                     last = e
+                    connect_failed = self._sock is None
                     self._drop_sock()
+                    if nep > 1 and connect_failed:
+                        # the endpoint itself is down (not just this
+                        # socket): fail over to the next one now
+                        self._ep_i = (self._ep_i + 1) % nep
+                        self.addr = self._eps[self._ep_i]
+                        self._note_failover()
                     continue
                 try:
                     resp = _recv_msg(self._sock)
@@ -618,14 +986,20 @@ class KVClient:
 
     def fence(self, fence_id: str, n: Optional[int] = None,
               weight: int = 1) -> None:
+        # cid-tagged, so a re-sent arrival (lost reply, or failover
+        # onto the promoted standby mid-fence) re-registers this
+        # client's waiter WITHOUT re-adding its weight — retryable,
+        # hence idempotent=True; the standby rebuilds the in-flight
+        # fence from the replicated arrivals plus these re-sends
         msg: Dict[str, Any] = self._ns_tag(
-            {"op": "fence", "id": self._k(fence_id)})
+            {"op": "fence", "id": self._k(fence_id),
+             "cid": self._cid})
         if n is not None:
             msg["n"] = n
         if weight != 1:
             msg["weight"] = weight
         try:
-            resp = self._request(msg)
+            resp = self._request(msg, idempotent=True)
         except ConnectionError as e:
             raise RuntimeError(f"fence {fence_id} failed: {e}") from e
         if "fence_done" not in resp:
@@ -885,8 +1259,9 @@ class KVProxy:
         try:
             with self._fence_lock:
                 if self._up_fence is None:
-                    self._up_fence = KVClient(
-                        f"{self.up.addr[0]}:{self.up.addr[1]}")
+                    # the full uri, not the current endpoint: the
+                    # fence channel must inherit the failover list
+                    self._up_fence = KVClient(self.up.uri)
                 self._up_fence.fence(fid, n=msg.get("n"),
                                      weight=self.local_expected)
             reply = {"fence_done": fid}

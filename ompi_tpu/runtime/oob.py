@@ -50,6 +50,20 @@ reconnect_grace_var = registry.register(
          "the legacy behavior)")
 
 
+def backoff_s(attempt: int, base: float, cap: float = 5.0) -> float:
+    """One control-plane reconnect backoff step: exponential in
+    ``attempt``, capped, with full 0.5x–1.5x jitter so a fleet of
+    reconnecting clients never stampedes a freshly promoted standby
+    or a supervisor-respawned server in lockstep.  The single
+    definition every reconnect loop in the control plane sleeps on —
+    daemon→HNP (tools/tpud) and KV client failover (runtime/kvstore,
+    DESIGN.md §20) — so tuning recovery pacing changes ONE policy,
+    not one copy per loop."""
+    import random
+    d = min(cap, max(0.001, base) * (2 ** min(6, max(0, attempt))))
+    return d * (0.5 + random.random())
+
+
 def silence_budget_s() -> float:
     """Heartbeat-silence horizon: how long a daemon may stay quiet
     before the HNP declares it lost (0.0 = monitoring disabled).

@@ -102,7 +102,12 @@ class FleetController:
                 want = self.ceil
             self.want_capacity = want
             self.idle_ticks = 0
-        elif depth == 0 and active == 0:
+        elif depth == 0 and active == 0 \
+                and getattr(srv, "rehydrated_parked", 0) == 0:
+            # rehydrated-but-unresumed sessions (crash recovery,
+            # DESIGN.md §20) hold zero ranks yet are about to resume:
+            # shrinking now would yank capacity out from under the
+            # recovering fleet and add resize churn to the MTTR
             self.idle_ticks += 1
             if self.idle_ticks >= self.shrink_ticks and cap > self.floor:
                 self.want_capacity = self.floor

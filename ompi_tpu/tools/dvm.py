@@ -184,6 +184,14 @@ class DvmDeadline(DvmError):
     shed = True
 
 
+class DvmDisconnect(DvmError):
+    """The pool connection died mid-request.  Retryable: a client
+    holding a session token reconnects (polling the uri file, which a
+    supervisor-respawned server rewrites), reattaches by token, and
+    replays the in-flight run under its original jobid — the server
+    dedups against its journal, so the job runs exactly once."""
+
+
 def _send(sock: socket.socket, obj: dict) -> None:
     data = json.dumps(obj).encode()
     sock.sendall(struct.pack(">I", len(data)) + data)
@@ -346,6 +354,112 @@ def _make_session_rte():
     return SessionRTE
 
 
+class _Journal:
+    """Write-ahead session journal: the DVM analog of the KV
+    replication stream (docs/DESIGN.md §20).  One JSONL record per
+    control-plane transition — attach / run (WAL, before the program
+    starts) / run_done / detach / pool epoch / quota snapshot — living
+    NEXT TO the uri file, so a restarted server rehydrates its session
+    table from disk exactly like the KV standby rebuilds fences from
+    replicated arrivals.
+
+    Durability policy: records that a crash must not lose (the run WAL
+    — it is what makes an in-flight jobid provably in-flight) are
+    flushed synchronously; bookkeeping records ride the buffered file
+    and are flushed by ``tick()`` from the heartbeat loop (and within
+    one hb period at the latest).  ``tick`` is allocation-free when
+    nothing is pending — it is audited as a progress-sweep hook
+    (tools/hotpath_audit)."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self._lock = threading.Lock()
+        self._f = open(path, "a", buffering=65536)
+        self._dirty = False
+
+    def append(self, rec: dict, sync: bool = False) -> None:
+        line = json.dumps(rec, separators=(",", ":")) + "\n"
+        with self._lock:
+            if self._f is None:
+                return
+            try:
+                self._f.write(line)
+                if sync:
+                    self._f.flush()
+                    self._dirty = False
+                else:
+                    self._dirty = True
+            except OSError:
+                pass  # a full disk must never take the pool down
+
+    def tick(self) -> None:
+        """Flush buffered records; no-op (and no allocation) when
+        clean.  Called from the pool heartbeat loop."""
+        if not self._dirty:
+            return
+        with self._lock:
+            if self._f is None or not self._dirty:
+                return
+            try:
+                self._f.flush()
+            except OSError:
+                pass
+            self._dirty = False
+
+    def rewrite(self, records: List[dict]) -> None:
+        """Compaction: replace the journal with just the records that
+        still matter (done at rehydration, so the file never grows
+        across restarts)."""
+        with self._lock:
+            try:
+                self._f.close()
+            except OSError:
+                pass
+            tmp = self.path + ".tmp"
+            with open(tmp, "w") as f:
+                for rec in records:
+                    f.write(json.dumps(rec, separators=(",", ":"))
+                            + "\n")
+            os.replace(tmp, self.path)
+            self._f = open(self.path, "a", buffering=65536)
+            self._dirty = False
+
+    def close(self, delete: bool = False) -> None:
+        with self._lock:
+            if self._f is not None:
+                try:
+                    self._f.flush()
+                    self._f.close()
+                except OSError:
+                    pass
+                self._f = None
+            if delete:
+                try:
+                    os.unlink(self.path)
+                except OSError:
+                    pass
+
+    @staticmethod
+    def load(path: str) -> List[dict]:
+        """Read every intact record; a torn tail line (killed mid-
+        write) is ignored, records before it are good — append-only
+        JSONL has no other failure mode."""
+        out: List[dict] = []
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        out.append(json.loads(line))
+                    except ValueError:
+                        break
+        except OSError:
+            pass
+        return out
+
+
 class _Session:
     def __init__(self, sid: int, np_: int, conn) -> None:
         self.sid = sid
@@ -373,10 +487,27 @@ class _Session:
         self.priority = 0
         self.preemptible = False
         self.parked = False
+        # True from journal rehydration until the owner's first
+        # resume (or a detach): the controller must not read the
+        # recovering pool as idle while these wait for their clients
+        self.rehydrated = False
         self.preempt_requested = False
         self.preempt_count = 0
         self.epoch = 0  # pool epoch at (re)admission — cid-bands
         #                 derived comms per resize epoch (ft/respawn)
+        # crash recovery (DESIGN.md §20): the reattach credential, the
+        # jobid->exit-code dedup memory for replayed runs, and the
+        # set of jobids whose run WAL has no run_done (in flight at a
+        # crash — the client must resubmit them)
+        self.token = os.urandom(8).hex()
+        self.completed: "collections.OrderedDict[str, int]" = \
+            collections.OrderedDict()
+        self.wal_jobs: set = set()
+
+    def remember_done(self, jobid: str, code: int) -> None:
+        self.completed[jobid] = code
+        while len(self.completed) > 64:  # bounded replay memory
+            self.completed.popitem(last=False)
 
 
 class _Waiter:
@@ -442,6 +573,19 @@ class DVMServer:
         self.kv_server: Any = None
         self.listener: Optional[socket.socket] = None
         self.port = 0
+        # crash recovery (DESIGN.md §20): every server life gets a
+        # fresh incarnation id (published in the uri doc, so clients
+        # detect a restart behind a reused endpoint), a session
+        # journal when uri_file is set, and — armed only for real
+        # subprocess servers (serve()) — the dvm_kill chaos injector
+        self.incarnation = os.urandom(6).hex()
+        self._journal: Optional[_Journal] = None
+        self._kill: Any = None
+        self.rehydrated = 0
+        # rehydrated sessions still parked (no client resumed them
+        # yet): read by FleetController.tick as a shrink inhibitor —
+        # a just-recovered pool with zero active ranks is NOT idle
+        self.rehydrated_parked = 0
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -457,9 +601,17 @@ class DVMServer:
         self.listener.listen(16)
         self.port = self.listener.getsockname()[1]
         if self.uri_file:
+            # rehydrate BEFORE publishing the uri: a reconnecting
+            # client must never reattach into a half-rebuilt table
+            self._rehydrate(f"{self.uri_file}.journal.jsonl")
             tmp = self.uri_file + ".tmp"
             with open(tmp, "w") as f:
+                # line 1 stays bare host:port (every old parser keeps
+                # working); line 2 is the incarnation doc clients use
+                # to detect a restart behind the same endpoint
                 f.write(f"127.0.0.1:{self.port}\n")
+                f.write(json.dumps({"incarnation": self.incarnation,
+                                    "pid": os.getpid()}) + "\n")
             os.replace(tmp, self.uri_file)  # submitters never see a torn file
         _ensure_stdio()
         # arm the serving-plane quota tap (per-band HBM attribution is
@@ -503,6 +655,11 @@ class DVMServer:
 
     def stop(self) -> None:
         self._drain()
+        if self._journal is not None:
+            # orderly stop == clean halt: drop the journal, nothing
+            # should rehydrate from an intentional shutdown
+            self._journal.close(delete=True)
+            self._journal = None
         self._halted = True
         self._close_listener()
         if self._accept_thread is not None:
@@ -579,6 +736,9 @@ class DVMServer:
                 # must stay off the rank hot path) while none run
                 ctrl.tick(time.perf_counter_ns())
                 ctrl.apply()
+            j = self._journal
+            if j is not None:
+                j.tick()  # flush buffered bookkeeping records
 
     def _client(self, conn: _Conn) -> None:
         owned: List[int] = []
@@ -615,8 +775,15 @@ class DVMServer:
             # strand its sessions' ranks (or poison anyone else's).
             # force=True: the owner is gone, nobody else may detach
             # these sids (dispatch is serial per connection, so no run
-            # of ours can still be in flight here)
+            # of ours can still be in flight here).  A session whose
+            # owner RE-BOUND it by token (reattach on a fresh
+            # connection) is skipped — ownership moved, this dead
+            # socket no longer speaks for it.
             for sid in owned:
+                with self.lock:
+                    sess = self.sessions.get(sid)
+                    if sess is not None and sess.conn is not conn:
+                        continue
                 try:
                     self._detach(sid, force=True)
                 except DvmError:
@@ -629,6 +796,15 @@ class DVMServer:
     def _dispatch(self, conn: _Conn, msg: dict,
                   owned: List[int]) -> bool:
         op = msg.get("op")
+        if self._kill is not None and self._kill.op():
+            # chaos (ft_inject dvm_kill): hard process death at the
+            # armed op count — no journal flush, no reply, no
+            # teardown; exactly what SIGKILL leaves behind.  Armed
+            # only on real subprocess servers (serve()).
+            sys.stderr.write("tpu-dvm: ft_inject dvm_kill — dying at "
+                             f"op {op}\n")
+            sys.stderr.flush()
+            os._exit(70)
         if op == "halt":
             conn.busy += 1
             try:
@@ -637,6 +813,11 @@ class DVMServer:
                 conn.busy -= 1
             _obs.record_event(_obs.EV_DVM_HALT, len(self.sessions), jobs)
             self._persist_events("halt")
+            if self._journal is not None:
+                # clean halt: nothing to rehydrate — a journal left
+                # behind would resurrect sessions nobody wants back
+                self._journal.close(delete=True)
+                self._journal = None
             conn.reply({"ok": True, "jobs": jobs})
             sys.stderr.write(f"tpu-dvm: halt after {jobs} jobs\n")
             self._halted = True
@@ -678,8 +859,39 @@ class DVMServer:
             finally:
                 conn.busy -= 1
             owned.append(sess.sid)
+            self._jrec({"t": "attach", "sid": sess.sid, "np": np_,
+                        "prio": sess.priority,
+                        "pre": sess.preemptible,
+                        "token": sess.token}, sync=True)
             conn.reply({"ok": True, "sid": sess.sid, "np": np_,
+                        "token": sess.token,
+                        "incarnation": self.incarnation,
                         "attach_us": attach_us, "queued_us": queued_us})
+            return False
+        if op == "reattach":
+            # crash recovery: a client re-binds its session (possibly
+            # rehydrated by a NEW incarnation) by token, on a fresh
+            # connection.  Replies with the jobids journaled as
+            # in-flight at the crash — the client resubmits those.
+            sid = int(msg.get("sid", -1))
+            sess = self._session_for(sid)
+            if msg.get("token") != sess.token:
+                raise DvmError(f"reattach s{sid}: bad session token "
+                               "(session belongs to someone else)")
+            with self.lock:
+                stale = sess.conn
+                sess.conn = conn
+            if stale is not None and stale is not conn:
+                stale.dead = True  # the old owner connection, if any,
+                # must not auto-detach this session when it reaps
+            if sid not in owned:
+                owned.append(sid)
+            inflight = sorted(sess.wal_jobs)
+            sess.wal_jobs = set()
+            conn.reply({"ok": True, "sid": sid, "np": sess.np,
+                        "incarnation": self.incarnation,
+                        "inflight": inflight,
+                        "parked": sess.parked})
             return False
         if op == "run":
             sid = int(msg.get("sid", -1))
@@ -687,15 +899,36 @@ class DVMServer:
                 raise DvmError(f"unknown session s{sid} (not attached "
                                "on this connection)")
             sess = self._session_for(sid)
+            jobid = msg.get("jobid")
+            if jobid and jobid in sess.completed:
+                # reconnect-with-replay dedup: this jobid already ran
+                # to completion (the reply was lost with the old
+                # connection) — acknowledge it, never run it twice
+                code = sess.completed[jobid]
+                _obs.record_event(_obs.EV_DVM_REPLAY, sid, code)
+                conn.reply({"code": code, "stdout": "", "stderr": "",
+                            "wall_s": 0.0, "replayed": True,
+                            "preempted": sess.preempt_count})
+                return False
             deadline_ms = msg.get("deadline_ms")
             if deadline_ms:
                 self._shed_check(sess, int(deadline_ms))
+            if jobid:
+                # WAL before the program starts: a crash mid-run
+                # leaves proof this jobid was in flight, so reattach
+                # hands it back for resubmission
+                self._jrec({"t": "run", "sid": sid, "jobid": jobid},
+                           sync=True)
             conn.busy += 1
             try:
                 code, out, err, wall = self._run(
                     sess, msg["prog"], msg.get("args") or [])
             finally:
                 conn.busy -= 1
+            if jobid:
+                sess.remember_done(jobid, code)
+                self._jrec({"t": "run_done", "sid": sid,
+                            "jobid": jobid, "code": code})
             conn.reply({"code": code, "stdout": out, "stderr": err,
                         "wall_s": round(wall, 3),
                         "preempted": sess.preempt_count})
@@ -858,6 +1091,113 @@ class DVMServer:
             sys.stderr.write(f"tpu-dvm: flight recorder -> {path} "
                              f"({why})\n")
 
+    # -- crash recovery (DESIGN.md §20) ------------------------------------
+
+    def _jrec(self, rec: dict, sync: bool = False) -> None:
+        if self._journal is not None:
+            self._journal.append(rec, sync=sync)
+
+    def _quota_snapshot(self) -> Dict[str, Any]:
+        return {"dvm_quota_hbm_bytes":
+                registry.get("dvm_quota_hbm_bytes", 0),
+                "dvm_quota_cache_share_pct":
+                registry.get("dvm_quota_cache_share_pct", 0)}
+
+    def _rehydrate(self, path: str) -> None:
+        """Rebuild the session table from the journal a dead
+        incarnation left behind.  Every journaled-attached session
+        comes back PARKED — sid, ns, token, priority and replay
+        memory restored, world torn down (it died with the process);
+        the existing preemption machinery (_run -> _unpark) brings
+        the world back up on the owner's next run, after it
+        reattaches by token.  Jobids journaled as in-flight (run WAL
+        without run_done) are handed back at reattach so the client
+        resubmits them — never silently lost."""
+        recs = _Journal.load(path)
+        self._journal = _Journal(path)
+        if not recs:
+            self._jrec({"t": "open", "inc": self.incarnation,
+                        "pid": os.getpid(), "cap": self.capacity},
+                       sync=True)
+            self._jrec({"t": "quota", **self._quota_snapshot()})
+            return
+        live: Dict[int, dict] = {}
+        done: Dict[int, "collections.OrderedDict[str, int]"] = {}
+        wal: Dict[int, set] = {}
+        jobs = 0
+        epoch = 0
+        max_sid = 0
+        for rec in recs:
+            t = rec.get("t")
+            if t == "attach":
+                sid = int(rec["sid"])
+                live[sid] = rec
+                max_sid = max(max_sid, sid)
+            elif t == "detach":
+                sid = int(rec["sid"])
+                live.pop(sid, None)
+                done.pop(sid, None)
+                wal.pop(sid, None)
+            elif t == "run":
+                wal.setdefault(int(rec["sid"]), set()).add(
+                    rec["jobid"])
+            elif t == "run_done":
+                sid = int(rec["sid"])
+                wal.get(sid, set()).discard(rec["jobid"])
+                done.setdefault(sid, collections.OrderedDict())[
+                    rec["jobid"]] = int(rec["code"])
+                jobs += 1
+            elif t == "epoch":
+                epoch = int(rec["epoch"])
+            elif t == "quota":
+                for k, v in rec.items():
+                    if k != "t" and v:
+                        registry.set(k, v)
+        self._sid_counter = itertools.count(max_sid + 1)
+        self._jobs = jobs
+        self.pool_epoch = epoch
+        for sid, arec in live.items():
+            sess = _Session(sid, int(arec["np"]), None)
+            sess.priority = int(arec.get("prio", 0))
+            sess.preemptible = bool(arec.get("pre", False))
+            sess.token = arec.get("token", sess.token)
+            sess.parked = True  # world died with the old process;
+            # the owner's next run re-admits + re-brings-up (the
+            # same path a preempted session resumes through)
+            sess.completed = done.get(sid, collections.OrderedDict())
+            sess.wal_jobs = wal.get(sid, set())
+            sess.rehydrated = True
+            self.sessions[sid] = sess
+            _pv_active.add(1)
+        self.rehydrated = len(live)
+        self.rehydrated_parked = len(live)
+        if live:
+            _pv_peak.update_max(len(self.sessions))
+            self._set_xsession_hint(len(self.sessions))
+        # compact: the new journal starts from the rehydrated state,
+        # not the dead incarnation's full history
+        out = [{"t": "open", "inc": self.incarnation,
+                "pid": os.getpid(), "cap": self.capacity},
+               {"t": "quota", **self._quota_snapshot()}]
+        if epoch:
+            out.append({"t": "epoch", "epoch": epoch,
+                        "cap": self.capacity})
+        for sid, arec in live.items():
+            out.append(arec)
+            for jobid, code in done.get(sid, {}).items():
+                out.append({"t": "run_done", "sid": sid,
+                            "jobid": jobid, "code": code})
+            for jobid in wal.get(sid, set()):
+                out.append({"t": "run", "sid": sid, "jobid": jobid})
+        self._journal.rewrite(out)
+        _obs.record_event(_obs.EV_DVM_REHYDRATE, len(live), jobs,
+                          _obs.intern(self.incarnation))
+        inflight = sum(len(s) for s in wal.values())
+        sys.stderr.write(
+            f"tpu-dvm: rehydrated {len(live)} session(s), {jobs} "
+            f"completed job(s), {inflight} in-flight jobid(s) from "
+            f"{path} (incarnation {self.incarnation})\n")
+
     # -- admission ---------------------------------------------------------
 
     def _can_admit_locked(self, np_: int, resume: bool = False) -> bool:
@@ -925,6 +1265,9 @@ class DVMServer:
                     sess = w.resume
                     self.active_ranks += w.np
                     sess.parked = False
+                    if sess.rehydrated:
+                        sess.rehydrated = False
+                        self.rehydrated_parked -= 1
                     sess.epoch = self.pool_epoch
                     w.sess = sess
                 else:
@@ -1047,6 +1390,9 @@ class DVMServer:
                 if not sess.parked:  # a parked session's ranks were
                     # already returned when it was preempted
                     self.active_ranks -= sess.np
+                if sess.rehydrated:
+                    sess.rehydrated = False
+                    self.rehydrated_parked -= 1
                 _pv_active.add(-1)
                 self._set_xsession_hint(len(self.sessions))
         self._pump()
@@ -1086,7 +1432,7 @@ class DVMServer:
                                               None) is not None:
                     st.progress.wakeup()
         try:
-            kvc = KVClient(self.kv_server.addr, ns=sess.ns)
+            kvc = KVClient(self.kv_server.uri, ns=sess.ns)
             kvc.abort(-1, code, why)
             kvc.close()
         except OSError:
@@ -1205,6 +1551,7 @@ class DVMServer:
             self.pool_epoch += 1
             epoch = self.pool_epoch
         _pv_resizes.add(1)
+        self._jrec({"t": "epoch", "epoch": epoch, "cap": new_cap})
         _obs.record_event(_obs.EV_DVM_RESIZE, old, new_cap, epoch)
         tr = trace.global_tracer()
         if tr is not None:
@@ -1262,7 +1609,7 @@ class DVMServer:
 
         def boot(rank: int) -> None:
             try:
-                rte = SessionRTE(world, rank, self.kv_server.addr,
+                rte = SessionRTE(world, rank, self.kv_server.uri,
                                  node_id=0, jobid=sess.jobid,
                                  session_dir=sess.dir, kv_ns=sess.ns)
                 if self.devices:
@@ -1294,7 +1641,7 @@ class DVMServer:
                     world.aborted = (rank, 1, f"bring-up failed: {e}")
                 # release peers parked in this session's init fences
                 try:
-                    kvc = KVClient(self.kv_server.addr, ns=sess.ns)
+                    kvc = KVClient(self.kv_server.uri, ns=sess.ns)
                     kvc.abort(rank, 1, f"bring-up failed: {e}")
                     kvc.close()
                 except OSError:
@@ -1475,6 +1822,7 @@ class DVMServer:
                                "progress; detach after it completes")
             sess.detaching = True
         _obs.record_event(_obs.EV_DVM_DETACH, sid)
+        self._jrec({"t": "detach", "sid": sid})
         self._destroy(sess)
         self._release(sess)
         self._write_proctable()
@@ -1516,7 +1864,7 @@ class DVMServer:
         the pool is long-lived, leaks accumulate forever."""
         from ompi_tpu.runtime.kvstore import KVClient
         try:
-            kvc = KVClient(self.kv_server.addr, ns=sess.ns)
+            kvc = KVClient(self.kv_server.uri, ns=sess.ns)
             kvc.purge("")
             kvc.close()
         except OSError:
@@ -1582,30 +1930,57 @@ class DvmClient:
     """Session-multiplexing client.  Heartbeat-aware: while a request
     is in flight the pool beats every dvm_heartbeat_s; a client that
     misses ~3 beats raises a friendly DvmError instead of the old
-    settimeout(None) forever-hang."""
+    settimeout(None) forever-hang.
+
+    Crash recovery (DESIGN.md §20): ``attach`` hands back a session
+    token; if the pool connection dies mid-``run`` the client re-reads
+    the uri file (a supervisor-respawned server rewrites it with a NEW
+    incarnation id), reconnects, ``reattach``es by token, and replays
+    the run under its original client-generated jobid — the server's
+    journal-backed dedup makes the replay exactly-once."""
 
     def __init__(self, uri_file: str,
                  connect_timeout: float = 10.0) -> None:
         self.uri_file = uri_file
+        self.incarnation: Optional[str] = None
+        self._tokens: Dict[int, str] = {}
+        self._jobid_n = itertools.count()
+        self._dial(connect_timeout)
+        self._hb = max(0.5, float(_hb_var.value))
+        from ompi_tpu import ft_inject
+        self._inject = ft_inject.dvm_injector(0)
+
+    def _dial(self, connect_timeout: float = 10.0) -> None:
+        """(Re)connect from the uri file.  Line 1 is host:port (the
+        original one-line format still parses); line 2, when present,
+        is the incarnation doc — a changed incarnation means the
+        server was restarted behind the same file."""
         try:
-            with open(uri_file) as f:
-                host, _, port = f.read().strip().partition(":")
+            with open(self.uri_file) as f:
+                host, _, port = f.readline().strip().partition(":")
+                doc_line = f.readline().strip()
         except FileNotFoundError:
             raise DvmError(
-                f"DVM uri-file {uri_file} not found — is the pool "
-                "running?  (start one: python -m ompi_tpu.tools.dvm "
-                f"--np N --uri-file {uri_file})") from None
+                f"DVM uri-file {self.uri_file} not found — is the "
+                "pool running?  (start one: python -m "
+                "ompi_tpu.tools.dvm "
+                f"--np N --uri-file {self.uri_file})") from None
         try:
             self.sock = socket.create_connection(
                 (host, int(port)), timeout=connect_timeout)
         except OSError as e:
             raise DvmError(
-                f"stale uri-file {uri_file}: no DVM pool listening at "
-                f"{host}:{port} ({e}) — the pool has likely exited; "
-                "remove the file and start a new pool") from None
-        self._hb = max(0.5, float(_hb_var.value))
-        from ompi_tpu import ft_inject
-        self._inject = ft_inject.dvm_injector(0)
+                f"stale uri-file {self.uri_file}: no DVM pool "
+                f"listening at {host}:{port} ({e}) — the pool has "
+                "likely exited; remove the file and start a new "
+                "pool") from None
+        self.incarnation = None
+        if doc_line:
+            try:
+                self.incarnation = json.loads(doc_line).get(
+                    "incarnation")
+            except ValueError:
+                pass
 
     def _await(self, deadline: Optional[float] = None) -> dict:
         while True:
@@ -1620,8 +1995,11 @@ class DvmClient:
                     "DVM pool stopped responding (no heartbeat for "
                     f"{max(5.0, 3.0 * self._hb):.0f}s) — the pool is "
                     "hung or dead") from None
+            except OSError as e:
+                raise DvmDisconnect(
+                    f"lost connection to the DVM pool: {e}") from None
             if resp is None:
-                raise DvmError("DVM pool closed the connection")
+                raise DvmDisconnect("DVM pool closed the connection")
             if resp.get("event") == "hb":
                 continue
             return resp
@@ -1631,9 +2009,50 @@ class DvmClient:
         try:
             _send(self.sock, msg)
         except OSError as e:
-            raise DvmError(
+            raise DvmDisconnect(
                 f"lost connection to the DVM pool: {e}") from None
         return self._await(deadline)
+
+    def _reconnect(self, sid: int,
+                   timeout: float = 30.0) -> List[str]:
+        """Kill-to-reattach recovery: poll the uri file until a live
+        server answers (the supervisor needs a moment to respawn),
+        then re-bind the session by token.  Returns the jobids the
+        server journaled as in-flight at the crash (the caller must
+        resubmit those).  Raises DvmError when the session cannot be
+        recovered — never silently."""
+        token = self._tokens.get(sid)
+        if token is None:
+            raise DvmError(f"cannot recover session s{sid}: no "
+                           "session token (attached elsewhere?)")
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+        deadline = time.monotonic() + timeout
+        last: Optional[Exception] = None
+        while time.monotonic() < deadline:
+            try:
+                self._dial(connect_timeout=2.0)
+                resp = self._rpc({"op": "reattach", "sid": sid,
+                                  "token": token})
+            except DvmDisconnect as e:
+                last = e  # dialed a dying socket: keep polling
+                time.sleep(0.05)
+                continue
+            except DvmError as e:
+                last = e  # uri file stale/missing: server respawning
+                time.sleep(0.05)
+                continue
+            if "error" in resp:
+                # the server ANSWERED: this verdict is final (bad
+                # token, session truly gone) — do not spin on it
+                raise DvmError(f"session s{sid} not recovered: "
+                               f"{resp['error']}")
+            return list(resp.get("inflight") or [])
+        raise DvmError(
+            f"session s{sid} not recovered within {timeout:.0f}s: "
+            f"{last}")
 
     @staticmethod
     def _raise_typed(resp: dict) -> None:
@@ -1653,6 +2072,23 @@ class DvmClient:
             if timeout else None)
         if "error" in resp:
             self._raise_typed(resp)
+        if "token" in resp:
+            self._tokens[int(resp["sid"])] = resp["token"]
+        return resp
+
+    def reattach(self, sid: int, token: Optional[str] = None) -> dict:
+        """Re-bind a session on this connection by token (after a
+        reconnect, or from a different client process that was handed
+        the token).  Returns the server reply, whose ``inflight`` list
+        names jobids journaled as started but never completed."""
+        if token is not None:
+            self._tokens[sid] = token
+        tok = self._tokens.get(sid)
+        if tok is None:
+            raise DvmError(f"reattach s{sid}: no session token")
+        resp = self._rpc({"op": "reattach", "sid": sid, "token": tok})
+        if "error" in resp:
+            self._raise_typed(resp)
         return resp
 
     def run(self, sid: int, prog: str, args=(),
@@ -1660,12 +2096,16 @@ class DvmClient:
             deadline_ms: Optional[int] = None) -> dict:
         msg: Dict[str, Any] = {"op": "run", "sid": sid,
                                "prog": os.path.abspath(prog),
-                               "args": list(args)}
+                               "args": list(args),
+                               "jobid": f"c{os.getpid()}-"
+                                        f"{next(self._jobid_n)}"}
         if deadline_ms is not None:
             msg["deadline_ms"] = int(deadline_ms)
         try:
             _send(self.sock, msg)
         except OSError as e:
+            if sid in self._tokens:
+                return self._replay_run(sid, msg, timeout)
             raise DvmError(
                 f"lost connection to the DVM pool: {e}") from None
         if self._inject is not None and self._inject.disconnect():
@@ -1675,8 +2115,26 @@ class DvmClient:
             self.close()
             raise DvmError(
                 "ft_inject dvm_disconnect: client dropped mid-run")
-        resp = self._await(
-            time.monotonic() + timeout if timeout else None)
+        try:
+            resp = self._await(
+                time.monotonic() + timeout if timeout else None)
+        except DvmDisconnect:
+            if sid in self._tokens:
+                # the pool died with our run in flight: reconnect
+                # (the supervisor respawns it), reattach by token,
+                # and resubmit THE SAME jobid — the journal dedup
+                # makes this exactly-once, never silently lost
+                return self._replay_run(sid, msg, timeout)
+            raise
+        if "error" in resp:
+            self._raise_typed(resp)
+        return resp
+
+    def _replay_run(self, sid: int, msg: dict,
+                    timeout: Optional[float]) -> dict:
+        self._reconnect(sid)
+        resp = self._rpc(msg, deadline=(time.monotonic() + timeout
+                                        if timeout else None))
         if "error" in resp:
             self._raise_typed(resp)
         return resp
@@ -1825,6 +2283,88 @@ class _Tee(io.TextIOBase):
         self.real.flush()
 
 
+# -- supervisor -------------------------------------------------------------
+
+class Supervisor:
+    """Respawn loop for a control-plane subprocess (the errmgr/HNP
+    restart analog): start the child, wait, and while it keeps dying
+    abnormally, start it again — the rewritten uri file plus journal
+    rehydration make the respawn invisible to token-holding clients
+    beyond a reconnect.  A clean exit (halt → rc 0) ends the loop."""
+
+    def __init__(self, child_argv: List[str],
+                 env: Optional[Dict[str, str]] = None,
+                 max_restarts: int = 16,
+                 respawn_env: Optional[Dict[str, str]] = None) -> None:
+        self.child_argv = list(child_argv)
+        self.env = env
+        # chaos probes arm a one-shot ft_inject kill in the FIRST
+        # child's env; respawns must come up with the plan cleared or
+        # every incarnation re-arms and dies at the same op count —
+        # respawn_env is the "kill once, then heal" environment
+        self.respawn_env = respawn_env
+        self.max_restarts = max_restarts
+        self.restarts = 0
+        self.proc: Any = None
+        self._stop = False
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+
+    def _spawn(self):
+        import subprocess
+        env = self.env
+        if self.restarts > 0 and self.respawn_env is not None:
+            env = self.respawn_env
+        return subprocess.Popen(self.child_argv, env=env)
+
+    def run_forever(self) -> int:
+        """Foreground mode (CLI --supervise): returns the child's
+        final exit code once it exits cleanly or restarts are
+        exhausted."""
+        while True:
+            with self._lock:
+                if self._stop:
+                    return 0
+                self.proc = self._spawn()
+            rc = self.proc.wait()
+            if self._stop or rc == 0:
+                return rc
+            if self.restarts >= self.max_restarts:
+                sys.stderr.write(
+                    f"tpu-dvm supervisor: child died rc={rc} and "
+                    f"restart budget ({self.max_restarts}) is spent "
+                    "— giving up\n")
+                return rc
+            self.restarts += 1
+            sys.stderr.write(
+                f"tpu-dvm supervisor: child died rc={rc}; respawn "
+                f"{self.restarts}/{self.max_restarts}\n")
+
+    def start(self) -> "Supervisor":
+        """Background mode (embedders, chaos probes)."""
+        self._thread = threading.Thread(target=self.run_forever,
+                                        daemon=True,
+                                        name="dvm-supervisor")
+        self._thread.start()
+        return self
+
+    def stop(self, kill: bool = False) -> None:
+        with self._lock:
+            self._stop = True
+            proc = self.proc
+        if proc is not None and proc.poll() is None:
+            try:
+                proc.kill() if kill else proc.terminate()
+            except OSError:
+                pass
+            try:
+                proc.wait(timeout=10)
+            except Exception:  # noqa: BLE001
+                pass
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+
 # -- CLI entry points -------------------------------------------------------
 
 def serve(opts) -> int:
@@ -1837,6 +2377,30 @@ def serve(opts) -> int:
         devices = jax.devices()  # PJRT bring-up happens HERE, once
     server = DVMServer(opts.np, devices=devices,
                        uri_file=opts.uri_file)
+    # chaos: dvm_kill is armed ONLY here, on a real subprocess server
+    # — an embedded pool shares the test process, and os._exit(70)
+    # would take the whole suite with it
+    from ompi_tpu import ft_inject
+    server._kill = ft_inject.dvm_kill_injector()
+
+    def _on_signal(signum, frame) -> None:
+        # an operator (or supervisor) killed the pool: the flight
+        # recorder and journal must outlive the process — the journal
+        # is what the respawned incarnation rehydrates from
+        try:
+            server._persist_events(signal.Signals(signum).name)
+        except Exception:  # noqa: BLE001
+            pass
+        j = server._journal
+        if j is not None:
+            j.tick()
+        raise SystemExit(128 + signum)
+
+    try:
+        signal.signal(signal.SIGTERM, _on_signal)
+        signal.signal(signal.SIGINT, _on_signal)
+    except ValueError:
+        pass  # not the main thread (embedded serve): skip handlers
     return server.serve_forever()
 
 
@@ -1900,7 +2464,20 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--ctrl", action="store_true",
                     help="enable the FleetController closed loop "
                          "(dvm_ctrl=1)")
+    ap.add_argument("--supervise", action="store_true",
+                    help="run the pool under a respawning supervisor: "
+                         "an abnormally-dying server is restarted and "
+                         "rehydrates its sessions from the journal "
+                         "(clean halt ends the loop)")
     opts = ap.parse_args(argv)
+    if opts.supervise:
+        if not opts.uri_file:
+            ap.error("--supervise needs --uri-file (the journal "
+                     "lives next to it)")
+        child = [sys.executable, "-m", "ompi_tpu.tools.dvm"] + [
+            a for a in (argv if argv is not None else sys.argv[1:])
+            if a != "--supervise"]
+        return Supervisor(child).run_forever()
     if opts.halt:
         return halt(opts.halt)
     if opts.resize is not None:

@@ -93,6 +93,13 @@ HOT_FUNCTIONS: Dict[str, Tuple[str, ...]] = {
         "DeviceWindow.get",
         "DeviceWindow._acc_entry",
     ),
+    # the session-journal flush tick rides the DVM heartbeat loop
+    # every period for the life of the pool (ISSUE 15): a dirty-flag
+    # check that is allocation-free when no bookkeeping record is
+    # pending — the common case, since attach/detach are rare
+    "ompi_tpu/tools/dvm.py": (
+        "_Journal.tick",
+    ),
 }
 
 _BANNED_BUILTIN_CALLS = ("dict", "list", "set", "tuple", "frozenset")

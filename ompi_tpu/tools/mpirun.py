@@ -297,7 +297,7 @@ def run_multinode(opts, nodes, rpp: int, hybrid: bool) -> int:
             **getattr(opts, "ckpt_env", {}),
             "TPUMPI_BIND": opts.bind_to,
             "TPUMPI_SIZE": str(opts.np),
-            "TPUMPI_KV_ADDR": server.addr,
+            "TPUMPI_KV_ADDR": server.uri,
             "TPUMPI_JOBID": f"job-{os.getpid()}",
             "TPUMPI_JOB_SECRET": os.environ["TPUMPI_JOB_SECRET"],
         }
@@ -627,7 +627,7 @@ def run_local(opts, rpp: int, hybrid: bool, ckpt_env: dict) -> int:
         "TPUMPI_BIND": opts.bind_to,
         "TPUMPI_SIZE": str(opts.np),
         "TPUMPI_LOCAL_SIZE": str(opts.np),  # single-host launch
-        "TPUMPI_KV_ADDR": server.addr,
+        "TPUMPI_KV_ADDR": server.uri,
         "TPUMPI_SESSION_DIR": session,
         "TPUMPI_JOBID": f"job-{os.getpid()}",
     })

@@ -67,9 +67,18 @@ def render(m: dict, events: int = 8) -> str:
                          f"  p90 {p.get('p90', 0):>9.0f}"
                          f"  p99 {p.get('p99', 0):>9.0f}"
                          f"  (n={total})")
+    # control-plane resilience (DESIGN.md §20): shown only once the
+    # KV client has actually retried/failed-over — a quiet pool keeps
+    # a quiet frame
+    pv = m.get("pvars", {})
+    kv_r = pv.get("kv_retries", 0)
+    kv_f = pv.get("kv_failovers", 0)
+    kv_c = pv.get("kv_reconnects", 0)
+    if kv_r or kv_f or kv_c:
+        lines.append(f"  ctrl-plane: kv_retries {kv_r}  "
+                     f"kv_reconnects {kv_c}  kv_failovers {kv_f}")
     # critical-path profiler gauges (DESIGN.md §18): what phase is
     # eating the dispatch budget right now, and how skewed arrivals are
-    pv = m.get("pvars", {})
     gating = pv.get("obs_critpath_gating_phase")
     phase_us = pv.get("obs_critpath_phase_us")
     if gating or phase_us:

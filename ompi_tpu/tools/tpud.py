@@ -307,15 +307,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         node's ranks with it.  Exponential backoff + jitter within a
         retry budget; only an exhausted budget falls back to the
         orphan-kill behavior."""
-        import random
         tr = trace.global_tracer()
         t0 = tr.start() if tr is not None else None
         delay = max(0.01, oob.retry_delay_var.value)
         for attempt in range(max(1, oob.retry_max_var.value)):
             if done.is_set() or killed.is_set():
                 return
-            time.sleep(delay * (0.5 + random.random()))
-            delay = min(5.0, delay * 2)
+            # shared control-plane pacing: same jittered policy the
+            # KV failover sleeps on (oob.backoff_s, DESIGN.md §20)
+            time.sleep(oob.backoff_s(attempt, delay))
             try:
                 ch = oob.connect(opts.hnp, handle, on_close, timeout=10)
                 ch.send(register_msg(reconnect=True))

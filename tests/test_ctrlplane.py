@@ -1,0 +1,186 @@
+"""Control-plane fault-tolerance tests (docs/DESIGN.md §20): the
+replicated KV store must survive a primary kill with ranks PARKED in
+a fence (the standby completes the fence from replicated arrivals —
+never re-creates it), the kv_kill/dvm_kill chaos classes must be
+deterministic and off by default, and the Supervisor's respawn loop
+must heal the fault plan so a killed child comes back clean."""
+
+import os
+import sys
+import threading
+import time
+
+from ompi_tpu.mca.params import registry
+from ompi_tpu.runtime.kvstore import KVClient, KVServer, _kv_pvars
+
+
+def _set(vals):
+    saved = {k: registry.get(k) for k in vals}
+    for k, v in vals.items():
+        registry.set(k, v)
+    return saved
+
+
+def _restore(saved):
+    for k, v in saved.items():
+        registry.set(k, v)
+
+
+def _pvar(suffix):
+    for p in _kv_pvars():
+        if p.full_name.endswith(suffix):
+            return p
+    raise AssertionError(f"no kv pvar ending with {suffix}")
+
+
+def test_kill_injectors_disabled_by_default():
+    """Empty plan = no injector objects: a production KVServer or DVM
+    never pays for chaos plumbing."""
+    from ompi_tpu import ft_inject
+    assert not ft_inject.enabled()
+    assert ft_inject.kv_kill_injector() is None
+    assert ft_inject.dvm_kill_injector() is None
+
+
+def test_kill_injector_fires_exactly_once_at_count():
+    """The armed op count is deterministic (no RNG): False until op
+    N, True AT op N, False forever after — a chaos run replays
+    bit-for-bit."""
+    from ompi_tpu.ft_inject import KillInjector
+    ki = KillInjector("kv", 5)
+    assert [ki.op() for _ in range(10)] == \
+        [False] * 4 + [True] + [False] * 5
+    # rates below 1 (a bare class name got the default rate) arm the
+    # mid-run default instead of dying on the first op
+    assert KillInjector("dvm", 0.02).after_ops == 64
+
+
+def test_kv_primary_kill_mid_fence_failover():
+    """The acceptance scenario: three clients parked in an n=4 fence
+    when the primary dies.  The promoted standby holds the fence's
+    replicated arrivals, the straggler lands on the standby, and ALL
+    FOUR complete — plus data and counters written before the kill
+    survive it."""
+    srv = KVServer(4, replicas=1)
+    clients = [KVClient(srv.uri) for _ in range(4)]
+    clients[0].put("pre/kill", "v1")
+    clients[0].incr("pre/ctr")
+    failovers0 = _pvar("failovers").read()
+    done = [False] * 4
+    errs = []
+    release = threading.Event()
+
+    def worker(i):
+        try:
+            if i == 3:
+                release.wait(30)
+            clients[i].fence("chaos", n=4)
+            done[i] = True
+        except Exception as e:  # noqa: BLE001
+            errs.append((i, repr(e)))
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.3)    # workers 0-2 are parked inside the fence
+    srv.crash()        # hard primary death, nothing flushed politely
+    release.set()      # the straggler arrives — at the STANDBY
+    for t in threads:
+        t.join(timeout=60)
+    assert not errs, errs
+    assert all(done), done
+    assert clients[0].get("pre/kill", timeout=10) == "v1"
+    # incr returns the PRE-increment value: exactly one incr happened
+    # before the kill, so the replicated counter must read 1 now
+    assert clients[1].incr("pre/ctr") == 1
+    assert _pvar("failovers").read() > failovers0
+    for c in clients:
+        c.close()
+    srv.close()
+
+
+def test_kv_kill_class_crashes_primary_at_op_count():
+    """The MCA-armed path end to end: ft_inject_plan=kv_kill:N makes
+    the primary hard-crash serving its Nth op; the client's failover
+    absorbs it mid-stream and every op lands."""
+    saved = _set({"ft_inject_plan": "kv_kill:10"})
+    try:
+        srv = KVServer(2, replicas=1)
+        assert srv._kill is not None, \
+            "replicated server must arm the planned kv_kill"
+        c = KVClient(srv.uri)
+        for k in range(30):    # death at op 10, failover, keep going
+            c.put(f"a/{k}", k)
+        assert c.get("a/29", timeout=10) == 29
+        assert c.get("a/5", timeout=10) == 5  # pre-kill data survived
+        c.close()
+        srv.close()
+    finally:
+        _restore(saved)
+
+
+def test_kv_kill_not_armed_without_replica():
+    """kv_kill on a replicas=0 server would kill the only copy — the
+    class only arms when there is a standby to fail over to."""
+    saved = _set({"ft_inject_plan": "kv_kill:10"})
+    try:
+        srv = KVServer(1, replicas=0)
+        assert srv._kill is None
+        srv.close()
+    finally:
+        _restore(saved)
+
+
+def test_supervisor_respawns_and_heals_fault_plan(tmp_path):
+    """Kill once, then heal: the first child sees the armed chaos env
+    and dies; the respawn runs under respawn_env with the plan
+    cleared and exits 0, which ends the loop."""
+    from ompi_tpu.tools.dvm import Supervisor
+    marker = str(tmp_path / "runs.txt")
+    prog = ("import os,sys\n"
+            f"open({marker!r},'a').write("
+            "os.environ.get('PROBE_CHAOS','-')+'\\n')\n"
+            "sys.exit(7 if os.environ.get('PROBE_CHAOS') else 0)\n")
+    env = dict(os.environ)
+    env["PROBE_CHAOS"] = "1"
+    heal = dict(os.environ)
+    heal.pop("PROBE_CHAOS", None)
+    sup = Supervisor([sys.executable, "-c", prog], env=env,
+                     respawn_env=heal)
+    rc = sup.run_forever()
+    assert rc == 0
+    assert sup.restarts == 1
+    with open(marker) as f:
+        assert f.read().split() == ["1", "-"]
+
+
+def test_controller_holds_shrink_while_rehydrated_sessions_parked():
+    """A freshly rehydrated pool has zero active ranks and an empty
+    queue — exactly what the controller's idle-shrink predicate
+    matches.  Shrinking there would yank capacity from under sessions
+    whose clients are mid-reconnect; the rehydrated_parked count must
+    inhibit the shrink until every one resumes or detaches."""
+    from ompi_tpu.serve.controller import FleetController
+
+    class _Stub:
+        capacity = 8
+        active_ranks = 0
+        _waiters = ()
+        est_wall_us = 0
+        rehydrated_parked = 2
+
+    srv = _Stub()
+    fc = FleetController(srv, floor=2, ceil=8)
+    fc.shrink_ticks = 2
+    now = 0
+    for _ in range(10):
+        now += fc.interval_ns + 1
+        fc.tick(now)
+    assert fc.want_capacity == 0, \
+        "controller shrank a pool still holding rehydrated sessions"
+    srv.rehydrated_parked = 0   # every session resumed or detached
+    for _ in range(10):
+        now += fc.interval_ns + 1
+        fc.tick(now)
+    assert fc.want_capacity == fc.floor

@@ -425,3 +425,74 @@ def test_early_rank_exit_releases_run_boundary_fence(pool):
             assert not srv.sessions, "session never released"
     finally:
         os.unlink(prog)
+
+
+def test_same_jobid_submitted_twice_runs_exactly_once(pool):
+    """The reconnect-with-replay idempotency contract (DESIGN.md
+    §20): a resubmitted jobid whose run already completed is
+    acknowledged from the session's replay memory — same exit code,
+    replayed=True, and the program does NOT execute a second time
+    (the cached reply carries no stdout; a re-run would)."""
+    srv, uri = pool
+    with DvmClient(uri) as c:
+        sid = c.attach(2)["sid"]
+        msg = {"op": "run", "sid": sid,
+               "prog": os.path.abspath(PROG), "args": ["dedup"],
+               "jobid": "t-dedup-1"}
+        r1 = c._rpc(dict(msg))
+        assert r1["code"] == 0 and not r1.get("replayed"), r1
+        assert "DIGEST dedup " in r1["stdout"]
+        r2 = c._rpc(dict(msg))
+        assert r2.get("replayed") is True, r2
+        assert r2["code"] == 0
+        assert r2["stdout"] == ""
+        c.detach(sid)
+
+
+def test_journal_rehydration_reattach_and_run(tmp_path):
+    """Crash recovery end to end in-process: a journal left behind by
+    a dead incarnation (simulated by resurrecting the file a clean
+    stop deleted) makes the next server rehydrate the session PARKED;
+    the client reattaches by token on the NEW incarnation and runs —
+    the session's identity survived the crash."""
+    uri = str(tmp_path / "dvm.uri")
+    srv = DVMServer(4, devices=jax.devices(), uri_file=uri).start()
+    jpath = uri + ".journal.jsonl"
+    c = DvmClient(uri)
+    sid = c.attach(2)["sid"]
+    token = c._tokens[sid]
+    inc1 = c.incarnation
+    assert inc1
+    with open(jpath, "rb") as f:
+        journal = f.read()   # open + quota + attach records
+    c.close()                # NOT detach: the session was live
+    srv.stop()               # clean stop deletes the journal...
+    assert not os.path.exists(jpath)
+    with open(jpath, "wb") as f:
+        f.write(journal)     # ...resurrect it: a crash left this
+    srv2 = DVMServer(4, devices=jax.devices(), uri_file=uri).start()
+    try:
+        assert srv2.rehydrated == 1
+        c2 = DvmClient(uri)
+        assert c2.incarnation and c2.incarnation != inc1
+        r = c2.reattach(sid, token)
+        assert r["ok"] and r["parked"], r
+        resp = c2.run(sid, PROG, ["rehyd"], timeout=120)
+        assert resp["code"] == 0, resp.get("stderr", "")[-2000:]
+        assert "DIGEST rehyd " in resp["stdout"]
+        c2.detach(sid)
+        c2.close()
+    finally:
+        srv2.stop()
+
+
+def test_reattach_bad_token_refused(pool):
+    """A token mismatch is a FINAL verdict (the session belongs to
+    someone else) — never a silent takeover."""
+    srv, uri = pool
+    with DvmClient(uri) as c:
+        sid = c.attach(1)["sid"]
+        with DvmClient(uri) as thief:
+            with pytest.raises(DvmError, match="token"):
+                thief.reattach(sid, "not-the-token")
+        c.detach(sid)
