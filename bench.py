@@ -187,10 +187,13 @@ def main() -> None:
                          "shedding), checkpoint-resume byte-identity "
                          "of a preempted run, and live pool resize "
                          "under traffic with zero failed jobs and "
-                         "exact per-band pvar sums; persist under "
+                         "exact per-band pvar sums, plus the N-host "
+                         "mode: a 2-host fleet of real tpud agents "
+                         "survives a whole-host SIGKILL mid-collective "
+                         "(host_kill_mttr_ms, zero failed jobs under "
+                         "host-granularity resize); persist under "
                          "'probe_fleet' in BENCH_DETAIL.json, and "
-                         "FAIL (exit 1) if any of the three "
-                         "invariants breaks")
+                         "FAIL (exit 1) if any invariant breaks")
     ap.add_argument("--probe-rma", action="store_true",
                     help="Measure one-sided RMA for BOTH osc "
                          "components (device vs pt2pt host-AM): "
@@ -506,11 +509,13 @@ def main() -> None:
         notes = persist(probe, detail_path)
         ov, pr, rz = (probe["overload"], probe["preempt_resume"],
                       probe["resize"])
+        ho = probe["hosts"]
         line = {
             "metric": f"dvm fleet control plane, "
                       f"{ov['low_submitters']}x np{ov['low_np']} "
                       f"overload vs np{ov['hi_np']} priority burst + "
-                      f"preempt-resume + live resize",
+                      f"preempt-resume + live resize + "
+                      f"{ho['hosts']}-host chaos",
             "value": ov["hi_p99_vs_unloaded"],
             "unit": "hi_p99_vs_unloaded_ratio",
             "hi_p99_ms": ov["hi_p99_ms"],
@@ -523,6 +528,10 @@ def main() -> None:
             "resumed_at_step": pr["resumed_at_step"],
             "resize_ok": rz["resize_ok"],
             "band_sums_exact": rz["band_sums_exact"],
+            "hosts": ho["hosts"],
+            "host_kill_mttr_ms": ho["host_kill_mttr_ms"],
+            "host_jobs_failed": ho["traffic_jobs_failed"],
+            "hosts_ok": ho["hosts_ok"],
             "within_budget": probe["within_budget"],
         }
         line.update({k: v for k, v in notes.items() if "error" in k})
@@ -534,7 +543,8 @@ def main() -> None:
                 f"{ov['priority_ok']} (p99 ratio "
                 f"{ov['hi_p99_vs_unloaded']}x vs "
                 f"{ov['priority_factor']}x budget), resume_ok="
-                f"{pr['resume_ok']}, resize_ok={rz['resize_ok']}\n")
+                f"{pr['resume_ok']}, resize_ok={rz['resize_ok']}, "
+                f"hosts_ok={ho['hosts_ok']}\n")
             sys.exit(1)
         return
 

@@ -21,6 +21,20 @@ plane (ISSUE 12), proven against a live in-process pool:
    recorded, and every ScopedPvar holds global == sum(bands)
    (attribution exactness across resize epochs).
 
+4. **N-host mode (ISSUE 16, DESIGN.md §21).**  A 2-host fleet with
+   two REAL ``tpud --fleet`` host-agent subprocesses.  One attach
+   commands a world spanning both domains; host 1's daemon is then
+   SIGKILLed mid-collective so the pool's heartbeat-silence detector
+   (not an RPC shortcut) marks the whole domain lost — the ULFM
+   survivors shrink around ONE atomic failure set and the job still
+   exits 0.  ``host_kill_mttr_ms`` (daemon SIGKILL -> domain
+   respawned) is the --regress-tracked recovery metric.  Then
+   host-granularity resize under traffic: submitters stream
+   DCN-spanning jobs while host 1 is killed and respawned under
+   them — ZERO failed jobs (in-flight runs replay transparently on
+   the rehydrated fleet), and a fresh agent re-registers under the
+   same fleet incarnation.
+
 Results land in BENCH_DETAIL.json under ``probe_fleet``.
 """
 
@@ -28,6 +42,9 @@ from __future__ import annotations
 
 import json
 import os
+import signal
+import subprocess
+import sys
 import threading
 import time
 from typing import Dict, List
@@ -43,9 +60,16 @@ PRIORITY_FACTOR = 2.0    # hi p99 under overload vs unloaded p99
 CKPT_STEPS = 10
 CKPT_SLEEP_S = 0.2
 
+HOSTS = 2                # fleet width of the N-host probe
+HOST_STEPS = 120         # shrink-arm workload loop bound
+HOST_TRAFFIC_RUNS = 8    # per streaming submitter, part 4
+HOST_TRAFFIC_PACE_S = 0.08  # inter-run pacing so the kill lands
+                            # under live traffic, not after it
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PROG = os.path.join(REPO, "tests", "_dvm_prog.py")
 CKPT_PROG = os.path.join(REPO, "tests", "_fleet_ckpt_prog.py")
+HOST_PROG = os.path.join(REPO, "tests", "_fleet_host_prog.py")
 
 
 def _pct(sorted_vals: List[float], p: float) -> float:
@@ -368,6 +392,181 @@ def _probe_resize(tmpdir: str) -> Dict:
         srv.stop()
 
 
+# -- part 4: N-host fleet — whole-host death under ULFM + traffic -----------
+
+
+def _spawn_agent(uri: str, host: int) -> subprocess.Popen:
+    """One REAL tpud host-agent process per failure domain: its PID is
+    the liveness signal the pool's silence detector watches."""
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.Popen(
+        [sys.executable, "-m", "ompi_tpu.tools.tpud",
+         "--fleet", uri, "--host", str(host)],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+
+def _wait(pred, timeout: float, what: str) -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise RuntimeError(f"timed out waiting for {what}")
+
+
+def _host_lines(stdout: str, kind: str, tag: str) -> List[List[str]]:
+    out = []
+    for line in stdout.splitlines():
+        parts = line.split()
+        if len(parts) >= 3 and parts[0] == kind and parts[1] == tag:
+            out.append(parts[2:])
+    return out
+
+
+def _probe_hosts(tmpdir: str) -> Dict:
+    import jax
+
+    from ompi_tpu.mca.params import registry
+    from ompi_tpu.tools.dvm import DVMServer, DvmClient
+
+    # tighten the beat so the silence horizon (3 beats + host grace)
+    # is probe-sized; the agents pace themselves off the grace the
+    # pool hands back at registration
+    hb0 = registry.get("dvm_heartbeat_s")
+    registry.set("dvm_heartbeat_s", 0.2)
+    uri = os.path.join(tmpdir, f"fleet-{time.time_ns()}.uri")
+    srv = DVMServer(CAPACITY, devices=jax.devices(), uri_file=uri,
+                    hosts=HOSTS)
+    srv.start()
+    agents: Dict[int, subprocess.Popen] = {}
+    try:
+        for h in range(HOSTS):
+            agents[h] = _spawn_agent(uri, h)
+        _wait(lambda: all(b > 0 for b in srv._host_beat), 120,
+              "both tpud host agents to register")
+
+        # -- multi-host attach + SIGKILL a daemon mid-collective ----
+        # control ops ride their own client: `c`'s socket is busy
+        # inside the blocking run RPC when the respawn lands
+        admin = DvmClient(uri)
+        c = DvmClient(uri)
+        r = c.attach(CAPACITY, timeout=180)
+        attach_hosts = int(r.get("hosts", 1))
+        sid = r["sid"]
+        res: Dict = {}
+
+        def chaos_run() -> None:
+            res.update(c.run(sid, HOST_PROG,
+                             ["pf", str(HOST_STEPS)], timeout=300))
+
+        th = threading.Thread(target=chaos_run)
+        th.start()
+        _wait(lambda: srv.sessions[sid].running, 60, "chaos session")
+        time.sleep(0.6)  # mid-loop, far from step HOST_STEPS
+        t_kill = time.perf_counter()
+        agents[1].send_signal(signal.SIGKILL)  # a real dead daemon
+        _wait(lambda: srv._host_dead[1] == 1, 60,
+              "heartbeat silence to mark host 1 lost")
+        detect_ms = (time.perf_counter() - t_kill) * 1e3
+        respawn_ms = float(admin.respawn_host(1)["mttr_ms"])
+        th.join(timeout=300)
+        code = res.get("code", -1)
+        shrinks = _host_lines(res.get("stdout", ""), "SHRINKS", "pf")
+        digs = _host_lines(res.get("stdout", ""), "DIGEST", "pf")
+        survivors = sorted(int(s[0]) for s in shrinks)
+        one_set = bool(survivors == [0, 1]
+                       and all(int(s[1]) == 1 for s in shrinks))
+        identical = bool(len(digs) == 2 and digs[0] == digs[1])
+        c.detach(sid)
+
+        # the replacement daemon re-registers under the SAME fleet
+        # incarnation (respawn_host reset the domain's beat slot)
+        agents[1].wait(timeout=30)
+        agents[1] = _spawn_agent(uri, 1)
+        _wait(lambda: srv._host_beat[1] > 0, 120,
+              "replacement agent to rejoin host 1")
+
+        # -- host-granularity resize under streaming traffic --------
+        # np=2 sessions span both domains (rank banding), so killing
+        # host 1 poisons every in-flight run; with ULFM off they must
+        # REPLAY on the rehydrated fleet — zero failed jobs, the
+        # client never sees more than latency
+        ulfm0 = registry.get("mpi_ft_ulfm")
+        registry.set("mpi_ft_ulfm", 0)
+        lock = threading.Lock()
+        done = [0]
+        errs: List[str] = []
+        try:
+            def submitter(idx: int) -> None:
+                try:
+                    with DvmClient(uri) as cli:
+                        tsid = cli.attach(2, timeout=180)["sid"]
+                        for _ in range(HOST_TRAFFIC_RUNS):
+                            tr = cli.run(tsid, PROG, timeout=180)
+                            if tr["code"] != 0:
+                                raise RuntimeError(
+                                    f"rc={tr['code']}: "
+                                    f"{tr['stderr'][-200:]}")
+                            with lock:
+                                done[0] += 1
+                            time.sleep(HOST_TRAFFIC_PACE_S)
+                        cli.detach(tsid)
+                except Exception as e:  # noqa: BLE001
+                    with lock:
+                        errs.append(f"submitter {idx}: {e}")
+
+            threads = [threading.Thread(target=submitter, args=(i,))
+                       for i in range(2)]
+            for t in threads:
+                t.start()
+            time.sleep(0.4)  # mid-stream
+            admin.kill_host(1)
+            # how many live DCN-spanning sessions the domain loss
+            # actually took ranks from (respawn pops the record)
+            hit = len(srv._host_lost_sids.get(1, []))
+            time.sleep(0.3)  # a measurable dead window under traffic
+            admin.respawn_host(1)
+            for t in threads:
+                t.join(timeout=300)
+        finally:
+            registry.set("mpi_ft_ulfm", ulfm0)
+        st = admin.stats()
+        admin.close()
+        c.close()
+        zero_failed = bool(not errs
+                           and done[0] == 2 * HOST_TRAFFIC_RUNS)
+        mttr_ms = detect_ms + respawn_ms
+        ok = bool(attach_hosts == HOSTS and code == 0 and one_set
+                  and identical and zero_failed and hit >= 1
+                  and st["hosts"] == HOSTS and st["hosts_lost"] == 0
+                  and st["hosts_rehydrating"] == 0)
+        return {
+            "hosts": HOSTS,
+            "agent": "tpud --fleet subprocess",
+            "attach_hosts": attach_hosts,
+            "chaos_rc": code,
+            "single_failure_set": one_set,
+            "survivor_digests_identical": identical,
+            "silence_detect_ms": round(detect_ms, 3),
+            "respawn_ms": round(respawn_ms, 3),
+            "host_kill_mttr_ms": round(mttr_ms, 3),
+            "traffic_jobs_done": done[0],
+            "traffic_jobs_failed": len(errs),
+            "traffic_sessions_hit": hit,
+            "failures": errs[:3],
+            "hosts_lost_final": st["hosts_lost"],
+            "hosts_ok": ok,
+        }
+    finally:
+        for p in agents.values():
+            if p.poll() is None:
+                p.kill()
+        srv.stop()
+        registry.set("dvm_heartbeat_s", hb0)
+
+
 def run_probe() -> Dict:
     import tempfile
 
@@ -376,6 +575,7 @@ def run_probe() -> Dict:
         overload = _probe_overload(tmpdir)
         resume = _probe_preempt_resume(tmpdir)
         resize = _probe_resize(tmpdir)
+        hosts = _probe_hosts(tmpdir)
     finally:
         import shutil
         shutil.rmtree(tmpdir, ignore_errors=True)
@@ -383,9 +583,11 @@ def run_probe() -> Dict:
         "overload": overload,
         "preempt_resume": resume,
         "resize": resize,
+        "hosts": hosts,
         "within_budget": bool(overload["priority_ok"]
                               and resume["resume_ok"]
-                              and resize["resize_ok"]),
+                              and resize["resize_ok"]
+                              and hosts["hosts_ok"]),
     }
 
 

@@ -69,6 +69,13 @@ TOLERANCES: Dict[str, Tuple[str, float]] = {
     # interpreter + import cold start (~600 ms), so its band is wide.
     "kv_failover_mttr_ms": ("lower", 150.0),
     "dvm_restart_mttr_ms": ("lower", 1500.0),
+    # whole-host recovery (ISSUE 16): daemon SIGKILL -> silence
+    # detection -> domain respawn.  Dominated by the probe's 3-beat
+    # grace horizon (~600 ms at the probe's 0.2 s beat), so the band
+    # absorbs a missed beat or two; a real regression (a detector
+    # stuck on the default horizon, a respawn replaying whole
+    # journals) lands in multiple seconds.
+    "host_kill_mttr_ms": ("lower", 1500.0),
 }
 
 
@@ -157,6 +164,10 @@ def _detail_metrics(detail: dict) -> Dict[str, float]:
         v = cp.get(key)
         if isinstance(v, (int, float)) and v > 0:
             out[key] = float(v)
+    fl = (detail.get("probe_fleet") or {}).get("hosts") or {}
+    v = fl.get("host_kill_mttr_ms") if isinstance(fl, dict) else None
+    if isinstance(v, (int, float)) and v > 0:
+        out["host_kill_mttr_ms"] = float(v)
     return out
 
 

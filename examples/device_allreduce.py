@@ -40,8 +40,14 @@ offloaded = 0
 for pv in registry.all_pvars():
     if pv.full_name == "coll_tpu_offloaded_collectives":
         offloaded = pv.read()
+# one atomic write per line: every rank is a thread of ONE app-shell
+# process, and print()'s separate text/newline writes interleave
+# across ranks on the shared stdout
+import sys
 if rank == 0:
-    print(f"coll_tpu_offloaded_collectives={offloaded}", flush=True)
+    sys.stdout.write(f"coll_tpu_offloaded_collectives={offloaded}\n")
+    sys.stdout.flush()
     assert offloaded > 0, "device collectives were not offloaded!"
-print(f"rank {rank} ok", flush=True)
+sys.stdout.write(f"rank {rank} ok\n")
+sys.stdout.flush()
 ompi_tpu.finalize()

@@ -12,12 +12,17 @@ MPI p2p, which is what makes kill -> respawn -> restore work without a
 shared store (ISSUE 5 acceptance: the replacement restores "without
 reading the filesystem checkpoint store").
 
-Placement is the classic ring: copy k of rank r lives on
-``(r + k) % size``.  A single failure between two checkpoints is
-always recoverable with degree >= 1; simultaneous loss of a rank AND
-all its partners is not (that is the filesystem store's job — the two
-layers compose, ``cr.checkpoint`` for cold durability, buddy for fast
-in-job recovery).
+Placement is a failure-domain-aware ring: copy k of rank r lives on
+``(r + o_k) % size`` where the offsets ``o_k`` are chosen (from the
+node_id each rank published into the modex at init) so that every
+rank's partner lives on a DIFFERENT host whenever the job spans more
+than one — a whole host dying then never takes a rank and all its
+replicas together.  On a single host the offsets degrade to the
+classic ring ``o_k = k`` (SCR partner placement).  A single failure
+between two checkpoints is always recoverable with degree >= 1;
+simultaneous loss of a rank AND all its partners is not (that is the
+filesystem store's job — the two layers compose, ``cr.checkpoint``
+for cold durability, buddy for fast in-job recovery).
 
 Commit protocol (tolerates a rank dying mid-checkpoint): every rank
 stores its own blob AND its partners' blobs *before* the barrier;
@@ -42,7 +47,7 @@ pickle, no traffic (the --probe-respawn budget check measures this).
 from __future__ import annotations
 
 import time
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -54,9 +59,11 @@ from ompi_tpu.mca.params import registry as _registry
 _degree_var = _registry.register(
     "cr", "buddy", "degree", 0, int,
     help="In-memory buddy-checkpoint replicas per rank (SCR-style "
-         "partner placement on (rank+k) %% size).  0 disables buddy "
+         "partner ring, offsets skipping same-host partners when the "
+         "job spans multiple node_ids).  0 disables buddy "
          "replication entirely; 1 survives any single rank failure "
-         "between checkpoints")
+         "between checkpoints — including a whole-host failure when "
+         "placement found an off-host offset")
 
 _pv_ckpts = _registry.register_pvar(
     "cr", "buddy", "checkpoints",
@@ -83,6 +90,53 @@ _TAG_RESTORE = 998_500_000
 # storing seq S but before committing it — survivors may then agree on
 # S-1, which a keep-1 policy would already have dropped.
 KEEP_SEQS = 2
+
+
+def ring_offsets(nodes: Sequence[int], deg: int) -> List[int]:
+    """Partner ring offsets for ``deg`` replicas given each comm
+    rank's host (``nodes[r]`` = node_id of comm rank r).
+
+    An offset ``o`` is *host-safe* when EVERY rank's partner at
+    ``(r + o) % size`` lives on a different node — so one dead host
+    can never hold both a rank's state and its replica.  Host-safe
+    offsets are preferred in ascending order; if the topology yields
+    fewer than ``deg`` of them (or the job is single-host), the
+    remaining slots fall back to the smallest unused plain-ring
+    offsets.  Every rank computes the same list from the same modex
+    data, which is what keeps the Sendrecv pairing collective."""
+    size = len(nodes)
+    plain = list(range(1, min(deg, size - 1) + 1))
+    if size < 2 or len(set(nodes)) < 2:
+        return plain
+    out = [o for o in range(1, size)
+           if all(nodes[(r + o) % size] != nodes[r]
+                  for r in range(size))][:deg]
+    if len(out) < deg:
+        for o in range(1, size):
+            if o not in out:
+                out.append(o)
+                if len(out) == deg:
+                    break
+    return out[:deg]
+
+
+def _rank_nodes(comm) -> List[int]:
+    """node_id of every comm rank, from the modex (the value each
+    rank published at init).  Missing keys (pre-modex bootstrap
+    comms, stub RTEs) deterministically collapse to one host — every
+    member reaches the same answer, never a split placement."""
+    n = len(comm.group)
+    rte = getattr(comm.state, "rte", None)
+    if rte is None or not hasattr(rte, "modex_get"):
+        return [0] * n
+    nodes = [0] * n
+    try:
+        for i, g in enumerate(comm.group):
+            nodes[i] = int(rte.modex_get(g, "node_id"))
+    except (KeyError, LookupError, AttributeError, TypeError,
+            ValueError):
+        return [0] * n
+    return nodes
 
 
 def _buddy_state(state) -> Dict[str, Any]:
@@ -164,9 +218,12 @@ def checkpoint(comm, payload: Any, degree: Optional[int] = None) -> int:
         mine = np.frombuffer(blob, dtype=np.uint8)
         nbytes = np.array([len(blob)], dtype=np.int64)
         peer_n = np.zeros(1, dtype=np.int64)
-        for k in range(1, deg + 1):
-            dst = (comm.rank + k) % size
-            src = (comm.rank - k) % size
+        # failure-domain-aware placement: offsets chosen so partners
+        # sit on a different host whenever the job spans more than one
+        offs = ring_offsets(_rank_nodes(comm), deg)
+        for k, o in enumerate(offs, start=1):
+            dst = (comm.rank + o) % size
+            src = (comm.rank - o) % size
             comm.Sendrecv(nbytes, dst, _TAG_BASE + 2 * k,
                           peer_n, src, _TAG_BASE + 2 * k)
             rbuf = np.empty(int(peer_n[0]), dtype=np.uint8)

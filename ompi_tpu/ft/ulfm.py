@@ -270,9 +270,21 @@ def publish_world_failure(world, grank: int) -> None:
     """Thread-rank delivery: mark the rank failed on the world, break
     the fence barrier (survivors fall through to the ULFM fence), and
     deliver the record to every live rank's UlfmState."""
-    first = grank not in world.ulfm_failed
-    world.ulfm_failed.add(grank)
-    if first:
+    publish_world_failures(world, (grank,))
+
+
+def publish_world_failures(world, granks) -> None:
+    """Atomic failure-DOMAIN delivery: mark EVERY rank in ``granks``
+    failed before any waiter wakes, so a whole-host death surfaces as
+    one consistent failure set — survivors of a host kill observe all
+    N resident ranks dead at once, never N racing single-rank
+    detections with fences recounting quorum between them."""
+    fresh = []
+    for grank in granks:
+        if grank not in world.ulfm_failed:
+            fresh.append(int(grank))
+        world.ulfm_failed.add(grank)
+    if fresh:
         try:
             world.barrier.abort()
         except Exception:  # noqa: BLE001 — barrier may be mid-reset
@@ -284,7 +296,8 @@ def publish_world_failure(world, grank: int) -> None:
     for st in list(world.states):  # indexed by rank; remote = None
         u = getattr(st, "ulfm", None)
         if u is not None:
-            u.deliver(("fail", int(grank)))
+            for grank in granks:
+                u.deliver(("fail", int(grank)))
 
 
 def publish_failure(state, grank: int) -> None:

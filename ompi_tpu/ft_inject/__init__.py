@@ -44,9 +44,11 @@ _plan_var = registry.register(
          "class:rate — e.g. 'drop:0.05,sever:0.01'.  Classes: drop, "
          "delay, dup, reorder, corrupt, sever, daemon_kill, "
          "oob_sever, kv_partition, rank_kill, io_stall, io_partial, "
-         "io_enospc, dvm_disconnect, rma_delay, kv_kill, dvm_kill "
-         "(for the kill classes the number is the armed OP COUNT the "
-         "control-plane process dies at, not a rate).  Empty = "
+         "io_enospc, dvm_disconnect, rma_delay, kv_kill, dvm_kill, "
+         "host_kill (for the kill classes the number is the armed OP "
+         "COUNT the control-plane process dies at, not a rate; "
+         "host_kill severs ft_inject_victim_host's whole failure "
+         "domain — daemon plus every resident rank).  Empty = "
          "framework disabled")
 _rate_var = registry.register(
     "ft", "inject", "rate", 0.02, float,
@@ -67,6 +69,11 @@ _after_var = registry.register(
 _victim_var = registry.register(
     "ft", "inject", "victim_node", 1, int,
     help="Node id that hosts the daemon_kill/oob_sever scenarios")
+_victim_host_var = registry.register(
+    "ft", "inject", "victim_host", 1, int,
+    help="Host (failure-domain) id severed by the host_kill scenario "
+         "— the victim daemon dies and every rank resident on that "
+         "host fails as ONE atomic domain record")
 _victim_rank_var = registry.register(
     "ft", "inject", "victim_rank", "1", str,
     help="Global rank(s) killed by the rank_kill scenario (permanent "
@@ -109,6 +116,11 @@ RMA_CLASSES = ("rma_delay",)
 # failover path); dvm_kill hard-exits the DVM server process
 # (journal rehydration path, subprocess runs only).
 KILL_CLASSES = ("kv_kill", "dvm_kill")
+# whole-HOST death: at the armed op count the victim host's daemon
+# (host agent) is severed and every rank resident on it fails as one
+# atomic failure-domain record — the fleet-level analog of kv_kill/
+# dvm_kill.  Consumed by tools/dvm (DVMServer.kill_host).
+HOST_CLASSES = ("host_kill",)
 
 
 def plan() -> Dict[str, float]:
@@ -325,6 +337,18 @@ def dvm_kill_injector() -> Optional[KillInjector]:
     if "dvm_kill" not in p:
         return None
     return KillInjector("dvm", p["dvm_kill"])
+
+
+def host_kill_injector() -> Optional[KillInjector]:
+    p = plan()
+    if "host_kill" not in p:
+        return None
+    return KillInjector("host", p["host_kill"])
+
+
+def host_kill_victim() -> int:
+    """Host id the host_kill scenario severs."""
+    return _victim_host_var.value
 
 
 def node_faults(node_id: int) -> List[str]:

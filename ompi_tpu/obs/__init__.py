@@ -190,6 +190,8 @@ EV_CTRL_ADJUST = 19
 EV_KV_FAILOVER = 20
 EV_DVM_REHYDRATE = 21
 EV_DVM_REPLAY = 22
+EV_HOST_LOST = 23
+EV_HOST_RESPAWN = 24
 
 EVENT_NAMES = (
     "ulfm_detect", "ulfm_revoke", "ulfm_agree", "ulfm_shrink",
@@ -197,7 +199,7 @@ EVENT_NAMES = (
     "dvm_reject", "dvm_queue_full", "ft_inject", "dvm_attach",
     "dvm_detach", "dvm_halt", "dvm_run", "dvm_preempt", "dvm_shed",
     "dvm_resize", "dvm_quota", "ctrl_adjust", "kv_failover",
-    "dvm_rehydrate", "dvm_replay",
+    "dvm_rehydrate", "dvm_replay", "host_lost", "host_respawn",
 )
 
 # Per-type argument field names (positional a0..a3); a trailing "$"
@@ -227,6 +229,8 @@ EVENT_FIELDS = (
     ("band", "ep$"),                         # kv_failover
     ("sessions", "jobs_done", "inc$"),       # dvm_rehydrate
     ("sid", "code"),                         # dvm_replay
+    ("host", "ranks", "sessions"),           # host_lost
+    ("host", "sessions", "ms"),              # host_respawn
 )
 
 # interned strings for event args (reason/cls/scope): the ring holds

@@ -39,6 +39,14 @@ _kv_replicas_var = registry.register(
          "standby fed by streaming op replication, advertised through "
          "the kv2: multi-endpoint uri so clients fail over when the "
          "primary dies)")
+_kv_standby_host_var = registry.register(
+    "rte", "base", "kv_standby_host", -1, int,
+    help="Failure-domain (host) id the hot standby is placed on.  -1 "
+         "= auto: anti-affinity with the primary when the fleet has "
+         "more than one host, else co-resident (the PR-15 in-process "
+         "placement).  Explicit ids pin the standby for chaos runs — "
+         "a standby sharing the primary's host dies WITH it on a "
+         "host kill, wedging every client's endpoint rotation")
 
 # monotonic per-process client ids: fence arrivals are cid-tagged so a
 # re-sent arrival (lost reply, or failover to the promoted standby)
@@ -181,13 +189,20 @@ class KVServer:
 
     def __init__(self, nprocs: int, host: str = "127.0.0.1",
                  advertise: Optional[str] = None,
-                 replicas: Optional[int] = None) -> None:
+                 replicas: Optional[int] = None,
+                 host_id: int = 0,
+                 standby_host: Optional[int] = None) -> None:
         """``host`` is the bind address (0.0.0.0 for multi-host jobs);
         ``advertise`` is the address clients are told to dial (the
         HNP's reachable IP when binding wildcard).  ``replicas``
         overrides the rte_base_kv_replicas knob (the standby itself is
-        built with replicas=0 so the chain is exactly one deep)."""
+        built with replicas=0 so the chain is exactly one deep).
+        ``host_id`` homes this server on a fleet failure domain;
+        ``standby_host`` places the standby (default: anti-affine per
+        rte_base_kv_standby_host — a standby that shares the
+        primary's host dies with it on a host kill)."""
         self.nprocs = nprocs
+        self.host_id = host_id
         self.secret = job_secret()
         self.data: Dict[str, Any] = {}
         self.lock = threading.Lock()
@@ -246,8 +261,13 @@ class KVServer:
         want_repl = _kv_replicas_var.value if replicas is None \
             else replicas
         if want_repl > 0:
+            sb_host = _kv_standby_host_var.value
+            if sb_host < 0:  # auto placement
+                sb_host = host_id if standby_host is None \
+                    else standby_host
             self.standby = KVServer(nprocs, host=host,
-                                    advertise=advertise, replicas=0)
+                                    advertise=advertise, replicas=0,
+                                    host_id=sb_host)
             peer = ("127.0.0.1" if host in ("127.0.0.1", "0.0.0.0")
                     else host, self.standby.sock.getsockname()[1])
             self._repl = socket.create_connection(peer, timeout=10)
@@ -708,6 +728,33 @@ class KVServer:
             except OSError:
                 pass
 
+    def crash_host(self, host_id: int) -> bool:
+        """Sever every endpoint of this server homed on failure
+        domain ``host_id`` (the host-kill path: a dying host takes
+        its resident KV endpoint with it).  Primary on the victim →
+        crash() and the anti-affine standby keeps serving; standby on
+        the victim → hard-close it and degrade replication, the
+        primary keeps serving.  A co-resident standby (placed WITHOUT
+        anti-affinity) dies together with its primary — exactly the
+        wedge rte_base_kv_standby_host exists to avoid.  Returns True
+        when any endpoint died."""
+        hit = False
+        if self.standby is not None \
+                and self.standby.host_id == host_id:
+            self.standby.crash()
+            if self._repl is not None:
+                try:
+                    self._repl.close()
+                except OSError:
+                    pass
+                self._repl = None
+            self.repl_degraded = True
+            hit = True
+        if self.host_id == host_id:
+            self.crash()
+            hit = True
+        return hit
+
     def close(self) -> None:
         self._stop = True
         try:
@@ -904,9 +951,20 @@ class KVClient:
                     raise ConnectionError("kv server closed")
                 return resp
         if isinstance(last, Exception):
+            eps = ",".join(f"{h}:{p}" for h, p in self._eps)
+            hint = ""
+            if nep > 1:
+                # every endpoint in the kv2 list refused a full
+                # rotation of reconnects: the classic cause is both
+                # endpoints sharing one dead host (standby placed
+                # without anti-affinity) — say so instead of leaving
+                # the user to decode a bare connect error
+                hint = ("; all endpoints are down — if they share a "
+                        "host, the standby was placed without host "
+                        "anti-affinity (see rte_base_kv_standby_host)")
             raise ConnectionError(
-                f"kv server unreachable after {tries} attempts: "
-                f"{last}") from last
+                f"kv server unreachable after {tries} attempts "
+                f"across endpoints [{eps}]{hint}: {last}") from last
         raise ConnectionError("kv server unreachable")
 
     def _k(self, key: str) -> str:

@@ -48,6 +48,13 @@ reconnect_grace_var = registry.register(
     help="HNP holds EV_DAEMON_LOST this long after a channel drop, "
          "waiting for the daemon to reconnect (0 = fire immediately, "
          "the legacy behavior)")
+host_grace_var = registry.register(
+    "oob", "host", "grace_s", 0.0, float,
+    help="Extra seconds of heartbeat silence tolerated before a WHOLE "
+         "host is declared a lost failure domain (added on top of the "
+         "per-daemon silence budget; 0 = no extra slack).  Consumed "
+         "by the HNP beat monitor and the DVM host-liveness plane — "
+         "one knob paces both host-granularity detectors")
 
 
 def backoff_s(attempt: int, base: float, cap: float = 5.0) -> float:

@@ -47,6 +47,12 @@ _shrink_ticks_var = registry.register(
 _margin_max_var = registry.register(
     "ctrl", "shed", "margin_max_pct", 400,
     help="Ceiling of the deadline-shed safety margin, percent")
+_host_respawn_var = registry.register(
+    "ctrl", "host", "respawn", 0,
+    help="1 = the controller auto-respawns dead host failure domains "
+         "on its apply sweep (the cluster-scheduler stand-in); 0 "
+         "leaves respawn to the operator / chaos probe so MTTR can "
+         "be measured")
 
 pv_ticks = registry.register_pvar(
     "ctrl", "loop", "ticks",
@@ -103,11 +109,15 @@ class FleetController:
             self.want_capacity = want
             self.idle_ticks = 0
         elif depth == 0 and active == 0 \
-                and getattr(srv, "rehydrated_parked", 0) == 0:
+                and getattr(srv, "rehydrated_parked", 0) == 0 \
+                and getattr(srv, "hosts_rehydrating", 0) == 0:
             # rehydrated-but-unresumed sessions (crash recovery,
             # DESIGN.md §20) hold zero ranks yet are about to resume:
             # shrinking now would yank capacity out from under the
-            # recovering fleet and add resize churn to the MTTR
+            # recovering fleet and add resize churn to the MTTR.
+            # Likewise a lost host domain mid-rehydration (§21): its
+            # parked sessions need their ranks back the moment the
+            # replacement host rejoins
             self.idle_ticks += 1
             if self.idle_ticks >= self.shrink_ticks and cap > self.floor:
                 self.want_capacity = self.floor
@@ -125,6 +135,7 @@ class FleetController:
         tests) — may lock, allocate, log.  Returns True if a resize
         was applied."""
         srv = self.server
+        self._maintain_hosts(srv)
         want = self.want_capacity
         if srv is None or not want or want == srv.capacity:
             self.want_capacity = 0
@@ -134,3 +145,22 @@ class FleetController:
                           self.last_depth, getattr(srv, "est_wall_us", 0))
         srv.resize(want)
         return True
+
+    def _maintain_hosts(self, srv) -> None:
+        """Host-granularity repair (DESIGN.md §21): a dead failure
+        domain is replaced — not merely mourned.  The controller is
+        the pool-side stand-in for a cluster scheduler handing back a
+        machine: it re-places the lost domain so the parked sessions'
+        next run lands on a live fleet.  Auto-repair is opt-in
+        (ctrl_host_respawn=1) because chaos probes want to measure
+        the gap between kill and an *operator-driven* respawn."""
+        if srv is None or getattr(srv, "hosts", 1) < 2:
+            return
+        if not _host_respawn_var.value:
+            return
+        dead = getattr(srv, "_host_dead", None)
+        if not dead:
+            return
+        for h, d in enumerate(dead):
+            if d:
+                srv.respawn_host(h)

@@ -118,9 +118,12 @@ def main(argv=None) -> int:
         # DVM-resident ranks are threads of the pool process; the
         # proctable names the thread so a --stacks dump is navigable
         thread = f"  thread {ent['thread']}" if "thread" in ent else ""
+        # multi-host fleets stamp each rank's failure domain — which
+        # host's death takes it down — next to the physical host
+        hdom = f"  domain host{ent['hdom']}" if "hdom" in ent else ""
         sys.stdout.write(
             f"rank(s) {ent['tag']:>8}  pid {ent['pid']:>7}  "
-            f"host {ent.get('host', 'localhost')}{thread}\n")
+            f"host {ent.get('host', 'localhost')}{hdom}{thread}\n")
     if opts.stacks:
         import socket as _socket
         me = _socket.gethostname()

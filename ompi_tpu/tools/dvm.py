@@ -139,6 +139,16 @@ _pv_resizes = registry.register_pvar(
     "dvm", "", "resizes",
     help="Live pool capacity changes applied (grow or shrink), each "
          "opening a new pool epoch")
+# host failure domains (ISSUE 16): the fleet-granularity liveness
+# counters the probe and `ompi_tpu-top` read
+_pv_hosts_active = registry.register_pvar(
+    "fleet", "", "hosts_active", var_class="level",
+    help="Live failure domains (hosts) currently backing the fleet")
+_pv_hosts_lost = registry.register_pvar(
+    "fleet", "", "hosts_lost",
+    help="Whole-host failures declared (heartbeat silence past the "
+         "grace horizon, or host_kill chaos) — each one atomic ULFM "
+         "domain record, never N racing per-rank detections")
 # session-banded (ompi_tpu/obs): a pool serves many tenants; global
 # reads through the registry stay O(1), per-session values come from
 # the metrics RPC only
@@ -538,6 +548,7 @@ class _Conn:
         self.send_lock = threading.Lock()
         self.busy = 0
         self.dead = False
+        self.agent_pid = 0  # set when this conn is a tpud host agent
 
     def reply(self, obj: dict) -> None:
         with self.send_lock:
@@ -550,7 +561,8 @@ class DVMServer:
     CLI-driven (.serve_forever())."""
 
     def __init__(self, capacity: int, devices=None,
-                 uri_file: Optional[str] = None) -> None:
+                 uri_file: Optional[str] = None,
+                 hosts: int = 1) -> None:
         self.capacity = capacity
         self.devices = devices
         self.uri_file = uri_file
@@ -586,6 +598,28 @@ class DVMServer:
         # yet): read by FleetController.tick as a shrink inhibitor —
         # a just-recovered pool with zero active ranks is NOT idle
         self.rehydrated_parked = 0
+        # host failure domains (ISSUE 16, DESIGN.md §21): the pool
+        # models `hosts` DCN-connected domains.  Resident ranks band
+        # onto them contiguously (_bringup publishes the band as the
+        # rank's node_id), session journal records federate across
+        # per-host files under ONE fleet incarnation id, and a
+        # per-host liveness plane (tpud host agents beating over the
+        # DCN control port) turns silence into one atomic domain
+        # record.  All-int preallocated state: _host_tick scans it on
+        # the audited hot path.
+        self.hosts = max(1, int(hosts))
+        self._host_beat = [0] * self.hosts     # last beat ns (0 = no agent)
+        self._host_dead = [0] * self.hosts     # 1 = lost domain
+        self._host_pending = [0] * self.hosts  # silence marks to collect
+        self._host_lost_ns = [0] * self.hosts  # MTTR clock starts
+        self._host_grace_ns = 0
+        self._host_agents: Dict[int, Any] = {}
+        self._host_lost_sids: Dict[int, List[int]] = {}
+        self._hjournals: List[Optional[_Journal]] = [None] * self.hosts
+        self._hkill: Any = None
+        # lost domains not yet replaced: read by FleetController.tick
+        # as a shrink inhibitor (a fleet mid-rehydration is not idle)
+        self.hosts_rehydrating = 0
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -594,7 +628,23 @@ class DVMServer:
             return
         self._started = True
         from ompi_tpu.runtime.kvstore import KVServer
-        self.kv_server = KVServer(self.capacity)
+        # multi-host fleets home the primary on host 0 and place the
+        # hot standby with host ANTI-affinity (satellite 2: a standby
+        # co-resident with the primary dies with it on a host kill,
+        # wedging every client's kv2 endpoint rotation)
+        self.kv_server = KVServer(
+            self.capacity, host_id=0,
+            standby_host=1 if self.hosts > 1 else None)
+        from ompi_tpu.runtime import oob as _oob
+        self._host_grace_ns = int(
+            (3.0 * max(0.2, _hb_var.value)
+             + max(0.0, _oob.host_grace_var.value)) * 1e9)
+        from ompi_tpu import ft_inject as _fi
+        if self.hosts > 1:
+            # host_kill is in-process safe (no os._exit): embedded
+            # pools arm it too, unlike dvm_kill
+            self._hkill = _fi.host_kill_injector()
+        _pv_hosts_active.add(self.hosts)
         self.listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self.listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self.listener.bind(("127.0.0.1", 0))
@@ -660,6 +710,14 @@ class DVMServer:
             # should rehydrate from an intentional shutdown
             self._journal.close(delete=True)
             self._journal = None
+        for h in range(1, self.hosts):
+            jh = self._hjournals[h]
+            if jh is not None:
+                jh.close(delete=True)
+                self._hjournals[h] = None
+        if self._started:
+            _pv_hosts_active.add(-(self.hosts
+                                   - sum(self._host_dead)))
         self._halted = True
         self._close_listener()
         if self._accept_thread is not None:
@@ -736,9 +794,18 @@ class DVMServer:
                 # must stay off the rank hot path) while none run
                 ctrl.tick(time.perf_counter_ns())
                 ctrl.apply()
+            # host liveness plane: the audited tick only MARKS silent
+            # domains; declaration (allocating, socket-touching) runs
+            # here, off any hot path
+            if self.hosts > 1 \
+                    and self._host_tick(time.perf_counter_ns()):
+                self._host_collect()
             j = self._journal
             if j is not None:
                 j.tick()  # flush buffered bookkeeping records
+            for jh in self._hjournals:
+                if jh is not None:
+                    jh.tick()
 
     def _client(self, conn: _Conn) -> None:
         owned: List[int] = []
@@ -805,6 +872,14 @@ class DVMServer:
                              f"op {op}\n")
             sys.stderr.flush()
             os._exit(70)
+        if self._hkill is not None and self._hkill.op():
+            # chaos (ft_inject host_kill): deterministic whole-host
+            # sever at the armed op count — the victim domain's agent
+            # daemon, KV endpoint and resident ranks all die as one
+            # atomic record.  In-process safe (never os._exit), so
+            # embedded pools arm it too.
+            from ompi_tpu import ft_inject as _fi
+            self.kill_host(_fi.host_kill_victim())
         if op == "halt":
             conn.busy += 1
             try:
@@ -818,6 +893,13 @@ class DVMServer:
                 # behind would resurrect sessions nobody wants back
                 self._journal.close(delete=True)
                 self._journal = None
+            for h in range(1, self.hosts):
+                # the federated host journals carry the same promise:
+                # on disk after a halt would read as a host crash
+                jh = self._hjournals[h]
+                if jh is not None:
+                    jh.close(delete=True)
+                    self._hjournals[h] = None
             conn.reply({"ok": True, "jobs": jobs})
             sys.stderr.write(f"tpu-dvm: halt after {jobs} jobs\n")
             self._halted = True
@@ -834,7 +916,55 @@ class DVMServer:
                             "queued": len(self._waiters),
                             "jobs": self._jobs,
                             "capacity": self.capacity,
-                            "epoch": self.pool_epoch})
+                            "epoch": self.pool_epoch,
+                            "hosts": self.hosts,
+                            "hosts_lost": sum(self._host_dead),
+                            "hosts_rehydrating":
+                                self.hosts_rehydrating})
+            return False
+        if op == "host_register":
+            # DCN control path: a tpud host agent (one per failure
+            # domain) announces itself on the pool port and starts
+            # beating — silence past the grace horizon marks the
+            # WHOLE domain lost (one atomic ULFM record)
+            h = int(msg.get("host", -1))
+            if not 0 <= h < self.hosts:
+                raise DvmError(f"host {h} outside fleet "
+                               f"(hosts={self.hosts})")
+            conn.agent_pid = int(msg.get("pid", 0))
+            with self.lock:
+                self._host_agents[h] = conn
+                self._host_beat[h] = time.perf_counter_ns()
+                self._host_dead[h] = 0
+                self._host_pending[h] = 0
+            conn.reply({"ok": True, "host": h,
+                        "incarnation": self.incarnation,
+                        "grace_s": self._host_grace_ns / 1e9})
+            return False
+        if op == "host_beat":
+            h = int(msg.get("host", -1))
+            if 0 <= h < self.hosts and self._host_dead[h] == 0:
+                self._host_beat[h] = time.perf_counter_ns()
+            conn.reply({"ok": True})
+            return False
+        if op == "host_kill":
+            h = int(msg.get("host", -1))
+            conn.busy += 1
+            try:
+                self.kill_host(h)
+            finally:
+                conn.busy -= 1
+            conn.reply({"ok": True, "host": h})
+            return False
+        if op == "host_respawn":
+            h = int(msg.get("host", -1))
+            conn.busy += 1
+            try:
+                mttr_ms = self.respawn_host(h)
+            finally:
+                conn.busy -= 1
+            conn.reply({"ok": True, "host": h,
+                        "mttr_ms": round(mttr_ms, 3)})
             return False
         if op == "resize":
             new_cap = int(msg.get("np", 0))
@@ -866,6 +996,7 @@ class DVMServer:
             conn.reply({"ok": True, "sid": sess.sid, "np": np_,
                         "token": sess.token,
                         "incarnation": self.incarnation,
+                        "hosts": self.hosts,
                         "attach_us": attach_us, "queued_us": queued_us})
             return False
         if op == "reattach":
@@ -1059,6 +1190,9 @@ class DVMServer:
             "jobs": self._jobs,
             "epoch": self.pool_epoch,
             "est_wall_us": self.est_wall_us,
+            "hosts": self.hosts,
+            "hosts_lost": sum(self._host_dead),
+            "hosts_rehydrating": self.hosts_rehydrating,
             "ctrl": None if self.ctrl is None else {
                 "ticks": self.ctrl.ticks,
                 "shed_margin_pct": self.ctrl.shed_margin_pct,
@@ -1093,9 +1227,31 @@ class DVMServer:
 
     # -- crash recovery (DESIGN.md §20) ------------------------------------
 
+    def _journal_path(self, h: int) -> str:
+        """Per-host journal file: host 0 shares the legacy path (so a
+        one-host pool's on-disk format is unchanged), host k >= 1 gets
+        a `.h<k>` sibling.  All federated under one incarnation id."""
+        base = f"{self.uri_file}.journal"
+        return f"{base}.jsonl" if h == 0 else f"{base}.h{h}.jsonl"
+
+    def _jrec_h(self, h: int, rec: dict, sync: bool = False) -> None:
+        j = self._journal if h == 0 else self._hjournals[h]
+        if j is not None:
+            j.append(rec, sync=sync)
+
     def _jrec(self, rec: dict, sync: bool = False) -> None:
-        if self._journal is not None:
-            self._journal.append(rec, sync=sync)
+        if self._journal is None:
+            return
+        h = 0
+        if self.hosts > 1:
+            # federate: each session's write-ahead records land in the
+            # journal of the host domain that owns it, so losing one
+            # host loses exactly that host's tail — the survivors'
+            # journals stay intact and replayable
+            sid = rec.get("sid")
+            if sid is not None:
+                h = int(sid) % self.hosts
+        self._jrec_h(h, rec, sync=sync)
 
     def _quota_snapshot(self) -> Dict[str, Any]:
         return {"dvm_quota_hbm_bytes":
@@ -1112,14 +1268,26 @@ class DVMServer:
         the world back up on the owner's next run, after it
         reattaches by token.  Jobids journaled as in-flight (run WAL
         without run_done) are handed back at reattach so the client
-        resubmits them — never silently lost."""
+        resubmits them — never silently lost.
+
+        With hosts > 1 the journal is FEDERATED: one file per host
+        domain, all stamped with the same fleet incarnation id.  A
+        new incarnation loads every surviving host journal (a torn
+        tail in any one of them is tolerated independently) and
+        compacts each back to its own host's state."""
         recs = _Journal.load(path)
         self._journal = _Journal(path)
+        for h in range(1, self.hosts):
+            hp = self._journal_path(h)
+            recs.extend(_Journal.load(hp))
+            self._hjournals[h] = _Journal(hp)
         if not recs:
-            self._jrec({"t": "open", "inc": self.incarnation,
-                        "pid": os.getpid(), "cap": self.capacity},
-                       sync=True)
-            self._jrec({"t": "quota", **self._quota_snapshot()})
+            opened = {"t": "open", "inc": self.incarnation,
+                      "pid": os.getpid(), "cap": self.capacity}
+            self._jrec_h(0, opened, sync=True)
+            self._jrec_h(0, {"t": "quota", **self._quota_snapshot()})
+            for h in range(1, self.hosts):
+                self._jrec_h(h, opened, sync=True)
             return
         live: Dict[int, dict] = {}
         done: Dict[int, "collections.OrderedDict[str, int]"] = {}
@@ -1144,8 +1312,15 @@ class DVMServer:
             elif t == "run_done":
                 sid = int(rec["sid"])
                 wal.get(sid, set()).discard(rec["jobid"])
-                done.setdefault(sid, collections.OrderedDict())[
-                    rec["jobid"]] = int(rec["code"])
+                d = done.setdefault(sid, collections.OrderedDict())
+                d[rec["jobid"]] = int(rec["code"])
+                # bound replay memory exactly like the live path
+                # (remember_done): without this, a long-lived session
+                # rehydrated across incarnations accretes its entire
+                # completed-jobid history into RAM and back into the
+                # compacted journal, growing without bound
+                while len(d) > 64:
+                    d.popitem(last=False)
                 jobs += 1
             elif t == "epoch":
                 epoch = int(rec["epoch"])
@@ -1174,22 +1349,30 @@ class DVMServer:
         if live:
             _pv_peak.update_max(len(self.sessions))
             self._set_xsession_hint(len(self.sessions))
-        # compact: the new journal starts from the rehydrated state,
-        # not the dead incarnation's full history
-        out = [{"t": "open", "inc": self.incarnation,
-                "pid": os.getpid(), "cap": self.capacity},
-               {"t": "quota", **self._quota_snapshot()}]
+        # compact: each journal starts from the rehydrated state, not
+        # the dead incarnation's full history.  Session records route
+        # back to their owning host's journal; pool-level records
+        # (quota, epoch) live in host 0's.
+        opened = {"t": "open", "inc": self.incarnation,
+                  "pid": os.getpid(), "cap": self.capacity}
+        outs: List[List[dict]] = [[opened] for _ in range(self.hosts)]
+        outs[0].append({"t": "quota", **self._quota_snapshot()})
         if epoch:
-            out.append({"t": "epoch", "epoch": epoch,
-                        "cap": self.capacity})
+            outs[0].append({"t": "epoch", "epoch": epoch,
+                            "cap": self.capacity})
         for sid, arec in live.items():
+            out = outs[sid % self.hosts if self.hosts > 1 else 0]
             out.append(arec)
             for jobid, code in done.get(sid, {}).items():
                 out.append({"t": "run_done", "sid": sid,
                             "jobid": jobid, "code": code})
             for jobid in wal.get(sid, set()):
                 out.append({"t": "run", "sid": sid, "jobid": jobid})
-        self._journal.rewrite(out)
+        self._journal.rewrite(outs[0])
+        for h in range(1, self.hosts):
+            jh = self._hjournals[h]
+            if jh is not None:
+                jh.rewrite(outs[h])
         _obs.record_event(_obs.EV_DVM_REHYDRATE, len(live), jobs,
                           _obs.intern(self.incarnation))
         inflight = sum(len(s) for s in wal.values())
@@ -1197,6 +1380,223 @@ class DVMServer:
             f"tpu-dvm: rehydrated {len(live)} session(s), {jobs} "
             f"completed job(s), {inflight} in-flight jobid(s) from "
             f"{path} (incarnation {self.incarnation})\n")
+
+    # -- host failure domains (DESIGN.md §21) ------------------------------
+
+    def host_ranks(self, sess: _Session, h: int) -> List[int]:
+        """Ranks of `sess` resident on host domain `h` — the same
+        contiguous banding _bringup stamps into each rank's node_id,
+        so liveness, placement and the modex all agree on who lives
+        where."""
+        if self.hosts < 2:
+            return list(range(sess.np)) if h == 0 else []
+        return [r for r in range(sess.np)
+                if r * self.hosts // sess.np == h]
+
+    def _host_tick(self, now: int) -> int:
+        """Hot-path host-liveness sweep (hotpath_audit-enforced):
+        mark every host whose agent has beaten at least once but has
+        now been silent past the grace horizon.  Pure integer
+        arithmetic over preallocated lists — no allocation, no
+        formatting; the expensive collection (ULFM publication,
+        parking, KV failover) runs off-path in _host_collect."""
+        if self.hosts < 2:
+            return 0
+        grace = self._host_grace_ns
+        beat = self._host_beat
+        dead = self._host_dead
+        pend = self._host_pending
+        n = self.hosts
+        hit = 0
+        h = 0
+        while h < n:
+            b = beat[h]
+            if b > 0 and dead[h] == 0 and pend[h] == 0 \
+                    and now - b > grace:
+                pend[h] = 1
+                hit += 1
+            h += 1
+        return hit
+
+    def _host_collect(self) -> None:
+        """Off-hot-path half of the liveness plane: turn every host
+        _host_tick marked into one atomic lost-domain record."""
+        h = 0
+        while h < self.hosts:
+            if self._host_pending[h] == 1 and self._host_dead[h] == 0:
+                self._host_lost(h, "heartbeat silence past "
+                                   "oob_host_grace_s")
+            h += 1
+
+    def _host_lost(self, h: int, why: str) -> None:
+        """A whole host failure domain died.  Every resident rank of
+        every session is marked failed as ONE atomic record — ULFM
+        waiters see a single consistent failure set instead of N
+        racing per-rank detections.  Per session:
+
+        - running + ULFM-aware: publish the batched failure set and
+          let the program shrink around it (survivors continue);
+        - running, not ULFM-aware: publish, then poison + park — the
+          session replays transparently on respawn (the preemption
+          machinery; the client sees a slower run, never a failed
+          one);
+        - idle: park directly, no ULFM publication (a graceful
+          finalize with dead ranks pre-counted would over-fill the
+          fence quorum).
+
+        Also fails the host's KV endpoint (crash_host — the off-host
+        standby takes over mid-fence) and closes — without deleting —
+        its federated journal, so the tail replays at respawn."""
+        from ompi_tpu.ft import ulfm as _ulfm
+        with self.lock:
+            if self._host_dead[h]:
+                return
+            self._host_dead[h] = 1
+            self._host_pending[h] = 0
+            self._host_lost_ns[h] = time.perf_counter_ns()
+            self.hosts_rehydrating += 1
+            agent = self._host_agents.pop(h, None)
+            sessions = list(self.sessions.values())
+        _pv_hosts_lost.add(1)
+        _pv_hosts_active.add(-1)
+        lost_sids: List[int] = []
+        nranks = 0
+        for sess in sessions:
+            ranks = self.host_ranks(sess, h)
+            if not ranks:
+                continue
+            park = False
+            with sess.lock:
+                if sess.dead or sess.parked or sess.world is None:
+                    continue
+                lost_sids.append(sess.sid)
+                nranks += len(ranks)
+                if sess.running:
+                    aware = False
+                    for st in sess.states:
+                        if st is not None and getattr(
+                                st, "ulfm", None) is not None:
+                            aware = True
+                            break
+                    # mark each resident rank's incarnation dead (the
+                    # arm_rank_kill marker): the rank-thread standing
+                    # in for a vanished process must see its own death
+                    # — a rank never ingests its own global-rank into
+                    # ulfm.failed — and last-rank accounting must stop
+                    # waiting for it
+                    for r in ranks:
+                        if r < len(sess.states):
+                            st = sess.states[r]
+                            if st is not None:
+                                st.ulfm_dead = True
+                    _ulfm.publish_world_failures(sess.world, ranks)
+                    if not aware:
+                        sess.preempt_requested = True
+                        self._poison_session(
+                            sess, 75, f"host {h} lost ({why})")
+                else:
+                    sess.preempt_requested = False
+                    sess.parked = True
+                    park = True
+            if park:
+                self._park(sess)
+        self._host_lost_sids[h] = lost_sids
+        if self.kv_server is not None:
+            try:
+                self.kv_server.crash_host(h)
+            except OSError:
+                pass
+        if agent is not None:
+            agent.dead = True
+            try:
+                agent.sock.close()
+            except OSError:
+                pass
+        jh = self._hjournals[h]
+        if jh is not None:
+            jh.close()  # keep the file: its tail replays at respawn
+            self._hjournals[h] = None
+        _obs.record_event(_obs.EV_HOST_LOST, h, nranks,
+                          len(lost_sids))
+        tr = trace.global_tracer()
+        if tr is not None:
+            tr.instant("host_lost", "fleet", host=h, ranks=nranks,
+                       sessions=len(lost_sids))
+        sys.stderr.write(
+            f"tpu-dvm: host {h} LOST ({why}) — {nranks} rank(s) in "
+            f"{len(lost_sids)} session(s) failed as one domain\n")
+
+    def kill_host(self, h: int) -> None:
+        """Deterministic whole-host sever (ft_inject host_kill and
+        the `tpu-dvm --kill-host` path): SIGKILL the host's tpud
+        agent if it is a real process, then run the same lost-domain
+        handling heartbeat silence would have reached — minus the
+        grace wait."""
+        if not 0 <= h < self.hosts:
+            raise DvmError(f"host {h} outside fleet "
+                           f"(hosts={self.hosts})")
+        if self._host_dead[h]:
+            return
+        agent = self._host_agents.get(h)
+        pid = getattr(agent, "agent_pid", 0) if agent is not None else 0
+        if pid:
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except OSError:
+                pass
+        self._host_lost(h, "host_kill")
+
+    def respawn_host(self, h: int) -> float:
+        """Host-granularity rehydration: a replacement host (fresh
+        tpud agent re-registers after this) rejoins the fleet under
+        the SAME incarnation id.  Its federated journal is rebuilt
+        from the live session table (the dead tail already did its
+        job: parked sessions replay through _unpark).  Returns the
+        domain's MTTR in milliseconds — lost-mark to rejoin."""
+        if not 0 <= h < self.hosts:
+            raise DvmError(f"host {h} outside fleet "
+                           f"(hosts={self.hosts})")
+        with self.lock:
+            if not self._host_dead[h]:
+                return 0.0
+            self._host_dead[h] = 0
+            self._host_pending[h] = 0
+            self._host_beat[h] = 0
+            lost_ns = self._host_lost_ns[h]
+            self._host_lost_ns[h] = 0
+            self.hosts_rehydrating = max(0, self.hosts_rehydrating - 1)
+            sids = self._host_lost_sids.pop(h, [])
+        if h > 0 and self.uri_file and self._journal is not None:
+            jh = _Journal(self._journal_path(h))
+            self._hjournals[h] = jh
+            outs = [{"t": "open", "inc": self.incarnation,
+                     "pid": os.getpid(), "cap": self.capacity}]
+            with self.lock:
+                for sid, sess in self.sessions.items():
+                    if sid % self.hosts != h:
+                        continue
+                    outs.append({"t": "attach", "sid": sid,
+                                 "np": sess.np, "prio": sess.priority,
+                                 "pre": sess.preemptible,
+                                 "token": sess.token})
+                    for jobid, code in sess.completed.items():
+                        outs.append({"t": "run_done", "sid": sid,
+                                     "jobid": jobid, "code": code})
+            jh.rewrite(outs)
+        _pv_hosts_active.add(1)
+        mttr_ms = ((time.perf_counter_ns() - lost_ns) / 1e6
+                   if lost_ns else 0.0)
+        _obs.record_event(_obs.EV_HOST_RESPAWN, h, len(sids),
+                          int(mttr_ms))
+        tr = trace.global_tracer()
+        if tr is not None:
+            tr.instant("host_respawn", "fleet", host=h,
+                       sessions=len(sids), ms=round(mttr_ms, 3))
+        sys.stderr.write(
+            f"tpu-dvm: host {h} respawned in {mttr_ms:.1f} ms "
+            f"({len(sids)} session(s) rehydrating)\n")
+        self._pump()
+        return mttr_ms
 
     # -- admission ---------------------------------------------------------
 
@@ -1484,6 +1884,17 @@ class DVMServer:
         world back up (fresh rank-threads, same sid/cid-band/KV ns).
         Runs on the owning connection's dispatch thread — the client
         keeps getting heartbeats while we wait."""
+        if self.hosts > 1 and self.hosts_rehydrating > 0:
+            # a replay admitted while a host domain is still a hole
+            # would band ranks onto the dead host: hold until the
+            # fleet rehydrates (bounded — a domain nobody replaces
+            # must not wedge the client forever; in-process worlds
+            # can still bring the band up on the survivors)
+            deadline = time.monotonic() + max(
+                5.0, 4.0 * self._host_grace_ns / 1e9)
+            while (self.hosts_rehydrating > 0
+                   and time.monotonic() < deadline):
+                time.sleep(0.02)
         w = _Waiter(sess.np, sess.conn, sess.priority,
                     sess.preemptible, resume=sess)
         with self.lock:
@@ -1609,8 +2020,14 @@ class DVMServer:
 
         def boot(rank: int) -> None:
             try:
+                # hosts > 1: band ranks contiguously onto host failure
+                # domains — node_id flows into the modex, so topology-
+                # aware consumers (tuned collectives, buddy placement)
+                # see the real placement instead of one flat host
+                node = (rank * self.hosts // sess.np
+                        if self.hosts > 1 else 0)
                 rte = SessionRTE(world, rank, self.kv_server.uri,
-                                 node_id=0, jobid=sess.jobid,
+                                 node_id=node, jobid=sess.jobid,
                                  session_dir=sess.dir, kv_ns=sess.ns)
                 if self.devices:
                     rte.default_device = self.devices[
@@ -1911,9 +2328,14 @@ class DVMServer:
                 sessions = list(self.sessions.values())
             for sess in sessions:
                 for r in range(sess.np):
-                    entries.append({"tag": f"s{sess.sid}:r{r}",
-                                    "pid": pid, "host": host,
-                                    "thread": f"dvm-s{sess.sid}-r{r}"})
+                    ent = {"tag": f"s{sess.sid}:r{r}",
+                           "pid": pid, "host": host,
+                           "thread": f"dvm-s{sess.sid}-r{r}"}
+                    if self.hosts > 1:
+                        # failure-domain column for the attach tool:
+                        # which host's death takes this rank with it
+                        ent["hdom"] = r * self.hosts // sess.np
+                    entries.append(ent)
             path = self.uri_file + ".proctable.json"
             try:
                 tmp = path + ".tmp"
@@ -2160,6 +2582,20 @@ class DvmClient:
              "timeout": timeout},
             deadline=time.monotonic() + timeout if timeout else None)
 
+    def kill_host(self, host: int) -> dict:
+        """Sever a whole host failure domain (daemon + ranks)."""
+        resp = self._rpc({"op": "host_kill", "host": host})
+        if "error" in resp:
+            raise DvmError(resp["error"])
+        return resp
+
+    def respawn_host(self, host: int) -> dict:
+        """Rejoin a lost host domain; resp['mttr_ms'] is the MTTR."""
+        resp = self._rpc({"op": "host_respawn", "host": host})
+        if "error" in resp:
+            raise DvmError(resp["error"])
+        return resp
+
     def halt(self) -> dict:
         return self._rpc({"op": "halt"})
 
@@ -2376,7 +2812,8 @@ def serve(opts) -> int:
                               os.environ["JAX_PLATFORMS"])
         devices = jax.devices()  # PJRT bring-up happens HERE, once
     server = DVMServer(opts.np, devices=devices,
-                       uri_file=opts.uri_file)
+                       uri_file=opts.uri_file,
+                       hosts=getattr(opts, "hosts", 1))
     # chaos: dvm_kill is armed ONLY here, on a real subprocess server
     # — an embedded pool shares the test process, and os._exit(70)
     # would take the whole suite with it
@@ -2464,6 +2901,21 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--ctrl", action="store_true",
                     help="enable the FleetController closed loop "
                          "(dvm_ctrl=1)")
+    ap.add_argument("--hosts", type=int, default=1,
+                    help="host failure domains in the fleet: ranks "
+                         "band contiguously across N domains, each "
+                         "watched by a tpud host agent over the DCN "
+                         "control path (silence = the whole domain "
+                         "fails as one atomic ULFM record)")
+    ap.add_argument("--kill-host", type=int, default=None,
+                    metavar="H",
+                    help="sever host domain H of a running fleet "
+                         "(named by --uri-file): daemon + ranks die "
+                         "as one record")
+    ap.add_argument("--respawn-host", type=int, default=None,
+                    metavar="H",
+                    help="rejoin host domain H of a running fleet; "
+                         "prints the domain's MTTR")
     ap.add_argument("--supervise", action="store_true",
                     help="run the pool under a respawning supervisor: "
                          "an abnormally-dying server is restarted and "
@@ -2480,6 +2932,28 @@ def main(argv: Optional[List[str]] = None) -> int:
         return Supervisor(child).run_forever()
     if opts.halt:
         return halt(opts.halt)
+    if opts.kill_host is not None or opts.respawn_host is not None:
+        if not opts.uri_file:
+            ap.error("--kill-host/--respawn-host need --uri-file to "
+                     "find the fleet")
+        try:
+            client = DvmClient(opts.uri_file)
+            try:
+                if opts.kill_host is not None:
+                    client.kill_host(opts.kill_host)
+                    sys.stderr.write(
+                        f"tpu-dvm: host {opts.kill_host} severed\n")
+                if opts.respawn_host is not None:
+                    resp = client.respawn_host(opts.respawn_host)
+                    sys.stderr.write(
+                        f"tpu-dvm: host {opts.respawn_host} rejoined "
+                        f"(mttr {resp.get('mttr_ms')} ms)\n")
+            finally:
+                client.close()
+        except DvmError as e:
+            sys.stderr.write(f"tpu-dvm: {e}\n")
+            return 1
+        return 0
     if opts.resize is not None:
         if not opts.uri_file:
             ap.error("--resize needs --uri-file to find the pool")

@@ -99,6 +99,11 @@ HOT_FUNCTIONS: Dict[str, Tuple[str, ...]] = {
     # pending — the common case, since attach/detach are rare
     "ompi_tpu/tools/dvm.py": (
         "_Journal.tick",
+        # the host-liveness sweep (ISSUE 16) also rides the heartbeat
+        # loop every period: pure integer compares over preallocated
+        # per-host lists; the expensive lost-domain collection runs
+        # off-path in _host_collect
+        "DVMServer._host_tick",
     ),
 }
 

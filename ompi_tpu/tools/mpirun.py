@@ -46,6 +46,7 @@ import threading
 import time
 from typing import List, Optional
 
+from ompi_tpu import obs as _obs
 from ompi_tpu.mca.params import registry as _params
 from ompi_tpu.runtime import statemachine as smx
 from ompi_tpu.runtime.kvstore import KVServer
@@ -369,6 +370,9 @@ def run_multinode(opts, nodes, rpp: int, hybrid: bool) -> int:
                                 p.rank_base + max(1, p.nlocal)))
         _ulfm_publish_failed(d["server"], ranks)
         d["done"].add(node)  # the node will never report node_done
+        # one atomic domain record: the whole host's rank set failed
+        # together, not N racing per-rank detections
+        _obs.record_event(_obs.EV_HOST_LOST, node, len(ranks), 1)
         sys.stderr.write(
             f"mpirun: daemon on node {node} lost; ulfm policy: "
             f"ranks {ranks} declared failed, job continues on "

@@ -249,12 +249,17 @@ class HNP:
     def _beat_monitor(self) -> None:
         iv = oob.heartbeat_interval_var.value
         budget = oob.heartbeat_budget_var.value
+        # a daemon's death takes every resident rank of its HOST with
+        # it — oob_host_grace_s buys extra silence before that whole
+        # failure domain is declared lost (one knob paces this monitor
+        # and the DVM host-liveness plane alike)
+        horizon = budget * iv + max(0.0, oob.host_grace_var.value)
         while not self._stop:
             time.sleep(iv / 2)
             now = time.monotonic()
             with self.lock:
                 stale = [n for n, t in self._last_beat.items()
-                         if now - t > budget * iv
+                         if now - t > horizon
                          and n not in self._beat_dead]
             for node in stale:
                 with self.lock:
@@ -268,7 +273,7 @@ class HNP:
                     return
                 sys.stderr.write(
                     f"mpirun: daemon on node {node} missed {budget} "
-                    f"heartbeats ({budget * iv:.1f}s silent); "
+                    f"heartbeats ({horizon:.1f}s silent); "
                     f"declaring it lost\n")
                 if ch is not None:
                     ch.close()  # marks _closed: on_close won't double-fire

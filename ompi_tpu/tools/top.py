@@ -77,6 +77,14 @@ def render(m: dict, events: int = 8) -> str:
     if kv_r or kv_f or kv_c:
         lines.append(f"  ctrl-plane: kv_retries {kv_r}  "
                      f"kv_reconnects {kv_c}  kv_failovers {kv_f}")
+    # host failure domains (DESIGN.md §21): shown for multi-host
+    # fleets, or after any domain has ever been lost
+    h_act = pv.get("fleet_hosts_active", 0)
+    h_lost = pv.get("fleet_hosts_lost", 0)
+    if m.get("hosts", 1) > 1 or h_lost:
+        lines.append(f"  fleet: hosts {h_act} active  "
+                     f"{h_lost} lost (lifetime)  "
+                     f"{m.get('hosts_rehydrating', 0)} rehydrating")
     # critical-path profiler gauges (DESIGN.md §18): what phase is
     # eating the dispatch budget right now, and how skewed arrivals are
     gating = pv.get("obs_critpath_gating_phase")

@@ -17,6 +17,14 @@ says and injects the right TPUMPI_* identity env.
 
 argv: --hnp HOST:PORT --node ID --name NAME [--subtree B64JSON]
       [--agent CMD] [--python EXE]
+
+Fleet host-agent mode (DESIGN.md §21): ``--fleet URI_FILE --host K``
+instead of ``--hnp`` turns the daemon into the liveness agent of one
+host failure domain of a DVM fleet — it dials the pool over the DCN
+control path, registers its domain, and beats until killed.  Silence
+past the pool's grace horizon (or a SIGKILL from ft_inject host_kill)
+is what the pool's host-liveness plane turns into ONE atomic
+lost-domain record covering every resident rank.
 """
 
 from __future__ import annotations
@@ -109,16 +117,62 @@ class _Unit:
         self.reported = False
 
 
+def host_agent(opts) -> int:
+    """One tpud per host failure domain of a DVM fleet: register on
+    the pool's control port, then beat.  The agent carries no state —
+    its PROCESS is the liveness signal, so ft_inject host_kill
+    SIGKILLs it (a real dead daemon, not a simulated one) and the
+    pool's detector runs the production silence path."""
+    from ompi_tpu.tools.dvm import DvmClient, DvmError
+    tag = f"tpud[host{opts.host}]"
+    try:
+        client = DvmClient(opts.fleet)
+        resp = client._rpc({"op": "host_register", "host": opts.host,
+                            "pid": os.getpid()})
+    except DvmError as e:
+        sys.stderr.write(f"{tag}: {e}\n")
+        return 1
+    if "error" in resp:
+        sys.stderr.write(f"{tag}: {resp['error']}\n")
+        client.close()
+        return 1
+    grace = float(resp.get("grace_s", 1.0))
+    iv = max(0.05, grace / 6.0)
+    sys.stderr.write(f"{tag}: registered with fleet incarnation "
+                     f"{resp.get('incarnation')} (beat every "
+                     f"{iv:.2f}s)\n")
+    while True:
+        time.sleep(iv)
+        try:
+            r = client._rpc({"op": "host_beat", "host": opts.host})
+        except (DvmError, OSError):
+            break  # pool gone; an agent has nothing to clean up
+        if "error" in r or not r.get("ok"):
+            break
+    client.close()
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(prog="tpud")
-    ap.add_argument("--hnp", required=True)
-    ap.add_argument("--node", type=int, required=True)
-    ap.add_argument("--name", required=True)
+    ap.add_argument("--hnp", default=None)
+    ap.add_argument("--node", type=int, default=0)
+    ap.add_argument("--name", default=None)
     ap.add_argument("--subtree", default=None)
     ap.add_argument("--agent", default="ssh")
     ap.add_argument("--python", default=sys.executable)
     ap.add_argument("--pythonpath", default="")
+    ap.add_argument("--fleet", default=None, metavar="URI_FILE",
+                    help="host-agent mode: the DVM fleet's uri file")
+    ap.add_argument("--host", type=int, default=0,
+                    help="host failure-domain id this agent covers "
+                         "(with --fleet)")
     opts = ap.parse_args(argv)
+    if opts.fleet is not None:
+        return host_agent(opts)
+    if not opts.hnp or opts.name is None:
+        ap.error("--hnp and --name are required (or use --fleet for "
+                 "host-agent mode)")
 
     units: List[_Unit] = []
     units_lock = threading.Lock()

@@ -1,0 +1,58 @@
+"""Off-host buddy-restore workload (run by test_fleet.py): on a
+2-host DVM pool, every rank buddy-checkpoints with degree 1 and
+asserts the failure-domain-aware ring actually placed its replica on
+the OTHER host.  Host 1's ranks then drop their own copies — the
+in-process stand-in for "host 1 died and its replacements came back
+empty" — and the collective restore must serve them from the
+off-host partners that survived.
+
+argv: tag
+
+Every rank prints ``BUDDY {tag} {rank} OK`` after verifying the
+restored payload; the test asserts one line per rank and exit 0.
+"""
+import sys
+
+import numpy as np
+
+import ompi_tpu
+from ompi_tpu.cr import buddy
+
+tag = sys.argv[1]
+
+comm = ompi_tpu.init()
+rank, size = comm.rank, comm.size
+
+nodes = buddy._rank_nodes(comm)
+assert len(set(nodes)) > 1, (
+    f"pool did not band ranks across hosts: {nodes}")
+my_node = nodes[rank]
+
+payload = {"rank": rank,
+           "vec": np.full(16, float(rank + 1), np.float64)}
+seq = buddy.checkpoint(comm, payload, degree=1)
+assert seq >= 0, "buddy checkpoint did not commit"
+
+bs = comm.state.extra["cr_buddy"]
+# placement proof: every copy this rank holds belongs to an OFF-host
+# owner — one dead host can never take a rank and its replica together
+for owner, s in bs["held"]:
+    assert nodes[owner] != my_node, (
+        f"rank {rank} (host {my_node}) holds rank {owner}'s copy but "
+        f"they share a host — placement is not domain-aware")
+
+# host 1 dies: its ranks lose their own in-memory state
+if my_node == 1:
+    bs["self"].clear()
+
+out = buddy.restore(comm)
+assert out is not None, "restore found nothing committed"
+assert int(out["rank"]) == rank
+assert np.array_equal(np.asarray(out["vec"]),
+                      np.full(16, float(rank + 1), np.float64))
+
+# one atomic write: rank-threads share the session stdout buffer and
+# print()'s separate text/newline writes interleave across ranks
+sys.stdout.write(f"BUDDY {tag} {rank} OK\n")
+sys.stdout.flush()
+ompi_tpu.finalize()

@@ -1,0 +1,88 @@
+"""Host-kill shrink-arm workload (run by test_fleet.py and the fleet
+probe): a deterministic stepped allreduce on a multi-host DVM pool
+whose host 1 is killed mid-loop.  Every rank resident on the dead
+host is published as failed in ONE atomic domain record, so the ULFM
+survivors observe a single consistent failure set: each survivor
+shrinks exactly once, resets, and redoes the whole accumulation on
+the shrunk world — making every survivor's digest byte-identical no
+matter which step the kill interrupted.
+
+Ranks on the dead host exit 0 the moment they see themselves in the
+failure set (a killed host's ranks do not get to finalize; in the
+in-process harness the thread stands in for the vanished process).
+
+argv: tag steps
+
+Every survivor prints ``SHRINKS {tag} {rank} {n}`` and
+``DIGEST {tag} {sha256}``; the test asserts n == 1 everywhere and all
+digests identical.
+"""
+import hashlib
+import sys
+import time
+
+import numpy as np
+
+import ompi_tpu
+from ompi_tpu.errhandler import MPIException
+from ompi_tpu.op import op as mpi_op
+
+tag = sys.argv[1]
+steps = int(sys.argv[2])
+
+comm = ompi_tpu.init()
+me = comm.rank
+work = comm
+vec = np.zeros(32, np.float64)
+shrinks = 0
+step = 0
+def _i_am_dead():
+    # a rank never ingests its OWN failure into ulfm.failed; the
+    # host-kill path marks the victim incarnations with the same
+    # ulfm_dead flag ft_inject rank_kill uses
+    return getattr(comm.state, "ulfm_dead", False)
+
+
+while step < steps:
+    if _i_am_dead():
+        # my host is the one that died: vanish without finalize
+        # (ulfm_fence drops failed ranks from the quorum).  Checked
+        # BEFORE each op — a dead rank must never meet survivors that
+        # already shrank around it.
+        sys.exit(0)
+    contrib = np.full(32, float((step + 1) * (work.rank + 1)),
+                      np.float64)
+    r = np.empty_like(contrib)
+    try:
+        work.Allreduce(contrib, r, mpi_op.SUM)
+    except MPIException as e:
+        assert e.code in (75, 76, 77), e.code
+        if _i_am_dead():
+            sys.exit(0)
+        # survivors: one shrink, then redo the run from step 0 on the
+        # shrunk world — survivors may disagree on whether the
+        # interrupted step completed, so partial sums are discarded
+        # rather than reconciled
+        work = work.shrink(name="survivors")
+        shrinks += 1
+        vec = np.zeros(32, np.float64)
+        step = 0
+        continue
+    except Exception:  # noqa: BLE001
+        # backstop for the publish/op race: a dead rank that slipped
+        # into one more op against a world the survivors are already
+        # reshaping dies HERE, not as a job failure
+        if _i_am_dead():
+            sys.exit(0)
+        raise
+    vec = vec + r
+    step += 1
+    time.sleep(0.02)
+
+dig = hashlib.sha256(vec.tobytes()).hexdigest()
+# one atomic write per line: rank-threads share the session stdout
+# buffer and print()'s separate text/newline writes interleave
+sys.stdout.write(f"SHRINKS {tag} {me} {shrinks}\n")
+sys.stdout.write(f"DIGEST {tag} {dig}\n")
+sys.stdout.flush()
+ompi_tpu.finalize()

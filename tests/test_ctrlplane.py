@@ -155,6 +155,169 @@ def test_supervisor_respawns_and_heals_fault_plan(tmp_path):
         assert f.read().split() == ["1", "-"]
 
 
+# -- ISSUE 16: host failure domains (docs/DESIGN.md §21) ---------------------
+
+
+def test_kv_standby_placed_with_host_anti_affinity():
+    """The standby's failure domain: co-resident on a one-host fleet
+    (the in-process default), anti-affine when the caller names the
+    off-host domain, and pinned by rte_base_kv_standby_host."""
+    srv = KVServer(2, replicas=1)
+    assert srv.standby.host_id == srv.host_id  # single host: co-res
+    srv.close()
+    srv = KVServer(2, replicas=1, host_id=0, standby_host=1)
+    assert srv.host_id == 0 and srv.standby.host_id == 1
+    srv.close()
+    saved = _set({"rte_base_kv_standby_host": 3})
+    try:
+        srv = KVServer(2, replicas=1, host_id=0, standby_host=1)
+        assert srv.standby.host_id == 3  # the knob pins placement
+        srv.close()
+    finally:
+        _restore(saved)
+
+
+def test_kv_host_crash_mid_fence_completes_on_off_host_standby():
+    """The §21 acceptance scenario: a fence in flight when the
+    primary's HOST dies completes on the anti-affine standby — the
+    arrivals were already replicated across the DCN."""
+    srv = KVServer(4, replicas=1, host_id=0, standby_host=1)
+    clients = [KVClient(srv.uri) for _ in range(4)]
+    clients[0].put("pre/host", "v1")
+    done = [False] * 4
+    errs = []
+    release = threading.Event()
+
+    def worker(i):
+        try:
+            if i == 3:
+                release.wait(30)
+            clients[i].fence("hostchaos", n=4)
+            done[i] = True
+        except Exception as e:  # noqa: BLE001
+            errs.append((i, repr(e)))
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.3)            # workers 0-2 parked inside the fence
+    assert srv.crash_host(0)   # host 0 dies: primary goes with it
+    release.set()              # straggler lands on the standby
+    for t in threads:
+        t.join(timeout=60)
+    assert not errs, errs
+    assert all(done), done
+    assert clients[0].get("pre/host", timeout=10) == "v1"
+    for c in clients:
+        c.close()
+    srv.close()
+
+
+def test_kv_host_crash_of_standby_degrades_replication_only():
+    """Losing the STANDBY's host degrades replication but never the
+    service: the primary keeps answering."""
+    srv = KVServer(2, replicas=1, host_id=0, standby_host=1)
+    c = KVClient(srv.uri)
+    c.put("k", "v")
+    assert srv.crash_host(1)
+    assert srv.repl_degraded
+    assert c.get("k", timeout=10) == "v"
+    c.put("k2", "v2")
+    assert c.get("k2", timeout=10) == "v2"
+    c.close()
+    srv.close()
+
+
+def test_kv_client_names_anti_affinity_when_all_endpoints_share_host():
+    """A standby placed WITHOUT anti-affinity dies with its primary on
+    a host kill; the client's endpoint rotation must then fail with an
+    error that names the misplacement and the knob — not rotate
+    forever on a bare connect error."""
+    import pytest
+    saved = _set({"rte_base_kv_retry_max": 1,
+                  "rte_base_kv_retry_delay": 0.01})
+    try:
+        srv = KVServer(2, replicas=1, host_id=0, standby_host=0)
+        c = KVClient(srv.uri)
+        c.put("k", "v")
+        assert srv.crash_host(0)  # takes BOTH endpoints
+        with pytest.raises(ConnectionError,
+                           match="rte_base_kv_standby_host"):
+            c.get("k", timeout=10)
+        c.close()
+        srv.close()
+    finally:
+        _restore(saved)
+
+
+def test_controller_holds_shrink_while_hosts_rehydrating():
+    """A lost host domain mid-rehydration parks its sessions at zero
+    active ranks — the idle-shrink predicate's trap.  The
+    hosts_rehydrating count must inhibit the shrink until the
+    replacement host rejoins."""
+    from ompi_tpu.serve.controller import FleetController
+
+    class _Stub:
+        capacity = 8
+        active_ranks = 0
+        _waiters = ()
+        est_wall_us = 0
+        rehydrated_parked = 0
+        hosts_rehydrating = 1
+
+    srv = _Stub()
+    fc = FleetController(srv, floor=2, ceil=8)
+    fc.shrink_ticks = 2
+    now = 0
+    for _ in range(10):
+        now += fc.interval_ns + 1
+        fc.tick(now)
+    assert fc.want_capacity == 0, \
+        "controller shrank a pool mid host-rehydration"
+    srv.hosts_rehydrating = 0   # the replacement host rejoined
+    for _ in range(10):
+        now += fc.interval_ns + 1
+        fc.tick(now)
+    assert fc.want_capacity == fc.floor
+
+
+def test_controller_auto_respawns_dead_hosts_when_opted_in():
+    """ctrl_host_respawn=1 turns the controller into the cluster
+    scheduler stand-in: its apply sweep replaces dead domains.  The
+    default (0) leaves them to the operator so MTTR stays measurable."""
+    from ompi_tpu.serve.controller import FleetController
+
+    class _Stub:
+        capacity = 8
+        active_ranks = 0
+        _waiters = ()
+        est_wall_us = 0
+        hosts = 2
+        _host_dead = [0, 1]
+
+        def __init__(self):
+            self.respawned = []
+
+        def respawn_host(self, h):
+            self.respawned.append(h)
+            self._host_dead[h] = 0
+            return 1.0
+
+    srv = _Stub()
+    fc = FleetController(srv, floor=2, ceil=8)
+    fc.apply()                      # default: hands off
+    assert srv.respawned == []
+    saved = _set({"ctrl_host_respawn": 1})
+    try:
+        fc.apply()
+        assert srv.respawned == [1]
+        fc.apply()                  # idempotent once healed
+        assert srv.respawned == [1]
+    finally:
+        _restore(saved)
+
+
 def test_controller_holds_shrink_while_rehydrated_sessions_parked():
     """A freshly rehydrated pool has zero active ranks and an empty
     queue — exactly what the controller's idle-shrink predicate

@@ -28,6 +28,8 @@ HERE = os.path.dirname(__file__)
 PROG = os.path.join(HERE, "_dvm_session_prog.py")
 SLOW_PROG = os.path.join(HERE, "_dvm_slow_prog.py")
 CKPT_PROG = os.path.join(HERE, "_fleet_ckpt_prog.py")
+HOST_PROG = os.path.join(HERE, "_fleet_host_prog.py")
+BUDDY_PROG = os.path.join(HERE, "_fleet_buddy_prog.py")
 
 
 def _set(vals):
@@ -546,6 +548,312 @@ def test_controller_grows_under_backlog_and_shrinks_idle(tmp_path):
         srv.stop()
     finally:
         _restore(saved)
+
+
+# -- ISSUE 16: host failure domains (DESIGN.md §21) -------------------------
+
+
+def _pool2(tmp_path, capacity, hosts=2):
+    """A multi-host pool: ranks band contiguously across `hosts`
+    failure domains (rank's node_id = rank * hosts // np)."""
+    uri = str(tmp_path / "dvm.uri")
+    srv = DVMServer(capacity, devices=jax.devices(), uri_file=uri,
+                    hosts=hosts).start()
+    return srv, uri
+
+
+def _lines(stdout, kind, tag):
+    out = []
+    for line in stdout.splitlines():
+        parts = line.split()
+        if len(parts) >= 3 and parts[0] == kind and parts[1] == tag:
+            out.append(parts[2:])
+    return out
+
+
+def test_ring_offsets_prefers_off_host_partners():
+    """satellite: buddy placement skips same-host partners whenever
+    the topology allows, and degrades to the classic ring when it
+    cannot (single host, or no host-safe offset exists)."""
+    from ompi_tpu.cr.buddy import ring_offsets
+
+    # 2 hosts x 2 ranks: offset 1 pairs within-host ranks (0<->1),
+    # offset 2 is the unique host-safe choice
+    assert ring_offsets([0, 0, 1, 1], 1) == [2]
+    # degree past the host-safe supply falls back to plain offsets
+    assert ring_offsets([0, 0, 1, 1], 3) == [2, 1, 3]
+    # interleaved placement: every odd offset crosses hosts
+    assert ring_offsets([0, 1, 0, 1], 1) == [1]
+    # one host: the classic SCR partner ring
+    assert ring_offsets([0, 0, 0, 0], 2) == [1, 2]
+    # no offset is host-safe for an asymmetric band: plain ring
+    assert ring_offsets([0, 0, 1], 1) == [1]
+    assert ring_offsets([7], 1) == []
+
+
+def test_two_host_attach_cross_host_fence_byte_identical(tmp_path):
+    """One attach commands a world spanning both host domains: the
+    init/finalize fences cross the DCN KV path, the proctable stamps
+    each rank's failure domain, and the output matches a single-host
+    run byte for byte."""
+    (tmp_path / "one").mkdir(exist_ok=True)
+    srv1, uri1 = _pool(tmp_path / "one", 4)
+    c1 = DvmClient(uri1)
+    s1 = c1.attach(4)["sid"]
+    base = c1.run(s1, PROG, ["xh"], timeout=120)
+    assert base["code"] == 0, base["stderr"][-2000:]
+    c1.detach(s1)
+    c1.close()
+    srv1.stop()
+
+    srv, uri = _pool2(tmp_path, 4)
+    c = DvmClient(uri)
+    r = c.attach(4)
+    assert r["hosts"] == 2
+    sid = r["sid"]
+    out = c.run(sid, PROG, ["xh"], timeout=120)
+    assert out["code"] == 0, out["stderr"][-2000:]
+    assert out["stdout"] == base["stdout"], \
+        "a DCN-spanning world diverged from the single-host run"
+    st = c.stats()
+    assert st["hosts"] == 2 and st["hosts_lost"] == 0
+    # the proctable stamps which host's death takes each rank down
+    import json
+    with open(f"{uri}.proctable.json") as fh:
+        table = json.load(fh)
+    doms = sorted(ent["hdom"] for ent in table if "hdom" in ent)
+    assert doms == [0, 0, 1, 1], table
+    c.detach(sid)
+    c.close()
+    srv.stop()
+
+
+def test_host_kill_shrink_arm_single_failure_set(tmp_path):
+    """host_kill mid-collective under ULFM: every rank on the dead
+    host lands in ONE atomic failure set, so each survivor shrinks
+    exactly once and all survivors' digests are byte-identical after
+    redoing the run on the shrunk world."""
+    srv, uri = _pool2(tmp_path, 4)
+    c = DvmClient(uri)
+    sid = c.attach(4)["sid"]
+    res = {}
+
+    def run():
+        res["r"] = c.run(sid, HOST_PROG, ["sa", "120"], timeout=240)
+
+    th = threading.Thread(target=run)
+    th.start()
+    _wait_for(lambda: srv.sessions[sid].running, what="session running")
+    time.sleep(0.6)  # mid-loop, well before step 120
+    srv.kill_host(1)
+    assert srv._host_dead[1] == 1
+    assert srv.hosts_rehydrating == 1
+    th.join(timeout=240)
+    r = res["r"]
+    assert r["code"] == 0, r["stderr"][-2000:]
+    shrinks = _lines(r["stdout"], "SHRINKS", "sa")
+    digs = _lines(r["stdout"], "DIGEST", "sa")
+    # survivors = ranks 0,1 (host 0); victims 2,3 exited silently
+    assert sorted(int(s[0]) for s in shrinks) == [0, 1], shrinks
+    assert all(int(s[1]) == 1 for s in shrinks), \
+        f"a survivor saw a torn failure set: {shrinks}"
+    assert len(digs) == 2 and digs[0] == digs[1], digs
+    # host-granularity respawn reports a real MTTR and refills the
+    # fleet (the RPC path the operator and the probe both use)
+    rr = c.respawn_host(1)
+    assert rr["mttr_ms"] > 0
+    assert srv.hosts_rehydrating == 0 and srv._host_dead[1] == 0
+    st = c.stats()
+    assert st["hosts_lost"] == 0  # live count back to full
+    assert registry._pvars["fleet_hosts_lost"].read() >= 1  # lifetime
+    c.detach(sid)
+    c.close()
+    _assert_band_sums_exact()
+    srv.stop()
+
+
+def test_host_kill_replay_arm_byte_identical(tmp_path):
+    """host_kill against a session that is NOT ULFM-aware
+    (mpi_ft_ulfm=0): the whole session parks, waits out the domain
+    rehydration, and replays from its checkpoint — the client sees
+    one successful slower run, digest byte-identical to an unkilled
+    baseline, never a failed job."""
+    saved = _set({"mpi_ft_ulfm": 0})
+    try:
+        srv, uri = _pool2(tmp_path, 4)
+        steps, sleep_s = 12, 0.2
+        store_a = str(tmp_path / "store_a")
+        cb = DvmClient(uri)
+        sb = cb.attach(2)["sid"]
+        rb = cb.run(sb, CKPT_PROG, ["hbase", store_a, str(steps)],
+                    timeout=240)
+        assert rb["code"] == 0, rb["stderr"][-2000:]
+        base_dig = _digest(rb["stdout"], "hbase")
+        cb.detach(sb)
+        cb.close()
+
+        store_v = str(tmp_path / "store_v")
+        cv = DvmClient(uri)
+        sv = cv.attach(2)["sid"]
+        res = {}
+
+        def run():
+            res["r"] = cv.run(sv, CKPT_PROG,
+                              ["hvic", store_v, str(steps),
+                               str(sleep_s)], timeout=240)
+
+        th = threading.Thread(target=run)
+        th.start()
+        _wait_for(lambda: srv.sessions[sv].running,
+                  what="victim running")
+        time.sleep(0.8)  # a few steps checkpointed
+        srv.kill_host(1)
+        time.sleep(0.3)
+        mttr = srv.respawn_host(1)
+        assert mttr > 0
+        th.join(timeout=240)
+        r = res["r"]
+        assert r["code"] == 0, r["stderr"][-2000:]  # zero failed jobs
+        assert r.get("preempted", 0) >= 1
+        assert _resumed_at(r["stdout"], "hvic") > 0, \
+            "victim restarted from scratch instead of its checkpoint"
+        assert _digest(r["stdout"], "hvic") == base_dig
+        cv.detach(sv)
+        cv.close()
+        srv.stop()
+    finally:
+        _restore(saved)
+
+
+def test_buddy_restore_from_off_host_partner(tmp_path):
+    """satellite: on a 2-host pool the buddy ring places every
+    replica off-host, so host 1's ranks restore their state from
+    host 0 partners after losing their own copies."""
+    srv, uri = _pool2(tmp_path, 4)
+    c = DvmClient(uri)
+    sid = c.attach(4)["sid"]
+    r = c.run(sid, BUDDY_PROG, ["bd"], timeout=240)
+    assert r["code"] == 0, r["stderr"][-2000:]
+    oks = _lines(r["stdout"], "BUDDY", "bd")
+    assert sorted(int(o[0]) for o in oks) == [0, 1, 2, 3], r["stdout"]
+    c.detach(sid)
+    c.close()
+    srv.stop()
+
+
+def test_ft_inject_host_kill_class(tmp_path):
+    """satellite: the deterministic host_kill fault class severs the
+    victim host at the armed op count — same lost-domain handling as
+    heartbeat silence, no process needed."""
+    saved = _set({"ft_inject_plan": "host_kill:3",
+                  "ft_inject_skip": 0,
+                  "ft_inject_victim_host": 1})
+    try:
+        srv, uri = _pool2(tmp_path, 4)  # injector armed in _setup
+        assert srv._hkill is not None
+        c = DvmClient(uri)
+        c.stats()   # op 1
+        c.stats()   # op 2
+        c.stats()   # op 3 -> fires
+        assert srv._host_dead[1] == 1
+        assert srv.hosts_rehydrating == 1
+        st = c.stats()
+        assert st["hosts_lost"] == 1
+        c.close()
+        srv.stop()
+    finally:
+        _restore(saved)
+
+
+def test_host_journal_federation_and_bounded_replay(tmp_path):
+    """satellite: per-host write-ahead journals federate under one
+    incarnation; completed-jobid replay memory stays bounded at 64
+    across torn-tail recovery, compaction, and TWO successive
+    incarnations."""
+    import json
+    srv, uri = _pool2(tmp_path, 4)
+    c = DvmClient(uri)
+    sid = c.attach(2)["sid"]  # sid 1 -> host 1's journal (1 % 2)
+    r = c.run(sid, PROG, ["fj"], timeout=120)
+    assert r["code"] == 0, r["stderr"][-2000:]
+    h0_path = f"{uri}.journal.jsonl"
+    h1_path = f"{uri}.journal.h1.jsonl"
+
+    def _h1():
+        with open(h1_path) as fh:
+            return fh.read()
+
+    # run/run_done append asynchronously; the heartbeat tick flushes
+    _wait_for(lambda: '"run_done"' in _h1(), timeout=30,
+              what="run_done flushed to the host journal")
+    with open(h0_path) as fh:
+        h0 = fh.read()
+    h1 = _h1()
+    # the session's records route to its OWNING host's journal
+    assert '"attach"' not in h0
+    assert '"attach"' in h1 and '"run_done"' in h1
+    # both journals are stamped with the same fleet incarnation
+    inc0 = json.loads(h0.splitlines()[0])["inc"]
+    inc1 = json.loads(h1.splitlines()[0])["inc"]
+    assert inc0 == inc1
+    c.sock.close()  # vanish without detach: the session must replay
+    srv.stop()      # deletes both journals
+
+    # resurrect the fleet's journals with 80 extra completed jobs and
+    # a torn tail on the HOST journal (the host died mid-append)
+    fakes = "".join(
+        json.dumps({"t": "run_done", "sid": sid,
+                    "jobid": f"fake-{i}", "code": 0}) + "\n"
+        for i in range(80))
+    with open(h0_path, "w") as fh:
+        fh.write(h0)
+    with open(h1_path, "w") as fh:
+        fh.write(h1 + fakes + '{"t": "run_done", "sid')  # torn tail
+    srv2 = DVMServer(4, devices=jax.devices(), uri_file=uri,
+                     hosts=2).start()
+    assert srv2.rehydrated == 1
+    sess = srv2.sessions[sid]
+    assert sess.parked and len(sess.completed) <= 64, \
+        f"replay memory unbounded: {len(sess.completed)}"
+    # the compacted host journal carries the bound forward too
+    with open(h1_path) as fh:
+        compacted = fh.read()
+    assert compacted.count('"run_done"') <= 64
+    with open(h1_path) as fh:
+        h1b = fh.read()
+    with open(h0_path) as fh:
+        h0b = fh.read()
+    srv2.stop()
+
+    # second incarnation: the bound holds again, no re-accretion
+    with open(h0_path, "w") as fh:
+        fh.write(h0b)
+    with open(h1_path, "w") as fh:
+        fh.write(h1b)
+    srv3 = DVMServer(4, devices=jax.devices(), uri_file=uri,
+                     hosts=2).start()
+    assert srv3.rehydrated == 1
+    assert len(srv3.sessions[sid].completed) <= 64
+    srv3.stop()
+
+
+def test_clean_halt_deletes_federated_journals(tmp_path):
+    """A journal on disk always means a crash — the RPC halt path
+    must delete the per-host federated journals along with the
+    primary, or the next incarnation resurrects sessions nobody
+    wants back."""
+    srv, uri = _pool2(tmp_path, 4)
+    c = DvmClient(uri)
+    sid = c.attach(2)["sid"]  # sid 1 -> host 1's journal
+    assert os.path.exists(f"{uri}.journal.jsonl")
+    assert os.path.exists(f"{uri}.journal.h1.jsonl")
+    c.halt()
+    assert not os.path.exists(f"{uri}.journal.jsonl")
+    assert not os.path.exists(f"{uri}.journal.h1.jsonl"), \
+        "clean halt left a host journal behind"
+    c.close()
+    srv.stop()
+    del sid
 
 
 def test_controller_tick_is_audited_hot():
