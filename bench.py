@@ -339,6 +339,14 @@ def main() -> None:
             "seg_crossover_bytes": probe["seg_crossover_bytes"],
             "hier_min_bytes": probe["hier_min_bytes"],
             "segments_rank0": probe["segments_rank0"],
+            "plan_builds": sum(
+                c.get("builds", 0)
+                for alg in (probe.get("plan_cache") or {}).values()
+                for c in alg.values()),
+            "plan_hits": sum(
+                c.get("hits", 0)
+                for alg in (probe.get("plan_cache") or {}).values()
+                for c in alg.values()),
         }
         line.update({k: v for k, v in notes.items() if "error" in k})
         sys.stderr.write(json.dumps(probe, indent=1) + "\n")

@@ -41,18 +41,21 @@ ALGS = ("fused", "segring", "segrd", "hier")
 _CONFIGS: Dict[str, Dict[str, object]] = {
     "fused": {"coll_pipeline_enable": False, "coll_hier_enable": False},
     "segring": {"coll_pipeline_enable": True, "coll_hier_enable": False,
-                "coll_pipeline_min_bytes": 1,
+                "coll_pipeline_min_bytes": 1, "coll_plan_enable": True,
                 "coll_pipeline_rd_max_bytes": 0},
     "segrd": {"coll_pipeline_enable": True, "coll_hier_enable": False,
-              "coll_pipeline_min_bytes": 1,
+              "coll_pipeline_min_bytes": 1, "coll_plan_enable": True,
               "coll_pipeline_rd_max_bytes": 1 << 62},
     "hier": {"coll_pipeline_enable": True, "coll_hier_enable": True,
              "coll_pipeline_min_bytes": 1, "coll_hier_min_bytes": 1,
+             "coll_plan_enable": True,
              "coll_pipeline_rd_max_bytes": 0},
 }
 
 # per-comm routing caches that must be dropped when knobs change
-_ROUTE_KEYS = ("_pipeline_pick", "_hier_eligible", "_hier_plan")
+# (resolved Plan objects key on geometry the knobs move)
+_ROUTE_KEYS = ("_pipeline_pick", "_hier_eligible", "_hier_plan",
+               "_coll_plans")
 
 
 def _median_us(samples: List[float]) -> float:
@@ -102,9 +105,16 @@ def run_probe(nranks: int = 8, reps: int = 7,
         import jax
         import jax.numpy as jnp
         from ompi_tpu.coll import pipeline
+        from ompi_tpu.coll import plan as coll_plan
         from ompi_tpu.op.op import SUM
 
         curve: Dict[str, Dict[str, float]] = {a: {} for a in ALGS}
+        # plan-cache traffic per alg x size: builds measured across the
+        # whole block (all ranks add to the process-wide pvar), so a
+        # steady-state regression — plans rebuilt per op — shows up as
+        # builds >> nranks for a single size
+        plan_cache: Dict[str, Dict[str, Dict[str, int]]] = \
+            {a: {} for a in ALGS}
         seg_before = pipeline.pv_segments.read()
         for alg in ALGS:
             for nb in sizes:
@@ -112,11 +122,16 @@ def run_probe(nranks: int = 8, reps: int = 7,
                 x = jax.device_put(
                     jnp.arange(nb // 4, dtype=jnp.float32) + comm.rank,
                     comm.device)
+                b0 = coll_plan.pv_builds.read()
+                h0 = coll_plan.pv_hits.read()
                 # big payloads settle for fewer reps: the median of 3
                 # at 16 MiB still rejects a single preemption
                 r = max(3, reps - 2 * sizes.index(nb))
                 curve[alg][str(nb)] = round(_time_loop(
                     comm, lambda: comm.allreduce_arr(x, SUM), r), 1)
+                plan_cache[alg][str(nb)] = {
+                    "builds": coll_plan.pv_builds.read() - b0,
+                    "hits": coll_plan.pv_hits.read() - h0}
                 del x
 
         # per-phase breakdown (ISSUE 13): a short pass per alg x size
@@ -162,6 +177,7 @@ def run_probe(nranks: int = 8, reps: int = 7,
             registry.set(k, v)
         _apply(comm, "fused", comm.size)  # leave the world at defaults
         return {"lat_us": curve, "phase_raw": raw,
+                "plan_cache": plan_cache,
                 "segments": pipeline.pv_segments.read() - seg_before}
 
     res = run_ranks(nranks, fn, devices=True, timeout=1800)
@@ -189,6 +205,7 @@ def run_probe(nranks: int = 8, reps: int = 7,
                           for s, us in lat[a].items()}
                       for a in ALGS},
         "phase_us": phase_us,
+        "plan_cache": res[0].get("plan_cache") or {},
         "segments_rank0": res[0]["segments"],
     }
     # measured crossovers: smallest probed size where the tier wins

@@ -51,6 +51,13 @@ TOLERANCES: Dict[str, Tuple[str, float]] = {
     "headline_busbw_gbs": ("higher", 0.10),
     "pipeline_fused_busbw_gbs": ("higher", 0.25),
     "pipeline_segring_busbw_gbs": ("higher", 0.25),
+    # compiled-plan sentries (ISSUE 17): the best segmented busbw
+    # anywhere on the sweep, and segmented-vs-fused at 256 KiB — the
+    # size where plan orchestration savings dominate, so a plan-path
+    # regression (per-op rebuilds, a lost zero-copy pack) shows up
+    # here before it shows in the 8 MiB headline
+    "seg_best_busbw_gbs": ("higher", 0.25),
+    "seg_vs_fused_ratio_256k": ("higher", 0.25),
     "trace_overhead_pct": ("lower", 2.0),
     "obs_overhead_pct": ("lower", 2.0),
     "dispatch_const_us": ("lower", 50.0),
@@ -87,6 +94,25 @@ def _json_lines(text: str):
                 yield json.loads(line)
             except ValueError:
                 continue
+
+
+#: sanity bound on the device sweep's measured d2h read constant.  An
+#: idle box reads 4 bytes in tens of microseconds; ~100 ms means the
+#: quiet gate failed (polling peers / tunnel threads contaminated the
+#: probe — the r4 failure mode) and the constant-subtraction then
+#: FABRICATES busbw.  Rounds in that state are not comparable.
+READ_CONST_SANE_US = 5000.0
+
+
+def headline_valid(doc: dict) -> bool:
+    """True when a round's headline came from the chained-dependency
+    methodology with a sane read constant.  Rounds predating the
+    ``read_const_us`` field timed unforced dispatch (the
+    block_until_ready floor), and rounds with a contaminated constant
+    over-credit every op — neither number is a usable baseline."""
+    parsed = doc.get("parsed") or {}
+    rc = parsed.get("read_const_us")
+    return isinstance(rc, (int, float)) and 0 <= rc < READ_CONST_SANE_US
 
 
 def round_headline(doc: dict) -> Optional[float]:
@@ -149,6 +175,20 @@ def _detail_metrics(detail: dict) -> Dict[str, float]:
         if sizes:
             top = max(sizes, key=int)
             out[f"pipeline_{alg}_busbw_gbs"] = float(curve[top])
+    # best segmented busbw across BOTH plan algs and ALL sizes
+    seg_vals = [float(v)
+                for alg in ("segring", "segrd")
+                for v in (bus.get(alg) or {}).values()
+                if isinstance(v, (int, float)) and v > 0]
+    if seg_vals:
+        out["seg_best_busbw_gbs"] = max(seg_vals)
+    k256 = str(256 << 10)
+    fused256 = (bus.get("fused") or {}).get(k256)
+    seg256 = [v for v in ((bus.get("segring") or {}).get(k256),
+                          (bus.get("segrd") or {}).get(k256))
+              if isinstance(v, (int, float)) and v > 0]
+    if isinstance(fused256, (int, float)) and fused256 > 0 and seg256:
+        out["seg_vs_fused_ratio_256k"] = round(max(seg256) / fused256, 3)
     rma = (detail.get("probe_rma") or {}).get("components") or {}
     mib = str(1 << 20)
     for comp in ("device", "pt2pt"):
@@ -230,10 +270,16 @@ def evaluate(rounds: List[Tuple[int, dict]],
     cur = current_metrics(rounds, detail)
     findings: List[dict] = []
 
-    # headline: newest round vs the prior rounds' own records
-    if "headline_busbw_gbs" in cur and len(rounds) >= 3:
+    # headline: newest round vs the prior rounds' own records —
+    # measurement-valid rounds only on BOTH sides (headline_valid):
+    # an invalid current round cannot be judged, and invalid history
+    # rows would anchor the baseline to fabricated numbers
+    if "headline_busbw_gbs" in cur and len(rounds) >= 3 and \
+            headline_valid(rounds[-1][1]):
         hist = []
         for _n, doc in rounds[:-1]:
+            if not headline_valid(doc):
+                continue
             v = round_headline(doc)
             if v is not None:
                 hist.append(v)

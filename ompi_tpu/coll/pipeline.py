@@ -402,11 +402,27 @@ def _unpack_trim(comm, outs: List[Any], n: int, seg: int):
     return out
 
 
+_plan_mod = None
+
+
+def _plan():
+    """Lazy plan-compiler import (coll/plan imports this module)."""
+    global _plan_mod
+    if _plan_mod is None:
+        from ompi_tpu.coll import plan as _plan_mod_imp
+        _plan_mod = _plan_mod_imp
+    return _plan_mod
+
+
 # -- mesh (coll/tpu) algorithms ---------------------------------------------
 
 def _mesh_seg_reduce(module, comm, x, op, alg: str):
-    """Segmented allreduce over the mesh: segring or segrd kernels,
-    pipelined."""
+    """Segmented allreduce over the mesh: the compiled-plan path (one
+    jitted whole-schedule program, one rendezvous — DESIGN.md §22)
+    when enabled, else segring/segrd kernels pipelined per segment."""
+    pl = _plan()
+    if pl.enabled():
+        return pl.mesh_reduce(module, comm, x, op, alg)
     import jax.numpy as jnp
     from ompi_tpu.coll import device
     mesh = comm.mesh()
@@ -496,9 +512,14 @@ def _mesh_seg_alltoall(module, comm, x):
 # -- hbm (intra-chip) segmentation ------------------------------------------
 
 def _hbm_seg_reduce(module, comm, x, op):
-    """Segmented intra-chip allreduce: per-segment stacked kernels
-    (elementwise over the rank axis — bit-exact vs the monolithic
-    stacked reduce at ANY dtype), pipelined through the async meet."""
+    """Segmented intra-chip allreduce: the compiled-plan path (one
+    stacked whole-payload kernel, one rendezvous) when enabled, else
+    per-segment stacked kernels (elementwise over the rank axis —
+    bit-exact vs the monolithic stacked reduce at ANY dtype),
+    pipelined through the async meet."""
+    pl = _plan()
+    if pl.enabled():
+        return pl.hbm_reduce(module, comm, x, op)
     import jax.numpy as jnp
     x = module._deposit(comm, x)
     shape = x.shape

@@ -1,0 +1,425 @@
+"""coll/plan: compiled collective plans — ONE jitted multi-segment
+program and ONE rendezvous per large-message collective.
+
+The pipelined tier (coll/pipeline.py) proved the segmented schedules
+but pays N per-segment rendezvous + N host dispatches + N
+``NamedSharding``/assemble constructions per op.  On a fast mesh the
+op becomes orchestration-bound: the device finishes a segment long
+before the host has packed, met and dispatched the next one.
+
+The plan compiler moves every decision out of steady state.  For each
+(alg, mesh, segment geometry, dtype, op) it compiles ONE jitted
+program covering the WHOLE multi-segment schedule — the full
+reduce-scatter + allgather ring (segring) or the recursive-doubling
+exchange (segrd) as a single shard_map with buffer donation — and
+binds it into a ``Plan`` holding the prebuilt sharding, the meet-fn
+closure and the pad identity.  Executing a plan is pure data motion:
+
+    pack (identity-pad to the plan's fixed shape, zero-copy staging
+    bypass where the runtime aliases aligned host buffers)
+      -> ONE ``device.meet`` (rendezvous collapses from N per op to 1;
+         the ULFM abort check rides the meet, so fault handling sits
+         at the plan boundary instead of per segment)
+      -> unpack (trim) + pvar/trace accounting.
+
+Keying and lifetime:
+
+* jitted executables live in the process-wide ``device.compile_cache``
+  under ``("plan_<alg>", dev_key, geometry, dtype, op, donate)`` —
+  dev_key is a top-level element, so ``drop_mesh`` on device loss and
+  shrink epochs evicts exactly the stale-mesh programs.
+* resolved ``Plan`` objects live per comm in ``comm._coll_plans``
+  (bounded LRU, ``coll_plan_cache_max``), purged by ULFM's
+  ``_COMM_CACHE_KEYS`` at shrink/respawn epochs and by
+  ``SELECTION_CACHE_KEYS`` when an autotune fold moves the calibrated
+  segment size out from under the plan geometry.
+* sub-segment payloads quantize the plan shape to the next pow2
+  (multiple of comm size), full payloads use the calibrated segment —
+  the identity padding keeps every size on a log-bounded key set.
+
+Reduce lowering: with ``coll_plan_native_reduce`` (default), plans
+for SUM/MAX/MIN lower to the runtime's native cross-replica reduction
+(psum/pmax/pmin) — the same backend-pragmatic discipline as the fused
+path's bcast-as-masked-psum — because a compiler-scheduled fused
+reduction beats a hop-explicit schedule wherever the runtime provides
+one.  Other ops, and all ops with the knob off, keep the faithful
+batched ring / recursive-doubling schedule, which real multi-slice
+topologies may prefer.
+
+DESIGN.md §22.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+import time
+
+import numpy as np
+
+from ompi_tpu import obs as _obs
+from ompi_tpu import trace as _trace
+from ompi_tpu.coll import pipeline as _pl
+from ompi_tpu.mca.params import registry
+from ompi_tpu.runtime import staging as _staging
+
+_CAT_SEG = _trace.CAT_COLL_SEGMENT
+_CAT_PHASE = _trace.CAT_PHASE
+_NAME_PLAN = _trace.NAME_PLAN_EXEC
+_NAME_PH_PACK = _trace.NAME_PH_PACK
+_NAME_PH_UNPACK = _trace.NAME_PH_UNPACK
+
+_enable_var = registry.register(
+    "coll", "plan", "enable", True, bool,
+    help="Compile one jitted multi-segment program per (alg, mesh, "
+         "segment geometry, dtype, op) and run each large-message "
+         "allreduce as ONE rendezvous + ONE dispatch (DESIGN.md §22); "
+         "0 = the per-segment pipelined rendezvous path")
+
+_cache_max_var = registry.register(
+    "coll", "plan", "cache_max", 32, int,
+    help="Per-communicator bound on resolved Plan objects (LRU). "
+         "Jitted executables are bounded separately by the "
+         "process-wide compile cache (coll_device_cache_max)")
+
+_native_var = registry.register(
+    "coll", "plan", "native_reduce", True, bool,
+    help="Lower plan reduce phases for SUM/MAX/MIN to the runtime's "
+         "native cross-replica reduction (psum/pmax/pmin); 0 keeps "
+         "the hop-explicit batched ring / recursive-doubling "
+         "schedule for every op")
+
+pv_builds = _obs.scoped_pvar(
+    "coll", "plan", "builds",
+    help="collective plans resolved (per rank): a Plan object built "
+         "and cached on the comm — steady state should be ~0")
+pv_hits = _obs.scoped_pvar(
+    "coll", "plan", "hits",
+    help="collective ops served by an already-resolved plan")
+pv_exec_us = _obs.scoped_pvar(
+    "coll", "plan", "exec_us",
+    help="cumulative wall microseconds inside plan execution "
+         "(pack + rendezvous + unpack)")
+
+#: ops with a native cross-replica lowering in the runtime
+_NATIVE_OPS = frozenset(("MPI_SUM", "MPI_MAX", "MPI_MIN"))
+
+#: interned alg ids for the plan_exec span
+_ALG_ID = {
+    "segring": _trace.intern_name("segring"),
+    "segrd": _trace.intern_name("segrd"),
+    "hbm": _trace.intern_name("hbm"),
+}
+
+
+def enabled() -> bool:
+    return bool(_enable_var.value)
+
+
+def _plan_segments(comm, n: int, seg: int):
+    """(nsegs, seg_elems) for an n-element payload.  Payloads below
+    one calibrated segment quantize to the next pow2 (rounded to a
+    comm-size multiple) so a 64 KiB message is not identity-padded to
+    a 1 MiB program; at or above, the calibrated segment is the unit.
+    Either way the key set stays log-bounded in payload size."""
+    size = comm.size
+    if n < seg:
+        s = 1
+        while s < n:
+            s <<= 1
+        rem = s % size
+        if rem:
+            s += size - rem
+        return 1, min(s, seg)
+    return -(-n // seg), seg
+
+
+class Plan:
+    """One resolved collective plan: the prebound meet-fn (prebuilt
+    sharding + jitted whole-schedule program + scatter), the pad
+    identity, this rank's deposit device and the interned ids the
+    executor stamps into spans.  Everything per-op-variable is an
+    ``execute`` argument; everything else was decided at build."""
+
+    __slots__ = ("alg", "alg_id", "nsegs", "seg", "total", "itemsize",
+                 "np_dtype", "pad_val", "fn", "meet", "device")
+
+    def __init__(self, alg: str, nsegs: int, seg: int, np_dtype,
+                 pad_val, fn, meet, device) -> None:
+        self.alg = alg
+        self.alg_id = _ALG_ID[alg]
+        self.nsegs = nsegs
+        self.seg = seg
+        self.total = nsegs * seg
+        self.itemsize = np_dtype.itemsize
+        self.np_dtype = np_dtype
+        self.pad_val = pad_val
+        self.fn = fn
+        self.meet = meet
+        self.device = device
+
+    def execute(self, module, comm, flat, n: int):
+        """The whole steady-state op.  Hot (once per large-message
+        collective): audited by hotpath_audit — pack/unpack and all
+        key/closure work live off this path."""
+        tr = comm.state.tracer
+        t0 = 0
+        if tr is not None:
+            t0 = tr.start_sampled(_CAT_SEG)
+        ns0 = time.perf_counter_ns()
+        value = flat
+        if n != self.total:
+            value = _pack(comm, flat, n, self)
+        out = self.meet(comm, value, self.fn, module._abort_check(comm))
+        if n != self.total:
+            out = _unpack(comm, out, n, self)
+        pv_exec_us.add((time.perf_counter_ns() - ns0) // 1000,
+                       _obs.current_band())
+        if t0:
+            tr.end(t0, _NAME_PLAN, _CAT_SEG,
+                   comm.cid, n * self.itemsize, self.alg_id)
+        return out
+
+
+def _pack(comm, flat, n: int, plan: Plan):
+    """Identity-pad ``flat`` (n,) to the plan's fixed (total,) shape.
+    On a zero-copy runtime this is ONE memcpy into a fresh aligned
+    host buffer that device_put then aliases — no device program, and
+    fresh per op because the padded array may still back an unforced
+    program when the next op starts (unlike osc's lock-serialized
+    mirror reuse).  Copying runtimes compose on device."""
+    tr = comm.state.tracer
+    t0 = tr.start_sampled(_CAT_PHASE) \
+        if tr is not None and tr.phase else 0
+    if _staging.runtime_zero_copy():
+        import jax
+        buf = _staging.aligned_empty(plan.total * plan.itemsize)
+        view = buf.view(plan.np_dtype)
+        np.copyto(view[:n], np.asarray(flat))
+        view[n:] = plan.pad_val
+        value = jax.device_put(view, plan.device)
+    else:
+        import jax.numpy as jnp
+        value = jnp.concatenate(
+            [jnp.asarray(flat),
+             jnp.full((plan.total - n,), plan.pad_val, plan.np_dtype)])
+    if t0:
+        tr.end(t0, _NAME_PH_PACK, _CAT_PHASE,
+               comm.cid, 0, n * plan.itemsize)
+    return value
+
+
+def _unpack(comm, out, n: int, plan: Plan):
+    tr = comm.state.tracer
+    t0 = tr.start_sampled(_CAT_PHASE) \
+        if tr is not None and tr.phase else 0
+    res = out[:n]
+    if t0:
+        tr.end(t0, _NAME_PH_UNPACK, _CAT_PHASE,
+               comm.cid, 0, n * plan.itemsize)
+    return res
+
+
+def _plans_of(comm) -> OrderedDict:
+    plans = comm.__dict__.get("_coll_plans")
+    if plans is None:
+        plans = comm.__dict__["_coll_plans"] = OrderedDict()
+    return plans
+
+
+def _resolve(comm, pkey, builder) -> Plan:
+    """Per-comm Plan LRU: hit moves to the back, build trims to
+    coll_plan_cache_max.  comm objects are rank-local, so this needs
+    no lock; the expensive XLA compile below it is deduped by the
+    process-wide compile cache."""
+    plans = _plans_of(comm)
+    plan = plans.get(pkey)
+    band = _obs.current_band()
+    if plan is not None:
+        plans.move_to_end(pkey)
+        pv_hits.add(1, band)
+        return plan
+    plan = builder()
+    plans[pkey] = plan
+    cap = max(1, int(_cache_max_var.value))
+    while len(plans) > cap:
+        plans.popitem(last=False)
+    pv_builds.add(1, band)
+    return plan
+
+
+# -- mesh plans -------------------------------------------------------------
+
+def _compile_mesh(alg: str, mesh, size: int, nsegs: int, seg: int,
+                  np_dtype, opname: str, native: bool, donate: bool):
+    """The ONE jitted program covering the whole multi-segment
+    schedule: global (size*nsegs*seg,) in P("r"), replicated out."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+    from ompi_tpu.coll import device
+
+    binop = _pl._binop(opname)
+    if native:
+        if opname == "MPI_SUM":
+            body = lambda x: lax.psum(x, "r")  # noqa: E731
+        elif opname == "MPI_MAX":
+            body = lambda x: lax.pmax(x, "r")  # noqa: E731
+        else:
+            body = lambda x: lax.pmin(x, "r")  # noqa: E731
+    elif alg == "segring":
+        # the full reduce-scatter + allgather ring, batched over the
+        # leading nsegs axis — per segment this is exactly the
+        # pipelined tier's segring kernel, fused into one program
+        ring = [(j, (j + 1) % size) for j in range(size)]
+        m = seg // size
+
+        def body(x):
+            i = lax.axis_index("r")
+            stripes = x.reshape(nsegs, size, m)
+
+            def stripe(idx):
+                return lax.dynamic_slice_in_dim(
+                    stripes, idx, 1, axis=1)[:, 0]
+
+            acc = stripe(i)
+            for t in range(size - 1):
+                acc = lax.ppermute(acc, "r", perm=ring)
+                acc = binop(acc, stripe((i - t - 1) % size))
+            # rank i now owns fully-reduced stripe (i+1) % size
+            out = jnp.zeros((nsegs, size, m), x.dtype)
+            out = lax.dynamic_update_slice_in_dim(
+                out, acc[:, None], (i + 1) % size, axis=1)
+            cur = acc
+            for t in range(size - 1):
+                cur = lax.ppermute(cur, "r", perm=ring)
+                out = lax.dynamic_update_slice_in_dim(
+                    out, cur[:, None], (i - t) % size, axis=1)
+            return out.reshape(nsegs * seg)
+    else:
+        # recursive doubling over the whole padded vector — the
+        # schedule is elementwise, so batching over segments is free
+        def body(x):
+            i = lax.axis_index("r")
+            acc = x
+            s = 1
+            while s < size:
+                perm = [(j, j ^ s) for j in range(size)]
+                other = lax.ppermute(acc, "r", perm=perm)
+                low = (i & s) == 0
+                acc = jnp.where(low, binop(acc, other),
+                                binop(other, acc))
+                s <<= 1
+            return acc
+
+    fn = device.shard_map_compat(body, mesh, P("r"), P(None))
+    if donate:
+        return jax.jit(fn, donate_argnums=(0,))
+    return jax.jit(fn)
+
+
+def _build_mesh_plan(comm, alg: str, nsegs: int, seg: int, np_dtype,
+                     opname: str, donate: bool) -> Plan:
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from ompi_tpu.coll import device
+
+    mesh = comm.mesh()
+    size = comm.size
+    devs = list(mesh.devices.reshape(-1))
+    dev_key = tuple(d.id for d in devs)
+    native = bool(_native_var.value) and opname in _NATIVE_OPS
+    # native programs are alg-independent — one compile serves both
+    # segring and segrd picks for the same geometry
+    if native:
+        ckey = ("plan_native", dev_key, (nsegs * seg,), np_dtype.str,
+                opname, donate)
+    else:
+        ckey = ("plan_" + alg, dev_key, (nsegs, seg), np_dtype.str,
+                opname, donate)
+    jfn = device.compile_cache.get(
+        ckey, lambda: _compile_mesh(alg, mesh, size, nsegs, seg,
+                                    np_dtype, opname, native, donate))
+    sharding = NamedSharding(mesh, P("r"))
+
+    def fn(shards, _m=mesh, _sh=sharding, _j=jfn, _n=size):
+        g = device._assemble(_m, shards, _sh)
+        return device._scatter_out(_j(g), _m, _n)
+
+    return Plan(alg, nsegs, seg, np_dtype,
+                _pl._pad_value(opname, np_dtype), fn, device.meet,
+                devs[comm.rank])
+
+
+def mesh_reduce(module, comm, x, op, alg: str):
+    """Plan-path segmented allreduce over the mesh: resolve (or reuse)
+    the plan for this payload's geometry, then one pack / one
+    rendezvous / one unpack."""
+    import jax.numpy as jnp
+
+    # 1-D payloads (the common case) flow through UNTOUCHED: a
+    # same-shape jnp reshape is a fresh dispatch whose result lands
+    # uncommitted on the default device, and _assemble would then
+    # re-place 7 of 8 shards with a device_put on EVERY op
+    if getattr(x, "ndim", None) == 1:
+        shape, flat = None, x
+    else:
+        shape = x.shape
+        flat = jnp.asarray(x).reshape(-1)
+    n = int(flat.shape[0])
+    np_dtype = np.dtype(flat.dtype)
+    nsegs, seg = _plan_segments(
+        comm, n, _pl.segment_elems(comm, np_dtype.itemsize))
+    # donation is only sound when the pack stage owns the padded
+    # buffer; exact-fit payloads flow the caller's array straight in
+    donate = nsegs * seg != n
+    pkey = ("mesh", alg, nsegs, seg, np_dtype.str, op.name, donate)
+    plan = _resolve(
+        comm, pkey,
+        lambda: _build_mesh_plan(comm, alg, nsegs, seg, np_dtype,
+                                 op.name, donate))
+    _pl.pv_segments.add(nsegs)
+    out = plan.execute(module, comm, flat, n)
+    return out if shape is None else out.reshape(shape)
+
+
+# -- hbm (intra-chip) plans -------------------------------------------------
+
+def _build_hbm_plan(module, comm, nsegs: int, seg: int, np_dtype,
+                    opname: str, device_hint) -> Plan:
+    from ompi_tpu.coll import device
+
+    size = comm.size
+    jbody, out_map = module._stacked("allreduce", opname, size,
+                                     (nsegs * seg,), np_dtype)
+
+    def fn(shards, _j=jbody, _o=out_map, _n=size):
+        return _o(_j(*shards), _n)
+
+    return Plan("hbm", nsegs, seg, np_dtype,
+                _pl._pad_value(opname, np_dtype), fn, device.meet,
+                device_hint)
+
+
+def hbm_reduce(module, comm, x, op):
+    """Plan-path intra-chip allreduce: the stacked whole-payload
+    kernel (already one dispatch) now also goes through exactly one
+    rendezvous instead of one per segment."""
+    x = module._deposit(comm, x)
+    if getattr(x, "ndim", None) == 1:
+        shape, flat = None, x  # no same-shape reshape dispatch
+    else:
+        shape = x.shape
+        flat = x.reshape(-1)
+    n = int(flat.shape[0])
+    np_dtype = np.dtype(flat.dtype)
+    nsegs, seg = _plan_segments(
+        comm, n, _pl.segment_elems(comm, np_dtype.itemsize))
+    pkey = ("hbm", nsegs, seg, np_dtype.str, op.name)
+    dev = getattr(x, "device", None)
+    plan = _resolve(
+        comm, pkey,
+        lambda: _build_hbm_plan(module, comm, nsegs, seg, np_dtype,
+                                op.name, dev))
+    _pl.pv_segments.add(nsegs)
+    out = plan.execute(module, comm, flat, n)
+    return out if shape is None else out.reshape(shape)

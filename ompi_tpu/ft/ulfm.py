@@ -577,6 +577,10 @@ _COMM_CACHE_KEYS = (
     # epochs
     "_pipeline_pick", "_hier_eligible", "_hier_plan",
     "_cart_device_mesh",
+    # compiled collective plans (DESIGN.md §22): Plan objects hold the
+    # old mesh, its sharding and a jitted executable bound to the old
+    # device set — stale-mesh executables must never survive an epoch
+    "_coll_plans",
     # osc framework: the per-window component verdict keys on the old
     # mesh (device eligibility), so a shrunk comm must re-decide
     "_osc_pick",
@@ -587,7 +591,10 @@ _COMM_CACHE_KEYS = (
 # them online when the calibrate profile moves).  _hier_plan and the
 # rendezvous caches are NOT here — their rebuild is collective
 # (subcomm construction) and may only happen at epoch boundaries.
-SELECTION_CACHE_KEYS = ("_pipeline_pick", "_osc_pick")
+# _coll_plans qualifies: a Plan rebuild is rank-local (the jitted
+# executable comes out of the process-wide CompiledLRU) and keys on
+# calibrated segment size, which is exactly what an autotune fold moves
+SELECTION_CACHE_KEYS = ("_pipeline_pick", "_osc_pick", "_coll_plans")
 
 
 def purge_comm_caches(comm, keys=_COMM_CACHE_KEYS) -> None:

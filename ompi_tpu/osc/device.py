@@ -62,7 +62,6 @@ host fallback.  put/get are byte-level and never care.
 from __future__ import annotations
 
 import threading
-import warnings
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -73,11 +72,6 @@ from ompi_tpu.mca.params import registry
 from ompi_tpu.op import op as opmod
 from ompi_tpu.osc import window as _host
 from ompi_tpu.osc.window import _DT_CODE, _WIRE_DTYPES, Window
-
-# donation is a no-op on the CPU backend; the warning would fire per
-# compiled kernel in every tier-1 run
-warnings.filterwarnings(
-    "ignore", message="Some donated buffers were not usable")
 
 _CAT_RMA = _trace.CAT_RMA
 _NAME_RMA_PUT = _trace.NAME_RMA_PUT
@@ -98,38 +92,15 @@ _dma_var = registry.register(
          "the mesh-collective lowering, kept for topologies where an "
          "origin-driven host DMA is the slow path")
 
-#: staging alignment for DMA-path uploads: the CPU runtime aliases a
-#: 64-byte-aligned host buffer on device_put instead of copying it
-_STAGE_ALIGN = 64
+# staging discipline (alignment, aliasing probe, mirror pool, the
+# donated-buffers warning filter) lives in the shared runtime module
+# since the coll plan tier packs through the same bypass; the local
+# names survive because the DMA path below predates the hoist
+from ompi_tpu.runtime import staging as _staging
 
-
-def _aligned_empty(nbytes: int) -> np.ndarray:
-    """Uninitialized uint8 staging buffer whose data pointer is
-    _STAGE_ALIGN-aligned (numpy only guarantees 16)."""
-    raw = np.empty(nbytes + _STAGE_ALIGN, dtype=np.uint8)
-    off = (-raw.ctypes.data) % _STAGE_ALIGN
-    return raw[off: off + nbytes]
-
-
-_ZERO_COPY: Optional[bool] = None
-
-
-def _runtime_zero_copy() -> bool:
-    """Whether device_put of an aligned host buffer ALIASES it (the
-    CPU runtime does; an accelerator with discrete HBM copies).  The
-    DMA path's write-through mirrors and deferred-decouple puts are
-    only sound when it does; otherwise the path degrades to
-    compose-and-upload, which still never launches a mesh program."""
-    global _ZERO_COPY
-    if _ZERO_COPY is None:
-        import jax
-        probe = _aligned_empty(_STAGE_ALIGN)
-        probe[:] = 0
-        arr = jax.device_put(probe)
-        arr.block_until_ready()
-        probe[0] = 1
-        _ZERO_COPY = bool(np.asarray(arr)[0] == 1)
-    return _ZERO_COPY
+_STAGE_ALIGN = _staging.STAGE_ALIGN
+_aligned_empty = _staging.aligned_empty
+_runtime_zero_copy = _staging.runtime_zero_copy
 
 #: window capacity / bucket alignment: max wire itemsize (complex128)
 _ALIGN = 16
@@ -186,7 +157,7 @@ class _ShardTable:
     so only the borrowing origin's completion point decouples it."""
 
     __slots__ = ("arrs", "lock", "zeros", "mirrors", "alias_tok",
-                 "scratch")
+                 "pool")
 
     def __init__(self, size: int) -> None:
         self.arrs: List[Any] = [None] * size
@@ -196,7 +167,7 @@ class _ShardTable:
         self.alias_tok: List[Any] = [None] * size
         #: displaced mirrors parked for reuse, so the decoupling copy
         #: at a completion point never pays fresh-page faults
-        self.scratch: List[Optional[np.ndarray]] = [None] * size
+        self.pool = _staging.MirrorPool(max_buffers=size)
 
 
 # -- kernel builders --------------------------------------------------------
@@ -509,10 +480,7 @@ class DeviceWindow(Window):
         tab = self._tab
         mir = tab.mirrors[target]
         if mir is None:
-            mir = tab.scratch[target]
-            tab.scratch[target] = None
-            if mir is None:
-                mir = _aligned_empty(self._cap)
+            mir = tab.pool.take(self._cap)
             np.copyto(mir, np.asarray(tab.arrs[target]))
             tab.arrs[target] = jax.device_put(mir, self._devs[target])
             tab.mirrors[target] = mir
@@ -549,8 +517,7 @@ class DeviceWindow(Window):
                 tok = object()
                 tab.arrs[target] = jax.device_put(
                     src, self._devs[target])
-                if tab.mirrors[target] is not None:
-                    tab.scratch[target] = tab.mirrors[target]
+                tab.pool.park(tab.mirrors[target])
                 tab.mirrors[target] = None
                 tab.alias_tok[target] = tok
                 self._borrowed[target] = tok
@@ -851,10 +818,7 @@ class DeviceWindow(Window):
             for t, tok in self._borrowed.items():
                 if tab.alias_tok[t] is not tok:
                     continue
-                mir = tab.scratch[t]
-                tab.scratch[t] = None
-                if mir is None:
-                    mir = _aligned_empty(self._cap)
+                mir = tab.pool.take(self._cap)
                 np.copyto(mir, np.asarray(tab.arrs[t]))
                 tab.arrs[t] = jax.device_put(mir, self._devs[t])
                 tab.mirrors[t] = mir

@@ -57,6 +57,13 @@ HOT_FUNCTIONS: Dict[str, Tuple[str, ...]] = {
     "ompi_tpu/coll/pipeline.py": (
         "_pull_segment",
     ),
+    # the compiled-plan executor (DESIGN.md §22) runs once per
+    # large-message collective in steady state: span shell, the single
+    # rendezvous, integer pvar adds.  Packing, key construction and
+    # plan/executable resolution live in helpers off this path
+    "ompi_tpu/coll/plan.py": (
+        "Plan.execute",
+    ),
     # the progress sweep runs on every blocking wait iteration; the
     # checkpoint drain tick rides every 8th sweep for the rest of the
     # job once one checkpoint has been taken — neither may allocate

@@ -233,6 +233,11 @@ NAME_RECV = intern_name("recv", ("cid", "src", "tag", "seq", "bytes"))
 NAME_NBC = intern_name("nbc", ("cid", "seq"))
 NAME_MEET = intern_name("meet", ("cid", "seq", "nbytes"))
 NAME_SEG_MEET = intern_name("seg_meet", ("cid", "seq", "nbytes"))
+# one span per compiled-plan collective (DESIGN.md §22): pack, the
+# single rendezvous and unpack all inside it.  Categorized under
+# coll_segment so HIST_COLL_SEGMENT keeps a latency pulse when the
+# plan path replaces per-segment meets
+NAME_PLAN_EXEC = intern_name("plan_exec", ("cid", "nbytes", "alg$"))
 NAME_FUSED_FLUSH = intern_name("fused_flush", ("cid", "ops"))
 NAME_FUSED_PACK = intern_name("fused_pack", ("cid", "groups", "slots"))
 NAME_XLA_COMPILE = intern_name("xla_compile", ("key$",))

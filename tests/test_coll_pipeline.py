@@ -22,9 +22,11 @@ from ompi_tpu.testing import run_ranks
 jax = pytest.importorskip("jax")
 import jax.numpy as jnp  # noqa: E402
 
-# register the pipeline knobs before any _set() snapshot, so saved
-# values are real defaults (not the unregistered-knob None sentinel)
+# register the pipeline + plan knobs before any _set() snapshot, so
+# saved values are real defaults (not the unregistered-knob None
+# sentinel)
 import ompi_tpu.coll.pipeline  # noqa: E402,F401
+import ompi_tpu.coll.plan  # noqa: E402,F401
 
 
 def _put(comm, a):
@@ -44,10 +46,12 @@ def _restore(saved):
 
 
 # route everything >= 2 KiB through 4 KiB segments: several segments
-# per op, tails included, in test-sized arrays
+# per op, tails included, in test-sized arrays.  The compiled-plan
+# path is pinned OFF: this file is the per-segment pipelined tier's
+# coverage (tests/test_coll_plan.py covers the plan path)
 PIPE_ON = {"coll_pipeline_enable": True, "coll_pipeline_min_bytes": 2048,
            "coll_seg_size": 4096, "coll_pipeline_rd_max_bytes": 0,
-           "coll_hier_enable": False}
+           "coll_hier_enable": False, "coll_plan_enable": False}
 PIPE_OFF = {"coll_pipeline_enable": False, "coll_hier_enable": False}
 
 

@@ -16,6 +16,9 @@ from ompi_tpu.mca.params import registry
 from ompi_tpu.testing import run_ranks
 from ompi_tpu.tools import critpath, traceview
 
+# register the plan knob _PIPE_ON pins off before any registry.set
+import ompi_tpu.coll.plan  # noqa: E402,F401
+
 # segmented-ring pipeline knobs (the test_coll_pipeline PIPE_ON shape):
 # small segments so a 16 KiB allreduce becomes several rendezvous
 _PIPE_ON = {
@@ -24,6 +27,9 @@ _PIPE_ON = {
     "coll_seg_size": 4096,
     "coll_pipeline_rd_max_bytes": 0,
     "coll_hier_enable": False,
+    # critpath attribution is over the PER-SEGMENT rendezvous phase
+    # structure; the compiled-plan tier collapses it to one meet
+    "coll_plan_enable": False,
 }
 
 

@@ -16,7 +16,10 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _round_doc(v):
-    return {"parsed": {"value": v, "unit": "GB/s"}, "tail": ""}
+    # read_const_us marks a measurement-valid round (headline_valid):
+    # synthetic history models honest chained-methodology sweeps
+    return {"parsed": {"value": v, "unit": "GB/s",
+                       "read_const_us": 25.0}, "tail": ""}
 
 
 def _write_rounds(d, values):
@@ -90,6 +93,28 @@ def test_synthetic_regression_exits_nonzero(tmp_path):
     detail = str(tmp_path / "BENCH_DETAIL.json")
     rc = regress.run_regress(str(tmp_path), detail, dry=True)
     assert rc == 1
+
+
+def test_contaminated_rounds_excluded_from_headline_baseline(tmp_path):
+    """Rounds whose d2h read constant shows the quiet gate failed
+    (or that predate the chained methodology) fabricate busbw and must
+    not anchor the baseline: with every history round invalid, a low
+    but honestly-measured current round is NOT a regression."""
+    import os as _os
+    _write_rounds(str(tmp_path), [74.4, 74.5, 74.3, 74.4, 1.0])
+    for i, rc_us in ((1, None), (2, 98766.7), (3, 90965.2), (4, None)):
+        p = _os.path.join(str(tmp_path), f"BENCH_r{i:02d}.json")
+        doc = json.load(open(p))
+        if rc_us is None:
+            del doc["parsed"]["read_const_us"]  # pre-methodology
+        else:
+            doc["parsed"]["read_const_us"] = rc_us  # contaminated
+        json.dump(doc, open(p, "w"))
+        assert not regress.headline_valid(doc)
+    assert regress.headline_valid(_round_doc(1.0))
+    detail = str(tmp_path / "BENCH_DETAIL.json")
+    rc = regress.run_regress(str(tmp_path), detail, dry=True)
+    assert rc == 0
 
 
 def test_green_history_exits_zero_and_appends(tmp_path):

@@ -174,7 +174,7 @@ def _mpirun(np_, prog):
     # generous timeouts: these run late in the suite on a loaded
     # 1-core CI box where process launch + window setup can crawl
     return mpirun_run(np_, os.path.join("examples", prog),
-                      timeout=300, job_timeout=240)
+                      timeout=480, job_timeout=420)
 
 
 def test_shmem_ring_example_procs():
