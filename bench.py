@@ -242,6 +242,17 @@ def main() -> None:
                          "in BENCH_DETAIL.json, and FAIL (exit 1) if "
                          "the median overhead exceeds 5%% or either "
                          "truth check breaks")
+    ap.add_argument("--probe-reqtrace", action="store_true",
+                    help="Measure request-scoped tracing + the hang "
+                         "doctor: a 4-session Poisson workload on a "
+                         "2-host pool whose traceview --job waterfalls "
+                         "must match the client-paid wall within 10%%, "
+                         "a rdv_sever-wedged job the doctor must "
+                         "diagnose (absent rank + rendezvous) within "
+                         "2x obs_watchdog_ms, and the per-op req_mark "
+                         "overhead arm (5%% budget); persist under "
+                         "'probe_reqtrace' in BENCH_DETAIL.json, FAIL "
+                         "(exit 1) if any gate breaks")
     opts = ap.parse_args()
 
     detail_path = os.path.join(
@@ -303,21 +314,26 @@ def main() -> None:
             "gil_enabled": probe["gil_enabled"],
             "phase_overhead_pct": probe["phase_overhead_pct"],
             "phase_within_budget": probe["phase_within_budget"],
+            "reqtrace_overhead_pct": probe["reqtrace_overhead_pct"],
+            "reqtrace_within_budget": probe["reqtrace_within_budget"],
             "within_budget": probe["within_budget"],
         }
         line.update({k: v for k, v in notes.items() if "error" in k})
         sys.stderr.write(json.dumps(probe, indent=1) + "\n")
         print(json.dumps(line))
         if not probe["within_budget"] or \
-                not probe["phase_within_budget"]:
+                not probe["phase_within_budget"] or \
+                not probe["reqtrace_within_budget"]:
             # the acceptance contract: >5% MEDIAN tracing overhead is
             # a regression, and it fails LOUDLY, never as a footnote
             # (best-of is reported for context but never gates); the
-            # phase profiler rides the SAME budget
+            # phase profiler and per-op request tagging ride the SAME
+            # budget
             sys.stderr.write(
                 f"FAIL: median tracing overhead "
                 f"{probe['overhead_pct']}% / phase overhead "
-                f"{probe['phase_overhead_pct']}% exceeds the "
+                f"{probe['phase_overhead_pct']}% / reqtrace overhead "
+                f"{probe['reqtrace_overhead_pct']}% exceeds the "
                 f"{probe['budget_pct']}% budget\n")
             sys.exit(1)
         return
@@ -624,6 +640,44 @@ def main() -> None:
             sys.exit(1)
         return
 
+    if opts.probe_reqtrace:
+        from benchmarks.probe_reqtrace import persist, run_probe
+
+        probe = run_probe()
+        notes = persist(probe, detail_path)
+        wf = probe["waterfall"]
+        doc = probe["doctor"]
+        line = {
+            "metric": f"reqtrace waterfalls, {wf['sessions']} Poisson "
+                      f"sessions x {wf['runs_per_session']} runs on "
+                      f"{wf['hosts']} hosts + rdv_sever hang doctor",
+            "value": wf["worst_err_pct"],
+            "unit": "pct_worst_span_vs_client_wall",
+            "fidelity_ok": wf["fidelity_ok"],
+            "queue_wait_p99_us": probe["queue_wait_p99_us"],
+            "doctor_mttd_ms": probe["doctor_mttd_ms"],
+            "mttd_budget_ms": doc["mttd_budget_ms"],
+            "absent_rank_named": doc["absent_rank_named"],
+            "doctor_ok": doc["doctor_ok"],
+            "reqtrace_overhead_pct":
+                probe["overhead"]["reqtrace_overhead_pct"],
+            "within_budget": probe["within_budget"],
+        }
+        line.update({k: v for k, v in notes.items() if "error" in k})
+        sys.stderr.write(json.dumps(probe, indent=1) + "\n")
+        print(json.dumps(line))
+        if not probe["within_budget"]:
+            sys.stderr.write(
+                f"FAIL: reqtrace probe — fidelity_ok="
+                f"{wf['fidelity_ok']} (worst {wf['worst_err_pct']}%), "
+                f"doctor_ok={doc['doctor_ok']} (mttd "
+                f"{probe['doctor_mttd_ms']}ms of "
+                f"{doc['mttd_budget_ms']}ms budget), reqtrace "
+                f"overhead {probe['overhead']['reqtrace_overhead_pct']}"
+                f"% (budget {probe['overhead']['budget_pct']}%)\n")
+            sys.exit(1)
+        return
+
     if opts.quick:
         caps = {"ar": 64 * 1024, "bcast": 16 * 1024, "a2a": 4 * 1024,
                 "rsb": 16 * 1024}
@@ -742,7 +796,7 @@ def main() -> None:
                                     "probe_pipeline", "probe_ckpt",
                                     "probe_serve", "probe_obs",
                                     "probe_fleet", "probe_rma",
-                                    "probe_ctrlplane",
+                                    "probe_ctrlplane", "probe_reqtrace",
                                     "regress_trajectory")
                           if isinstance(prior, dict) and k in prior},
                        "device_us": dev, "software_us": sw,

@@ -1,0 +1,373 @@
+"""--probe-reqtrace microbench: request-scoped tracing + the hang
+doctor (DESIGN.md §23), proven against a live in-process pool:
+
+1. **Waterfall fidelity.**  A 4-session Poisson workload on a 2-host
+   fleet (real ``tpud --fleet`` agents) with ``obs_reqtrace_enable``
+   on: every attach mints a trace id, every run carries it, and the
+   pool's flight recorder accumulates the request's events.  The
+   claim: ``traceview --job`` reduces those events to a per-request
+   waterfall whose additive span sum (queue wait + run walls + resume
+   bringups) matches the CLIENT-measured run wall within
+   FIDELITY_PCT (10%%) for every request — the numbers an operator
+   reads are the numbers the client paid.
+
+2. **Hang doctor MTTD + verdict.**  With the watchdog armed
+   (``obs_watchdog_ms``) and the EWMA estimator warmed, a job is
+   deliberately wedged via the ``rdv_sever`` fault class (victim
+   rank silently stops arriving at its device-collective
+   rendezvous).  The claim: the watchdog fires within
+   2 x obs_watchdog_ms of the threshold crossing (``doctor_mttd_ms``,
+   the --regress sentry), exactly ONE capture is taken for the job,
+   and ``tools/doctor.py`` reduces it to a verdict NAMING the absent
+   rank and its rendezvous — from the persisted
+   ``<uri>.doctor.s*.json`` alone, no live pool required.
+
+3. **Tagging overhead.**  The trace_overhead methodology's reqtrace
+   rotation arm (off / on / on+phase / on+req_mark, micro-interleaved
+   in one world): request tagging at the serving plane's per-run
+   ``req_mark`` bracket cadence must stay within the same 5%% budget
+   as tracing itself.
+
+Results land in BENCH_DETAIL.json under ``probe_reqtrace``;
+``queue_wait_p99_us`` and ``doctor_mttd_ms`` feed the --regress
+sentry.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+import time
+from typing import Dict, List
+
+from benchmarks.probe_fleet import _spawn_agent, _wait
+
+CAPACITY = 4
+HOSTS = 2
+SESSIONS = 4            # concurrent Poisson submitters, part 1
+RUNS_PER_SESSION = 3
+RUN_REPS = 80           # collective-mix reps per run: a warm run's
+                        # wall must dwarf the ms-granular server wall
+                        # rounding and the client RPC round-trip, or
+                        # the fidelity comparison measures THOSE
+POISSON_MEAN_S = 0.05   # mean think time between a session's runs
+FIDELITY_PCT = 10.0     # waterfall span sum vs client wall
+
+WD_MS = 250             # obs_watchdog_ms for the doctor arm
+WD_FACTOR = 2           # stall threshold: 2x the EWMA estimate
+WARM_RUNS = 6           # pull the EWMA down past the jit-compile run
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PROG = os.path.join(REPO, "tests", "_dvm_session_prog.py")
+
+
+# -- part 1: 4-session Poisson workload -> per-request waterfalls -----------
+
+
+def _probe_waterfall(tmpdir: str) -> Dict:
+    import jax
+
+    from ompi_tpu import obs as _obs
+    from ompi_tpu.mca.params import registry
+    from ompi_tpu.tools.dvm import DVMServer, DvmClient
+    from ompi_tpu.tools.traceview import job_report
+
+    hb0 = registry.get("dvm_heartbeat_s")
+    rq0 = registry.get("obs_reqtrace_enable")
+    registry.set("dvm_heartbeat_s", 0.2)
+    registry.set("obs_reqtrace_enable", 1)
+    uri = os.path.join(tmpdir, f"reqtrace-{time.time_ns()}.uri")
+    srv = DVMServer(CAPACITY, devices=jax.devices(), uri_file=uri,
+                    hosts=HOSTS)
+    srv.start()
+    agents = {}
+    try:
+        for h in range(HOSTS):
+            agents[h] = _spawn_agent(uri, h)
+        _wait(lambda: all(b > 0 for b in srv._host_beat), 120,
+              "tpud host agents to register")
+
+        lock = threading.Lock()
+        reqs: List[Dict] = []
+        errs: List[str] = []
+
+        def submitter(idx: int) -> None:
+            rng = random.Random(1000 + idx)  # replayable arrivals
+            try:
+                with DvmClient(uri) as cli:
+                    t0 = time.perf_counter()
+                    resp = cli.attach(2, timeout=180)
+                    attach_us = int((time.perf_counter() - t0) * 1e6)
+                    sid, tid = resp["sid"], int(resp.get("tid") or 0)
+                    run_us = 0
+                    for n in range(RUNS_PER_SESSION):
+                        time.sleep(rng.expovariate(1 / POISSON_MEAN_S))
+                        t0 = time.perf_counter()
+                        r = cli.run(sid, PROG,
+                                    [f"w{idx}", str(RUN_REPS)],
+                                    timeout=300)
+                        run_us += int((time.perf_counter() - t0) * 1e6)
+                        if r["code"] != 0:
+                            raise RuntimeError(
+                                f"run rc={r['code']}: "
+                                f"{r['stderr'][-200:]}")
+                    with lock:
+                        reqs.append({"sid": sid, "tid": tid,
+                                     "attach_us": attach_us,
+                                     "client_run_us": run_us})
+                    cli.detach(sid)
+            except Exception as e:  # noqa: BLE001
+                with lock:
+                    errs.append(f"submitter {idx}: {e}")
+
+        threads = [threading.Thread(target=submitter, args=(i,))
+                   for i in range(SESSIONS)]
+        for t in threads:
+            t.start()
+        # the per-session SLI surface, observed mid-stream: rows must
+        # carry the request tid and the banded queue-wait p99 gauge
+        sli_rows = 0
+        admin = DvmClient(uri)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            m = admin.metrics()
+            rows = m.get("sessions", {})
+            sli_rows = max(sli_rows, sum(
+                1 for row in rows.values()
+                if row.get("tid") and "queue_wait_p99_us" in row))
+            if not any(t.is_alive() for t in threads):
+                break
+            time.sleep(0.1)
+        admin.close()
+        for t in threads:
+            t.join(timeout=300)
+        if errs:
+            raise RuntimeError("; ".join(errs[:3]))
+
+        # reduce the pool's flight ring exactly as traceview --job
+        # does (the dump document IS the persisted-events format)
+        dump = _obs.recorder().trace_dump()
+        waterfalls = []
+        worst_err = 0.0
+        for rq in reqs:
+            lines, info = job_report([dump], [], rq["tid"])
+            if not info:
+                waterfalls.append({"tid": rq["tid"], "found": False})
+                worst_err = 1e9
+                continue
+            span_us = info["run_us"] + info["resume_us"]
+            err = (abs(span_us - rq["client_run_us"])
+                   / max(1, rq["client_run_us"]) * 100.0)
+            worst_err = max(worst_err, err)
+            waterfalls.append({
+                "tid": rq["tid"], "found": True,
+                "runs": info["runs"],
+                "queued_us": info["queued_us"],
+                "span_sum_us": span_us,
+                "client_run_us": rq["client_run_us"],
+                "err_pct": round(err, 2),
+                "queue_wait_le_attach": bool(
+                    info["queued_us"] <= rq["attach_us"] + 50_000),
+            })
+        qwaits = sorted(w.get("queued_us", 0) for w in waterfalls)
+        fidelity_ok = bool(
+            len(waterfalls) == SESSIONS
+            and all(w["found"] for w in waterfalls)
+            and all(w["runs"] == RUNS_PER_SESSION for w in waterfalls)
+            and all(w["queue_wait_le_attach"] for w in waterfalls)
+            and worst_err <= FIDELITY_PCT)
+        return {
+            "sessions": SESSIONS,
+            "runs_per_session": RUNS_PER_SESSION,
+            "hosts": HOSTS,
+            "poisson_mean_s": POISSON_MEAN_S,
+            "waterfalls": waterfalls,
+            "worst_err_pct": round(worst_err, 2),
+            "fidelity_pct": FIDELITY_PCT,
+            "queue_wait_p99_us": qwaits[-1] if qwaits else 0,
+            "sli_rows_seen": sli_rows,
+            "events_recorded": dump.get("recorded", 0),
+            "events_dropped": dump.get("dropped", 0),
+            "fidelity_ok": fidelity_ok,
+        }
+    finally:
+        for p in agents.values():
+            if p.poll() is None:
+                p.kill()
+        srv.stop()
+        registry.set("dvm_heartbeat_s",
+                     "2.0" if hb0 is None else hb0)
+        registry.set("obs_reqtrace_enable",
+                     "0" if rq0 is None else rq0)
+
+
+# -- part 2: wedge a job, let the doctor name the absent rank ---------------
+
+
+def _probe_doctor(tmpdir: str) -> Dict:
+    import jax
+
+    from ompi_tpu.mca.params import registry
+    from ompi_tpu.tools import doctor as doctor_tool
+    from ompi_tpu.tools.dvm import DVMServer, DvmClient
+
+    saved = {k: registry.get(k) for k in
+             ("obs_watchdog_ms", "obs_watchdog_factor",
+              "obs_reqtrace_enable", "ft_inject_plan",
+              "ft_inject_victim_rank", "ft_inject_seed",
+              "coll_device_rendezvous_timeout",
+              "coll_device_rendezvous_poll")}
+    registry.set("obs_watchdog_ms", WD_MS)   # before start(): the
+    registry.set("obs_watchdog_factor", WD_FACTOR)  # thread arms in _setup
+    registry.set("obs_reqtrace_enable", 1)
+    registry.set("coll_device_rendezvous_poll", 0.05)
+    uri = os.path.join(tmpdir, f"doctor-{time.time_ns()}.uri")
+    srv = DVMServer(2, devices=jax.devices(), uri_file=uri)
+    srv.start()
+    try:
+        # warm the EWMA estimator past the jit-compile first run so
+        # the stall threshold reflects steady-state wall time
+        with DvmClient(uri) as cli:
+            wsid = cli.attach(2, timeout=180)["sid"]
+            for n in range(WARM_RUNS):
+                r = cli.run(wsid, PROG, ["warm"], timeout=300)
+                if r["code"] != 0:
+                    raise RuntimeError(f"warm rc={r['code']}: "
+                                       f"{r['stderr'][-200:]}")
+            cli.detach(wsid)
+        limit_s = srv.est_wall_us * WD_FACTOR / 1e6
+        # the wedge must outlive the watchdog but not the probe: give
+        # the rendezvous stall raise a horizon safely past detection
+        registry.set("coll_device_rendezvous_timeout",
+                     max(10.0, limit_s * 4 + 5 * WD_MS / 1000.0))
+        # arm the sever AFTER warm-up: the wedge session's fresh rank
+        # states pick the injector up at world bring-up
+        registry.set("ft_inject_seed", 7)
+        registry.set("ft_inject_victim_rank", "1")
+        registry.set("ft_inject_plan", "rdv_sever:1")
+
+        res: Dict = {}
+        cli = DvmClient(uri)
+        resp = cli.attach(2, timeout=180)
+        sid, tid = resp["sid"], int(resp.get("tid") or 0)
+
+        def wedged() -> None:
+            try:
+                res.update(cli.run(sid, PROG, ["wedge"], timeout=300))
+            except Exception as e:  # noqa: BLE001
+                res["error"] = str(e)
+
+        th = threading.Thread(target=wedged)
+        t0 = time.perf_counter()
+        th.start()
+        _wait(lambda: len(srv.doctor_reports) >= 1,
+              limit_s * 3 + 60, "the watchdog to capture the stall")
+        detect_wall_ms = (time.perf_counter() - t0) * 1e3
+        th.join(timeout=300)  # the rendezvous stall raise unwedges it
+        cli.detach(sid)
+        cli.close()
+        registry.set("ft_inject_plan", "")
+
+        doc = srv.doctor_reports[0]
+        # the verdict, reduced from the PERSISTED capture (the 3am
+        # path: the pool may be gone) by the real tool
+        docs = doctor_tool.load_captures(uri)
+        verdict = doctor_tool.verdict(docs[0]) if docs else []
+        vtext = "\n".join(verdict)
+        absent_named = any(
+            1 in [rv.get("group", [""] * len(rv.get("absent", [])))[s]
+                  for s in rv.get("absent", [])
+                  if s < len(rv.get("group", []))]
+            for rv in doc.get("rendezvous", []))
+        mttd_ms = float(doc.get("mttd_ms", 1e9))
+        ok = bool(
+            len(srv.doctor_reports) == 1       # one capture per job
+            and doc.get("sid") == sid and doc.get("tid") == tid
+            and absent_named                    # rank 1 absent, named
+            and "ABSENT" in vtext and "rendezvous" in vtext
+            and len(doc.get("stacks") or {}) >= 1
+            and 0 <= mttd_ms <= 2 * WD_MS       # the MTTD contract
+            and res.get("code", 0) != 0)        # the wedge DID fail
+        return {
+            "watchdog_ms": WD_MS,
+            "watchdog_factor": WD_FACTOR,
+            "est_wall_ms": round(srv.est_wall_us / 1000.0, 3),
+            "wedged_rc": res.get("code"),
+            "captures": len(srv.doctor_reports),
+            "doctor_mttd_ms": round(mttd_ms, 3),
+            "mttd_budget_ms": 2 * WD_MS,
+            "detect_wall_ms": round(detect_wall_ms, 3),
+            "absent_rank_named": absent_named,
+            "stacks_captured": len(doc.get("stacks") or {}),
+            "rendezvous_captured": len(doc.get("rendezvous") or []),
+            "verdict_head": verdict[:6],
+            "doctor_ok": ok,
+        }
+    finally:
+        srv.stop()
+        # registry.get returns None for never-resolved vars; restore
+        # those to their documented defaults (the test_obs idiom)
+        defaults = {"obs_watchdog_ms": "0", "obs_watchdog_factor": "4",
+                    "obs_reqtrace_enable": "0", "ft_inject_plan": "",
+                    "ft_inject_victim_rank": "1", "ft_inject_seed": "0",
+                    "coll_device_rendezvous_timeout": "300",
+                    "coll_device_rendezvous_poll": "0.25"}
+        for k, v in saved.items():
+            registry.set(k, defaults[k] if v is None else v)
+
+
+def run_probe() -> Dict:
+    import shutil
+    import tempfile
+
+    tmpdir = tempfile.mkdtemp(prefix="probe_reqtrace_")
+    try:
+        waterfall = _probe_waterfall(tmpdir)
+        hangdoc = _probe_doctor(tmpdir)
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    # part 3: the tagging-overhead arm rides the trace_overhead
+    # methodology (same interleaved-block world, same budget)
+    from benchmarks.trace_overhead import run_probe as _trace_probe
+    tp = _trace_probe()
+    overhead = {
+        "off_us_median": tp["off_us_median"],
+        "reqtrace_us_median": tp["reqtrace_us_median"],
+        "reqtrace_overhead_pct": tp["reqtrace_overhead_pct"],
+        "budget_pct": tp["budget_pct"],
+        "reqtrace_within_budget": tp["reqtrace_within_budget"],
+    }
+    return {
+        "waterfall": waterfall,
+        "doctor": hangdoc,
+        "overhead": overhead,
+        "queue_wait_p99_us": waterfall["queue_wait_p99_us"],
+        "doctor_mttd_ms": hangdoc["doctor_mttd_ms"],
+        "within_budget": bool(waterfall["fidelity_ok"]
+                              and hangdoc["doctor_ok"]
+                              and overhead["reqtrace_within_budget"]),
+    }
+
+
+def persist(probe: Dict, detail_path: str) -> Dict:
+    """Merge under 'probe_reqtrace' in BENCH_DETAIL.json, preserving
+    every other section (the probe_dispatch/probe_fleet pattern)."""
+    notes: Dict = {}
+    try:
+        with open(detail_path) as fh:
+            detail = json.load(fh)
+        if not isinstance(detail, dict):
+            detail = {}
+    except (OSError, ValueError):
+        detail = {}
+    detail["probe_reqtrace"] = probe
+    try:
+        tmp = f"{detail_path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as fh:
+            json.dump(detail, fh, indent=1)
+        os.replace(tmp, detail_path)
+    except OSError as e:
+        notes["detail_error"] = str(e)[:120]
+    return notes

@@ -83,6 +83,13 @@ TOLERANCES: Dict[str, Tuple[str, float]] = {
     # stuck on the default horizon, a respawn replaying whole
     # journals) lands in multiple seconds.
     "host_kill_mttr_ms": ("lower", 1500.0),
+    # reqtrace sentries (ISSUE 18): queue-wait p99 of the probe's
+    # 4-session Poisson workload (µs — admission scheduling drift
+    # shows up here before goodput moves) and the hang doctor's
+    # threshold-to-capture latency (ms — contractually within
+    # 2 x obs_watchdog_ms; the band absorbs watchdog-tick phase)
+    "queue_wait_p99_us": ("lower", 100000.0),
+    "doctor_mttd_ms": ("lower", 200.0),
 }
 
 
@@ -208,6 +215,11 @@ def _detail_metrics(detail: dict) -> Dict[str, float]:
     v = fl.get("host_kill_mttr_ms") if isinstance(fl, dict) else None
     if isinstance(v, (int, float)) and v > 0:
         out["host_kill_mttr_ms"] = float(v)
+    rp = detail.get("probe_reqtrace") or {}
+    for key in ("queue_wait_p99_us", "doctor_mttd_ms"):
+        v = rp.get(key)
+        if isinstance(v, (int, float)) and v > 0:
+            out[key] = float(v)
     return out
 
 

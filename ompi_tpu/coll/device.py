@@ -284,21 +284,59 @@ def _coll_delay_injector(state):
     return inj
 
 
+def _coll_sever_injector(state):
+    """ft_inject 'rdv_sever' (the hang-doctor chaos class): a one-shot
+    deterministic wedge — the victim rank stops short of depositing at
+    its Nth rendezvous, stranding every peer in _wait_for until the
+    session is poisoned (cached per rank-state; False = disarmed)."""
+    inj = state.__dict__.get("_coll_sever_inj")
+    if inj is None:
+        from ompi_tpu import ft_inject
+        inj = ft_inject.rdv_sever_injector(
+            state.rank, getattr(state, "size", None)) or False
+        state._coll_sever_inj = inj
+    return inj
+
+
+def _sever_hold(abort_check) -> None:
+    """The wedge itself: hold THIS rank before it deposits, in small
+    abort-checked sleeps, so the hang doctor finds a live stall (peers
+    parked at the rendezvous, this rank absent) and the session poison
+    still unwinds everything cleanly — abort_check raises once the
+    pool declares the job dead.  Bounded by the rendezvous stall
+    timeout so a doctor-less run errors instead of hanging forever."""
+    deadline = time.monotonic() + _rv_timeout_var.value
+    while True:
+        if abort_check:
+            abort_check()
+        if time.monotonic() > deadline:
+            raise RuntimeError(
+                "ft_inject rdv_sever: hold outlived the rendezvous "
+                "stall timeout with no abort")
+        time.sleep(0.02)
+
+
 # -- phase profiler helpers (docs/DESIGN.md §18) ----------------------------
 # A "ph ctx" is the tuple (tracer, cid, seq, nbytes) a traced op builds
-# ONCE (only when tracer.phase is armed — the zero-cost-when-off gate
-# everywhere else is a single attribute check) and threads through the
-# rendezvous so the waits, the dispatch, and the fenced device execute
-# decompose the op span into named phases.  Each phase span samples
-# independently through the 'phase' category, so the exactness
-# invariant (kept + sampled_out == seen) holds per category.
+# ONCE — only when tracer.phase is armed (the zero-cost-when-off gate
+# everywhere else is a single attribute check) AND the op samples IN
+# through the phase category (Tracer.gate_sampled at the build site:
+# armed-but-sampled-out costs the same two list ops as an unsampled
+# dispatch span and takes the exact ph=None path) — and threads
+# through the rendezvous so the waits, the dispatch, and the fenced
+# device execute decompose the op span into named phases.  The GATE
+# carries the sampling bookkeeping; a non-None ctx means every
+# sub-span records, so one op's decomposition is always coherent
+# (never a dispatch span whose execute sampled out) and the exactness
+# invariant (kept + sampled_out == seen) holds per category at op
+# granularity.
 
 def _ph_rdv_start(ph):
-    """Open a rendezvous-wait phase span (0 when the ctx is absent or
-    the phase category sampled this one out)."""
+    """Open a rendezvous-wait phase span (0 when the ctx is absent —
+    a present ctx already sampled in at build time)."""
     if ph is None:
         return 0
-    return ph[0].start_sampled(_CAT_PHASE)
+    return ph[0].start()
 
 
 def _ph_rdv_end(ph, t0) -> None:
@@ -313,19 +351,17 @@ def _ph_rdv_end(ph, t0) -> None:
 def _phase_fn(fn, shards, ph):
     """Run a meeting's computation with dispatch/execute phases
     recorded against the triggering rank's tracer.  The execute fence
-    (block_until_ready) runs ONLY when that phase span was sampled in
-    — an unsampled op keeps XLA's async dispatch untouched."""
+    (block_until_ready) runs ONLY for a sampled-in op (ph non-None)
+    — a sampled-out op keeps XLA's async dispatch untouched."""
     if ph is None:
         return fn(shards)
     tr = ph[0]
-    t0 = tr.start_sampled(_CAT_PHASE)
+    t0 = tr.start()
     res = fn(shards)
-    if t0:
-        tr.end(t0, _NAME_PH_DISPATCH, _CAT_PHASE, ph[1], ph[2], ph[3])
-    t1 = tr.start_sampled(_CAT_PHASE)
-    if t1:
-        _block_ready(res)
-        tr.end(t1, _NAME_PH_EXECUTE, _CAT_PHASE, ph[1], ph[2], ph[3])
+    tr.end(t0, _NAME_PH_DISPATCH, _CAT_PHASE, ph[1], ph[2], ph[3])
+    t1 = tr.start()
+    _block_ready(res)
+    tr.end(t1, _NAME_PH_EXECUTE, _CAT_PHASE, ph[1], ph[2], ph[3])
     return res
 
 
@@ -545,6 +581,30 @@ class Rendezvous:
         gen = self.begin(rank, value, fn, abort_check, progress, ph=ph)
         return self.finish(rank, gen, abort_check, progress, ph=ph)
 
+    def snapshot(self) -> dict:
+        """Doctor-facing state capture (DESIGN.md §23): which ranks
+        have deposited for the current generation and which are
+        absent.  Cold path (fires on a watchdog stall); tries the
+        meeting lock briefly and falls back to a lock-free read —
+        under the GIL a stale list read is safe, and a wedged meeting
+        is by definition not changing."""
+        got = self.cv.acquire(timeout=0.2)
+        try:
+            arrived = [r for r in range(self.size)
+                       if self.slots[r] is not self._SENTINEL]
+            return {
+                "size": self.size,
+                "gen": self.gen,
+                "count": self.count,
+                "arrived": arrived,
+                "absent": [r for r in range(self.size)
+                           if self.slots[r] is self._SENTINEL],
+                "pending_gens": sorted(self.results.keys()),
+            }
+        finally:
+            if got:
+                self.cv.release()
+
 
 def meet(comm, value, fn, abort_check) -> Any:
     """The one rendezvous entry point for offloaded collectives:
@@ -558,6 +618,9 @@ def meet(comm, value, fn, abort_check) -> Any:
         d = inj.maybe_delay()
         if d:
             time.sleep(d)
+    sv = _coll_sever_injector(comm.state)
+    if sv and sv.should_sever():
+        _sever_hold(abort_check)
     nbytes = int(getattr(value, "nbytes", 0) or 0)
     count_offload(comm, nbytes)
     tr = comm.state.tracer
@@ -581,8 +644,17 @@ def meet(comm, value, fn, abort_check) -> Any:
     else:
         t0 = tr.start_sampled(_CAT_DISP)
     # phase ctx (docs/DESIGN.md §18): one tuple per op ONLY when the
-    # profiler is armed — off, this is a single attribute check
-    ph = (tr, comm.cid, seq, nbytes) if tr.phase else None
+    # profiler is armed AND this op samples in — off, a single
+    # attribute check; armed-but-sampled-out, the same inlined
+    # two-list-op skip as the dispatch span above
+    ph = None
+    if tr.phase:
+        c = ctr[_CAT_PHASE]
+        if c:
+            ctr[_CAT_PHASE] = c - 1
+            tr._skipped[_CAT_PHASE] += 1
+        elif tr.gate_sampled(_CAT_PHASE):
+            ph = (tr, comm.cid, seq, nbytes)
     out = rv.run(comm.rank, value, fn, abort_check,
                  progress=comm.state.progress, ph=ph)
     if t0:
@@ -605,6 +677,9 @@ def meet_begin(comm, value, fn, abort_check):
         d = inj.maybe_delay()
         if d:
             time.sleep(d)
+    sv = _coll_sever_injector(comm.state)
+    if sv and sv.should_sever():
+        _sever_hold(abort_check)
     nbytes = int(getattr(value, "nbytes", 0) or 0)
     count_offload(comm, nbytes)
     tr = comm.state.tracer
@@ -612,7 +687,7 @@ def meet_begin(comm, value, fn, abort_check):
     ph = None
     if tr is not None:
         t0 = tr.start_sampled(_CAT_SEG)
-        if tr.phase:
+        if tr.phase and tr.gate_sampled(_CAT_PHASE):
             # the final seq is assigned at meet_finish; the CURRENT
             # _dev_seq is close enough for critpath's containment-
             # based attribution (exact keys ride the seg_meet span)

@@ -320,15 +320,16 @@ def _pull_segment(it, ph):
     """Pack stage: pull one (value, fn) job from the segment
     generator.  The slice+pad work happens inside next(), so the span
     around it IS the host-pack phase.  Hot (once per segment, per
-    rank): audited by hotpath_audit.  The exhausted-iterator probe
-    records one ~0 span, keeping kept+dropped==seen exact."""
+    rank): audited by hotpath_audit.  A non-None ctx sampled in at
+    build time (Tracer.gate_sampled), so every segment of a kept op
+    records — the whole-op decomposition stays coherent.  The
+    exhausted-iterator probe records one ~0 span."""
     if ph is None:
         return next(it, None)
     tr = ph[0]
-    t0 = tr.start_sampled(_CAT_PHASE)
+    t0 = tr.start()
     job = next(it, None)
-    if t0:
-        tr.end(t0, _NAME_PH_PACK, _CAT_PHASE, ph[1], ph[2], ph[3])
+    tr.end(t0, _NAME_PH_PACK, _CAT_PHASE, ph[1], ph[2], ph[3])
     return job
 
 
@@ -340,7 +341,9 @@ def _run_pipelined(module, comm, jobs) -> List[Any]:
     depth = max(1, _depth_var.value)
     check = module._abort_check(comm)
     tr = comm.state.tracer
-    ph = (tr, comm.cid, 0, 0) if tr is not None and tr.phase else None
+    ph = ((tr, comm.cid, 0, 0)
+          if tr is not None and tr.phase and tr.gate_sampled(_CAT_PHASE)
+          else None)
     it = iter(jobs)
     handles: deque = deque()
     outs: List[Any] = []

@@ -187,7 +187,7 @@ class _Handle:
     """One in-flight (begun, not yet committed) filesystem epoch."""
 
     __slots__ = ("epoch", "comm", "file", "dir", "my_off", "residue",
-                 "shards", "queue", "inj", "failed", "nbytes")
+                 "shards", "queue", "inj", "failed", "nbytes", "t0_ns")
 
     def __init__(self) -> None:
         self.epoch = -1
@@ -201,6 +201,7 @@ class _Handle:
         self.inj = None
         self.failed: Optional[str] = None
         self.nbytes = 0
+        self.t0_ns = 0  # enqueue time: the req_drain window anchor
 
 
 class Engine:
@@ -246,6 +247,15 @@ class Engine:
         if done:
             _pv_ticks.add(1)
             _pv_shards.add(done)
+            if not h.queue and h.failed is None and h.t0_ns:
+                # the epoch's async drain just finished: one req_drain
+                # flight event per epoch (not per tick) so a request
+                # waterfall (DESIGN.md §23) can place the drain-stall
+                # window against the run it shadowed
+                _obs.record_event(
+                    _obs.EV_REQ_DRAIN, _obs.current_band(), h.epoch,
+                    (time.perf_counter_ns() - h.t0_ns) // 1000)
+                h.t0_ns = 0
         return done
 
     def _write_shard(self, h: _Handle, sh: _shard.Shard,
@@ -327,6 +337,7 @@ class Engine:
             for sh in p.shards:
                 h.queue.append((sh, o))
                 o += sh.nbytes
+            h.t0_ns = time.perf_counter_ns()
         self.pending = h
         return h.epoch
 

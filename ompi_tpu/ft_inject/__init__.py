@@ -45,11 +45,13 @@ _plan_var = registry.register(
          "delay, dup, reorder, corrupt, sever, daemon_kill, "
          "oob_sever, kv_partition, rank_kill, io_stall, io_partial, "
          "io_enospc, dvm_disconnect, rma_delay, kv_kill, dvm_kill, "
-         "host_kill (for the kill classes the number is the armed OP "
-         "COUNT the control-plane process dies at, not a rate; "
-         "host_kill severs ft_inject_victim_host's whole failure "
-         "domain — daemon plus every resident rank).  Empty = "
-         "framework disabled")
+         "host_kill, rdv_sever (for the kill classes the number is "
+         "the armed OP COUNT the control-plane process dies at, not "
+         "a rate; host_kill severs ft_inject_victim_host's whole "
+         "failure domain — daemon plus every resident rank; "
+         "rdv_sever wedges ft_inject_victim_rank at its Nth "
+         "device-collective rendezvous — the hang-doctor test "
+         "target).  Empty = framework disabled")
 _rate_var = registry.register(
     "ft", "inject", "rate", 0.02, float,
     help="Default per-event injection probability for plan entries "
@@ -121,6 +123,13 @@ KILL_CLASSES = ("kv_kill", "dvm_kill")
 # atomic failure-domain record — the fleet-level analog of kv_kill/
 # dvm_kill.  Consumed by tools/dvm (DVMServer.kill_host).
 HOST_CLASSES = ("host_kill",)
+# rendezvous sever: the victim rank silently stops arriving at its
+# Nth device-collective rendezvous (the plan number is the armed meet
+# count, deterministic like the kill classes) — every peer wedges in
+# Rendezvous._wait_for, which is exactly the stall the hang doctor
+# (DESIGN.md §23) must diagnose: "rank R absent from cid C gen G".
+# The hold is abort-aware, so the doctor's poison unwinds it cleanly.
+RDV_CLASSES = ("rdv_sever",)
 
 
 def plan() -> Dict[str, float]:
@@ -232,6 +241,51 @@ def coll_injector(rank: int) -> Optional[CollInjector]:
     if not p:
         return None
     return CollInjector("coll", rank, p)
+
+
+class RdvSeverInjector:
+    """One-shot deterministic rendezvous sever: ``should_sever()``
+    counts the victim rank's meets and returns True exactly once, at
+    the armed count — no RNG, so the wedge replays bit-for-bit (the
+    KillInjector model, applied to a rank instead of a process).  The
+    caller then holds the rank BEFORE it deposits, in small
+    abort-checked sleeps, until the session is poisoned — peers wedge
+    at the rendezvous and the hang doctor gets a live crime scene."""
+
+    def __init__(self, rank: int, after_ops: float) -> None:
+        self.rank = rank
+        # a rate below 1 (including the bare-class default) means "no
+        # explicit count": arm a post-bring-up default
+        self.after_ops = int(after_ops) if after_ops >= 1 else 8
+        self._count = 0
+        self._fired = False
+
+    def should_sever(self) -> bool:
+        if self._fired:
+            return False
+        self._count += 1
+        if self._count < self.after_ops:
+            return False
+        self._fired = True
+        from ompi_tpu import obs as _obs
+        from ompi_tpu import trace
+        tr = trace.current_tracer()
+        if tr is not None:
+            tr.instant("ft_inject", "fault", cls="rdv_sever",
+                       scope="coll", rank=self.rank)
+        _obs.record_event(_obs.EV_FT_INJECT,
+                          _obs.intern("rdv_sever"),
+                          _obs.intern("coll"), rank=self.rank)
+        return True
+
+
+def rdv_sever_injector(rank: int,
+                       size: Optional[int] = None
+                       ) -> Optional[RdvSeverInjector]:
+    p = plan()
+    if "rdv_sever" not in p or rank not in victim_ranks(size):
+        return None
+    return RdvSeverInjector(rank, p["rdv_sever"])
 
 
 class RmaInjector(_Scoped):

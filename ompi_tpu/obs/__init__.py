@@ -69,6 +69,31 @@ _ring_var = registry.register(
 _prom_var = registry.register(
     "obs", "", "prometheus", True, bool,
     help="Include Prometheus text exposition in metrics RPC replies")
+_wd_ms_var = registry.register(
+    "obs", "", "watchdog_ms", 0, int,
+    help="Progress-stall watchdog tick interval for the DVM serving "
+         "plane, milliseconds (0 = off, the default).  A running job "
+         "whose wall time exceeds the pool's EWMA estimate by "
+         "obs_watchdog_factor fires a wd_stall flight event and a "
+         "doctor capture (stacks + rendezvous/fence/ULFM state) "
+         "within ~2 ticks")
+_wd_factor_var = registry.register(
+    "obs", "", "watchdog_factor", 4, int,
+    help="Stall threshold as a multiple of the pool's EWMA wall "
+         "estimate (§17): a job running longer than factor x estimate "
+         "is declared stalled.  With the FleetController on, the "
+         "published per-tick tolerance (widened under backlog) takes "
+         "precedence, this knob seeding its floor")
+
+
+def watchdog_ms() -> int:
+    return max(0, int(_wd_ms_var.value))
+
+
+def watchdog_factor_pct() -> int:
+    """The stall threshold in percent of the EWMA wall estimate
+    (knob x100; the FleetController publishes an adaptive override)."""
+    return max(100, int(_wd_factor_var.value) * 100)
 
 
 def prometheus_enabled() -> bool:
@@ -165,6 +190,76 @@ def current_band() -> int:
     return st.cid_band if st is not None else 0
 
 
+class ScopedHist:
+    """Per-session log2 latency histogram for serve-plane SLI gauges
+    (queue-wait p99 and friends): one global histogram plus a lazy
+    per-band shadow keyed by the same cid-band the ScopedPvars use.
+    ``add_us`` is a bit_length bucket index and two integer bumps;
+    band rows allocate under a lock the FIRST time a session appears
+    — adds ride the serve control path (attach/run bookkeeping),
+    never a traced rank hot path, so the lazy allocation is fine.
+    Buckets are the trace module's fixed log2 bounds, so
+    ``hist_percentiles`` reads these directly."""
+
+    __slots__ = ("name", "total", "bands", "_lock")
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self.total = [0] * _trace.N_BUCKETS
+        self.bands: Dict[int, List[int]] = {}
+        self._lock = threading.Lock()
+
+    def add_us(self, us: int, band: int = 0) -> None:
+        b = int(us).bit_length()
+        if b >= _trace.N_BUCKETS:
+            b = _trace.N_BUCKETS - 1
+        self.total[b] += 1
+        band &= _BAND_MASK
+        h = self.bands.get(band)
+        if h is None:
+            with self._lock:
+                h = self.bands.setdefault(band,
+                                          [0] * _trace.N_BUCKETS)
+        h[b] += 1
+
+    def band_hist(self, band: int) -> Optional[List[int]]:
+        return self.bands.get(band & _BAND_MASK)
+
+    def band_percentile(self, band: int, tag: str = "p99") -> int:
+        h = self.band_hist(band)
+        if h is None:
+            return 0
+        return int(hist_percentiles(h)[tag])
+
+
+_scoped_hists: Dict[str, ScopedHist] = {}
+
+
+def scoped_hist(name: str) -> ScopedHist:
+    """Idempotent factory (the scoped_pvar model): one ScopedHist per
+    full name, cached for the life of the process so bands never
+    reset behind a reader's back."""
+    with _scoped_lock:
+        sh = _scoped_hists.get(name)
+        if sh is None:
+            sh = ScopedHist(name)
+            _scoped_hists[name] = sh
+        return sh
+
+
+def scoped_hist_snapshot() -> Dict[str, Dict[str, Any]]:
+    """{name: {"total": [...], "bands": {band: [...]}}} — the SLI
+    attribution view the metrics RPC exports next to ``scoped``."""
+    with _scoped_lock:
+        hists = list(_scoped_hists.values())
+    out: Dict[str, Dict[str, Any]] = {}
+    for sh in hists:
+        out[sh.name] = {"total": list(sh.total),
+                        "bands": {str(b): list(h) for b, h in
+                                  sh.bands.items() if sum(h)}}
+    return out
+
+
 # -- flight recorder --------------------------------------------------------
 
 EV_ULFM_DETECT = 0
@@ -192,6 +287,15 @@ EV_DVM_REHYDRATE = 21
 EV_DVM_REPLAY = 22
 EV_HOST_LOST = 23
 EV_HOST_RESPAWN = 24
+# request-scoped tracing + hang doctor (DESIGN.md §23): the ``tid``
+# argument is the 63-bit request trace id minted at DvmClient
+# attach/run — traceview --job stitches these into one waterfall
+EV_REQ_ATTACH = 25
+EV_REQ_RUN = 26
+EV_REQ_PARK = 27
+EV_REQ_RESUME = 28
+EV_WD_STALL = 29
+EV_REQ_DRAIN = 30
 
 EVENT_NAMES = (
     "ulfm_detect", "ulfm_revoke", "ulfm_agree", "ulfm_shrink",
@@ -200,6 +304,8 @@ EVENT_NAMES = (
     "dvm_detach", "dvm_halt", "dvm_run", "dvm_preempt", "dvm_shed",
     "dvm_resize", "dvm_quota", "ctrl_adjust", "kv_failover",
     "dvm_rehydrate", "dvm_replay", "host_lost", "host_respawn",
+    "req_attach", "req_run", "req_park", "req_resume", "wd_stall",
+    "req_drain",
 )
 
 # Per-type argument field names (positional a0..a3); a trailing "$"
@@ -231,6 +337,12 @@ EVENT_FIELDS = (
     ("sid", "code"),                         # dvm_replay
     ("host", "ranks", "sessions"),           # host_lost
     ("host", "sessions", "ms"),              # host_respawn
+    ("sid", "tid", "queued_us"),             # req_attach
+    ("sid", "tid", "span", "wall_ms"),       # req_run
+    ("sid", "tid"),                          # req_park
+    ("sid", "tid", "us"),                    # req_resume
+    ("sid", "tid", "run_ms", "est_ms"),      # wd_stall
+    ("band", "epoch", "us"),                 # req_drain
 )
 
 # interned strings for event args (reason/cls/scope): the ring holds
@@ -693,6 +805,7 @@ def local_metrics(events: int = 16, tracer=None,
         "hists": hists,
         "percentiles": pcts,
         "scoped": scoped_snapshot(),
+        "scoped_hists": scoped_hist_snapshot(),
         "events": recorder().snapshot(events),
     }
 
@@ -705,23 +818,49 @@ def prometheus_text(metrics: Dict[str, Any],
                     prefix: str = "ompi_tpu") -> str:
     """Prometheus text exposition format (version 0.0.4) rendered from
     a metrics document: scalar pvars as counters/gauges, scoped
-    counters with a ``session`` label per band, percentile gauges as a
+    counters as ONE grouped family each — the global sum plus a
+    ``session`` label per cid band (0.0.4 requires all samples of a
+    family in one group, so scoped names are skipped in the plain
+    pvar sweep and rendered here) — per-session SLI histograms as
+    labeled percentile gauges, and the latency percentile gauges as a
     labeled ``latency_us`` family."""
     classes: Dict[str, str] = {}
     for p in registry.pvars_in_registration_order():
         classes[p.full_name] = p.var_class
+    scoped = metrics.get("scoped", {})
     lines: List[str] = []
     for name, val in metrics.get("pvars", {}).items():
+        if name in scoped:
+            continue  # rendered grouped with its session series below
         if isinstance(val, bool) or not isinstance(val, (int, float)):
             continue
         typ = "counter" if classes.get(name) == "counter" else "gauge"
         lines.append(f"# TYPE {prefix}_{name} {typ}")
         lines.append(f"{prefix}_{name} {val}")
-    for sname, sv in metrics.get("scoped", {}).items():
+    for sname, sv in scoped.items():
+        typ = "counter" if classes.get(sname, "counter") == "counter" \
+            else "gauge"
+        lines.append(f"# TYPE {prefix}_{sname} {typ}")
+        g = sv.get("global")
+        if isinstance(g, (int, float)) and not isinstance(g, bool):
+            lines.append(f"{prefix}_{sname} {g}")
         for band, v in sorted(sv.get("bands", {}).items(),
                               key=lambda kv: int(kv[0])):
             lines.append(f'{prefix}_{sname}'
                          f'{{session="{_prom_escape(str(band))}"}} {v}')
+    for hname, hv in sorted(metrics.get("scoped_hists", {}).items()):
+        lines.append(f"# TYPE {prefix}_{hname} gauge")
+        tot = hist_percentiles(hv.get("total") or [])
+        for tag in PCT_TAGS:
+            lines.append(f'{prefix}_{hname}{{q="{tag}"}} '
+                         f'{tot.get(tag, 0.0)}')
+        for band, h in sorted(hv.get("bands", {}).items(),
+                              key=lambda kv: int(kv[0])):
+            p = hist_percentiles(h)
+            for tag in PCT_TAGS:
+                lines.append(f'{prefix}_{hname}'
+                             f'{{session="{_prom_escape(str(band))}",'
+                             f'q="{tag}"}} {p.get(tag, 0.0)}')
     pct = metrics.get("percentiles", {})
     if pct:
         lines.append(f"# TYPE {prefix}_latency_us gauge")

@@ -1,0 +1,80 @@
+"""Request-scoped trace context for the serving plane (DESIGN.md §23).
+
+OpenTelemetry-style context propagation rebuilt on the surfaces the
+repo already has: ``mint()`` produces a (trace id, parent span) pair
+at ``DvmClient.attach``/``run``; the ids ride the length-framed DVM
+RPC as two plain ints, land on the ``_Session`` server-side, are
+stamped into each resident rank's Tracer as a per-job tag
+(``Tracer.req_mark`` — two integer stores, the §16 cid-band cost
+model), published into the session's KV namespace so remote-host
+components can correlate, and annotate the admission / park / resume
+/ shed / preempt flight events.  ``tools/traceview.py --job <tid>``
+stitches all of it into one per-request waterfall.
+
+Ids are 63-bit positive integers (they must fit the flight recorder's
+and the tracer's signed ``array('q')`` columns) built from wall
+nanoseconds, the pid, and a process-monotonic counter — unique across
+the client fleet without an RNG, and meaningless to guess, which is
+all a correlation key needs.  Span ids are small per-process
+counters: a (tid, span) pair names one causal step under a request.
+
+Everything is gated on ``obs_reqtrace_enable`` (off by default): when
+off, ``mint()`` is never called, no RPC field is added, and the rank
+hot path keeps its two-int-store worst case only for jobs that carry
+a context.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import time
+from typing import Tuple
+
+from ompi_tpu.mca.params import registry
+
+_enable_var = registry.register(
+    "obs", "reqtrace", "enable", False, bool,
+    help="Mint a request trace context (trace id + parent span) at "
+         "DvmClient attach/run and propagate it end-to-end: RPC "
+         "fields, admission/park/resume flight events, per-job rank "
+         "tracer tags, KV namespace, ckpt drain events.  Off = no "
+         "context is minted and runs carry tag 0")
+
+_MASK63 = (1 << 63) - 1
+
+_span_n = itertools.count(1)
+
+
+def enabled() -> bool:
+    return bool(_enable_var.value)
+
+
+def mint() -> Tuple[int, int]:
+    """A fresh (trace id, parent span) pair.  The tid folds wall
+    nanoseconds, the pid and a process counter into 63 bits; the span
+    is this process's next span id.  Cold path (once per attach/run),
+    so two clock-free int reads plus one time_ns is fine."""
+    n = next(_span_n)
+    tid = ((time.time_ns() & 0xFFFFFFFFFF) << 23) \
+        ^ ((os.getpid() & 0x7FFFFF) << 16) ^ (n & 0xFFFF)
+    tid &= _MASK63
+    if tid == 0:
+        tid = 1  # 0 means "no context" everywhere downstream
+    return tid, n
+
+
+def next_span() -> int:
+    """The next span id under an existing trace (one per run RPC)."""
+    return next(_span_n)
+
+
+def fmt(tid: int) -> str:
+    """Canonical display form of a trace id (hex, the --job syntax)."""
+    return f"0x{tid:x}"
+
+
+def parse(text: str) -> int:
+    """Parse a --job argument: hex with 0x prefix, or decimal."""
+    s = str(text).strip()
+    return int(s, 16) if s.lower().startswith("0x") else int(s)

@@ -398,6 +398,26 @@ class KVServer:
                 self.fence_done.pop(self._fence_done_order.pop(0),
                                     None)
 
+    def fence_snapshot(self, prefix: str = "") -> dict:
+        """Doctor-facing capture of in-flight (incomplete) fences
+        (DESIGN.md §23): fence id -> accumulated arrival weight,
+        parked waiter count, and the per-client arrival map, filtered
+        by id prefix (fence ids are ns-prefixed, so a session scope is
+        a prefix).  A fence that appears here during a stall names
+        exactly who has NOT arrived — the hang doctor's fence-side
+        verdict.  Cold path; takes the store lock."""
+        with self.cv:
+            out: Dict[str, dict] = {}
+            for fid, have in self.fences.items():
+                if prefix and not fid.startswith(prefix):
+                    continue
+                out[fid] = {
+                    "arrived_weight": have,
+                    "waiters": len(self.fence_waiters.get(fid, ())),
+                    "arrivals": dict(self.fence_cids.get(fid, {})),
+                }
+            return out
+
     def _fence_arrive_locked(self, msg: dict,
                              conn: Optional[socket.socket]
                              ) -> Optional[dict]:

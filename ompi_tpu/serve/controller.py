@@ -83,6 +83,13 @@ class FleetController:
         self.want_capacity = 0       # 0 = no pending resize
         self.shed_margin_pct = 100
         self.last_depth = 0
+        # published hang-doctor tolerance (DESIGN.md §23), percent of
+        # the EWMA wall estimate: seeded from obs_watchdog_factor and
+        # widened under backlog the same way the shed margin is — a
+        # loaded pool legitimately runs jobs slower, so the watchdog
+        # must not cry wolf exactly when preemption churn peaks
+        self.wd_base_pct = _obs.watchdog_factor_pct()
+        self.wd_factor_pct = self.wd_base_pct
 
     def tick(self, now: int) -> int:
         # hot path: called from Progress.progress on resident
@@ -102,6 +109,10 @@ class FleetController:
         if margin > self.margin_max:
             margin = self.margin_max
         self.shed_margin_pct = margin
+        wf = self.wd_base_pct + depth * 25
+        if wf > self.wd_base_pct * 2:
+            wf = self.wd_base_pct * 2
+        self.wd_factor_pct = wf
         if depth >= self.grow_depth and cap < self.ceil:
             want = cap + self.grow_step
             if want > self.ceil:

@@ -63,12 +63,15 @@ def show_events(target: str, last: int) -> int:
     uri = _resolve_uri(target)
     events = None
     source = None
+    recorded = dropped = 0
     if os.path.isfile(uri):
         try:
             from ompi_tpu.tools.dvm import DvmClient, DvmError
             with DvmClient(uri, connect_timeout=3.0) as cli:
                 m = cli.metrics(events=last)
             events = m.get("events", [])
+            recorded = int(m.get("events_recorded", len(events)))
+            dropped = int(m.get("events_dropped", 0))
             source = "live"
         except (DvmError, OSError, ValueError):
             events = None
@@ -78,6 +81,8 @@ def show_events(target: str, last: int) -> int:
             with open(persisted) as fh:
                 dump = json.load(fh)
             events = dump.get("events", [])
+            recorded = int(dump.get("recorded", len(events)))
+            dropped = int(dump.get("dropped", 0))
             source = persisted
         except (OSError, ValueError):
             sys.stderr.write(
@@ -88,6 +93,18 @@ def show_events(target: str, last: int) -> int:
         events = events[-last:]
     sys.stdout.write(f"flight recorder ({source}): "
                      f"{len(events)} event(s)\n")
+    # never return a short tail silently: when the bounded ring has
+    # already rotated events out (or the caller asked for more than
+    # survive), say exactly how many are gone and why
+    if dropped > 0:
+        sys.stdout.write(
+            f"attach: note: {dropped} older event(s) of {recorded} "
+            "recorded were dropped by the bounded ring "
+            "(obs_events_ring) and cannot be shown\n")
+    elif last > 0 and recorded > len(events):
+        sys.stdout.write(
+            f"attach: note: showing the newest {len(events)} of "
+            f"{recorded} recorded event(s)\n")
     for ev in events:
         sys.stdout.write(_format_event(ev) + "\n")
     return 0

@@ -71,6 +71,7 @@ from typing import Any, Dict, List, Optional
 from ompi_tpu import obs as _obs
 from ompi_tpu import trace
 from ompi_tpu.mca.params import registry
+from ompi_tpu.obs import reqtrace as _reqtrace
 
 _session_max_var = registry.register(
     "dvm", "", "session_max", 8, int,
@@ -172,6 +173,21 @@ _pv_attach_hist = registry.register_pvar(
     help="Session-attach latency histogram (log2 us buckets, bounds "
          "in trace_hist_bucket_bounds_us)",
     getter=lambda: list(_attach_hist))
+# per-session SLI gauges (DESIGN.md §23): the request-scoped health
+# triple `ompi_tpu-top` renders per tenant — queue-wait distribution
+# (p99 via the banded histogram), preemptions suffered, and goodput
+# (wall microseconds of SUCCESSFUL runs; failed-run wall is burned
+# pool time, not service delivered)
+_pv_sli_qwait = _obs.scoped_hist("dvm_sli_queue_wait_us")
+_pv_sli_preempts = _obs.scoped_pvar(
+    "dvm", "sli", "preempts",
+    help="Preemptions suffered by resident sessions (summed; "
+         "per-session via the metrics RPC)")
+_pv_sli_goodput = _obs.scoped_pvar(
+    "dvm", "sli", "goodput_us",
+    help="Wall microseconds of successful (code 0) runs — the "
+         "goodput half of job_wall_us (summed; per-session via the "
+         "metrics RPC)")
 
 
 class DvmError(RuntimeError):
@@ -513,6 +529,16 @@ class _Session:
         self.completed: "collections.OrderedDict[str, int]" = \
             collections.OrderedDict()
         self.wal_jobs: set = set()
+        # request trace context (DESIGN.md §23): minted client-side at
+        # attach (obs_reqtrace_enable), carried by every run RPC.
+        # 0 = untraced.  span is the parent span of the CURRENT run.
+        self.tid = 0
+        self.span = 0
+        # progress-stall watchdog state: perf_counter_ns at run start
+        # (0 = no run in flight) and a per-run one-shot latch so one
+        # stalled run fires exactly one doctor capture
+        self.run_start_ns = 0
+        self.wd_fired = False
 
     def remember_done(self, jobid: str, code: int) -> None:
         self.completed[jobid] = code
@@ -594,6 +620,11 @@ class DVMServer:
         self._journal: Optional[_Journal] = None
         self._kill: Any = None
         self.rehydrated = 0
+        # hang doctor (DESIGN.md §23): sids flagged by the audited
+        # watchdog tick (collected off-path), and the in-process
+        # verdict documents tests/tools read without touching disk
+        self._wd_hits: List[int] = []
+        self.doctor_reports: List[dict] = []
         # rehydrated sessions still parked (no client resumed them
         # yet): read by FleetController.tick as a shrink inhibitor —
         # a just-recovered pool with zero active ranks is NOT idle
@@ -684,6 +715,12 @@ class DVMServer:
             pass  # non-main thread or unsupported platform
         threading.Thread(target=self._hb_loop, daemon=True,
                          name="dvm-hb").start()
+        if _obs.watchdog_ms() > 0:
+            # progress-stall watchdog (DESIGN.md §23): its own thread,
+            # NOT the heartbeat loop — detection latency is bounded by
+            # 2·obs_watchdog_ms, far below the 2 s heartbeat period
+            threading.Thread(target=self._wd_loop, daemon=True,
+                             name="dvm-watchdog").start()
         sys.stderr.write(
             f"tpu-dvm: ready on 127.0.0.1:{self.port} "
             f"(capacity {self.capacity} ranks, "
@@ -985,7 +1022,8 @@ class DVMServer:
                     np_, conn, wait=bool(msg.get("wait", True)),
                     timeout=float(timeout) if timeout else None,
                     priority=int(msg.get("priority", 0)),
-                    preemptible=bool(msg.get("preemptible", False)))
+                    preemptible=bool(msg.get("preemptible", False)),
+                    tid=int(msg.get("tid") or 0))
             finally:
                 conn.busy -= 1
             owned.append(sess.sid)
@@ -1041,6 +1079,14 @@ class DVMServer:
                             "wall_s": 0.0, "replayed": True,
                             "preempted": sess.preempt_count})
                 return False
+            # request trace context (DESIGN.md §23): every run of a
+            # session carries the attach-minted tid plus its own span
+            # id — re-sent on every run so a token reattach onto a
+            # rehydrated session restores the correlation key too
+            tid = int(msg.get("tid") or 0)
+            if tid:
+                sess.tid = tid
+            sess.span = int(msg.get("span") or 0)
             deadline_ms = msg.get("deadline_ms")
             if deadline_ms:
                 self._shed_check(sess, int(deadline_ms))
@@ -1152,6 +1198,13 @@ class DVMServer:
             row = {"np": sess.np, "dead": sess.dead}
             for sp in _obs.scoped_items():
                 row[sp.full_name] = sp.read_band(sid)
+            # derived SLI: per-tenant queue-wait p99 from the banded
+            # histogram (DESIGN.md §23) — what top's session table
+            # and the reqtrace probe's sentry metric read
+            row["queue_wait_p99_us"] = \
+                _pv_sli_qwait.band_percentile(sid)
+            if sess.tid:
+                row["tid"] = sess.tid
             sessions[str(sid)] = row
             for st in sess.states:
                 sc = st.progress.obs
@@ -1201,6 +1254,8 @@ class DVMServer:
             "scraped_ranks": scraped,
             "pvars": mpit.pvar_snapshot(),
             "scoped": _obs.scoped_snapshot(),
+            "scoped_hists": _obs.scoped_hist_snapshot(),
+            "doctor_reports": len(self.doctor_reports),
             "sessions": sessions,
             "hists": hists_doc,
             "percentiles": pcts,
@@ -1678,7 +1733,7 @@ class DVMServer:
 
     def _attach(self, np_: int, conn, wait: bool = True,
                 timeout: Optional[float] = None, priority: int = 0,
-                preemptible: bool = False):
+                preemptible: bool = False, tid: int = 0):
         t0 = time.perf_counter()
         if np_ < 1 or np_ > self.capacity:
             raise DvmError(
@@ -1770,10 +1825,15 @@ class DVMServer:
             self._release(sess)
             raise
         attach_us = int((time.perf_counter() - t0) * 1e6)
+        sess.tid = tid
         _pv_attaches.add(1)
         _pv_queue_wait_us.add(queued_us, sess.sid)
+        _pv_sli_qwait.add_us(queued_us, sess.sid)
         _pv_attach_us_max.update_max(attach_us)
         _obs.record_event(_obs.EV_DVM_ATTACH, sess.sid, np_, attach_us)
+        if tid:
+            _obs.record_event(_obs.EV_REQ_ATTACH, sess.sid, tid,
+                              queued_us)
         b = attach_us.bit_length()
         _attach_hist[b if b < trace.N_BUCKETS else trace.N_BUCKETS - 1] += 1
         tr = trace.global_tracer()
@@ -1845,6 +1905,7 @@ class DVMServer:
         slower run, never a failed one.  Idle: parked here directly;
         its next run re-admits and re-brings-up transparently."""
         _pv_preempts.add(1)
+        _pv_sli_preempts.add(1, victim.sid)
         _obs.record_event(_obs.EV_DVM_PREEMPT, victim.sid, by_priority,
                           victim.priority)
         tr = trace.global_tracer()
@@ -1871,6 +1932,7 @@ class DVMServer:
         The session object (sid, ns, jobid, priority) stays in the
         table; _unpark re-admits and re-brings it up."""
         sess.preempt_count += 1
+        _obs.record_event(_obs.EV_REQ_PARK, sess.sid, sess.tid)
         self._destroy(sess)
         sess.world = None
         sess.states = []
@@ -1884,6 +1946,7 @@ class DVMServer:
         world back up (fresh rank-threads, same sid/cid-band/KV ns).
         Runs on the owning connection's dispatch thread — the client
         keeps getting heartbeats while we wait."""
+        t0 = time.perf_counter()
         if self.hosts > 1 and self.hosts_rehydrating > 0:
             # a replay admitted while a host domain is still a hole
             # would band ranks onto the dead host: hold until the
@@ -1914,6 +1977,10 @@ class DVMServer:
             raise DvmError(f"preempted session s{sess.sid} could not "
                            "be re-admitted (pool saturated)")
         self._bringup(sess)
+        # the park->resume gap a request waterfall renders: queue wait
+        # for re-admission plus the fresh bring-up
+        _obs.record_event(_obs.EV_REQ_RESUME, sess.sid, sess.tid,
+                          int((time.perf_counter() - t0) * 1e6))
         self._write_proctable()
 
     def _shed_check(self, sess: _Session, deadline_ms: int) -> None:
@@ -1940,6 +2007,133 @@ class DVMServer:
             f"deadline {deadline_ms}ms infeasible: pool estimates "
             f"~{est // 1000}ms wall at {margin}% margin — shed at "
             "admission")
+
+    # -- hang doctor (DESIGN.md §23) ---------------------------------------
+
+    def _wd_loop(self) -> None:
+        """Progress-stall watchdog thread: ticks at half the knob
+        period so a stall is DETECTED within 2·obs_watchdog_ms of
+        crossing the threshold.  The tick is audited (integer scans
+        only); the capture — stacks, rendezvous/fence state, JSON —
+        runs here, off every hot path."""
+        wd_ms = _obs.watchdog_ms()
+        while not self._halted:
+            time.sleep(wd_ms / 2000.0)
+            # re-resolved every tick (cold path) so the factor knob
+            # is live-tunable on a running pool
+            base_pct = _obs.watchdog_factor_pct()
+            if self._watchdog_tick(time.perf_counter_ns(), base_pct):
+                self._watchdog_collect(base_pct)
+
+    def _watchdog_tick(self, now: int, base_pct: int) -> int:
+        # audited (tools/hotpath_audit): the scan itself is the
+        # per-tick cost and must stay integer compares over the
+        # session table — flagged sids go to _wd_hits; everything
+        # that allocates happens in _watchdog_collect
+        est = self.est_wall_us
+        if est <= 0:
+            return 0  # no completed run yet: nothing to compare with
+        ctrl = self.ctrl
+        factor = ctrl.wd_factor_pct if ctrl is not None else base_pct
+        limit = est * 1000 * factor // 100
+        hits = 0
+        try:
+            for sess in self.sessions.values():
+                t0 = sess.run_start_ns
+                if t0 and not sess.wd_fired and now - t0 > limit:
+                    sess.wd_fired = True
+                    self._wd_hits.append(sess.sid)
+                    hits += 1
+        except RuntimeError:
+            return hits  # table mutated mid-scan: catch them next tick
+        return hits
+
+    def _watchdog_collect(self, base_pct: int) -> None:
+        hits = self._wd_hits
+        if not hits:
+            return
+        self._wd_hits = []
+        for sid in hits:
+            with self.lock:
+                sess = self.sessions.get(sid)
+            if sess is None or sess.run_start_ns == 0:
+                continue  # the run finished between tick and collect
+            self._doctor_capture(sess, base_pct)
+
+    def _doctor_capture(self, sess: _Session, base_pct: int) -> None:
+        """Auto-capture on a detected stall: every resident rank's
+        stack, the session world's rendezvous arrival state, its KV
+        namespace's in-flight fences, ULFM abort state, and the flight
+        tail — reduced to a verdict by tools/doctor.py."""
+        now = time.perf_counter_ns()
+        ctrl = self.ctrl
+        factor = ctrl.wd_factor_pct if ctrl is not None else base_pct
+        limit_ns = self.est_wall_us * 1000 * factor // 100
+        run_ms = (now - sess.run_start_ns) // 1_000_000
+        est_ms = self.est_wall_us // 1000
+        # detection latency past the moment the threshold was crossed
+        # — the probe's doctor_mttd_ms sentry metric
+        mttd_ms = (now - (sess.run_start_ns + limit_ns)) / 1e6
+        _obs.record_event(_obs.EV_WD_STALL, sess.sid, sess.tid,
+                          run_ms, est_ms)
+        stacks: Dict[str, List[str]] = {}
+        frames = sys._current_frames()
+        prefix = f"dvm-s{sess.sid}-r"
+        for t in threading.enumerate():
+            if t.name.startswith(prefix):
+                fr = frames.get(t.ident)
+                if fr is not None:
+                    stacks[t.name] = traceback.format_stack(fr)
+        rdvs: List[dict] = []
+        aborted = None
+        w = sess.world
+        if w is not None:
+            aborted = list(w.aborted) if w.aborted else None
+            with w.shared_lock:
+                rvs = [(k, v) for k, v in w.shared.items()
+                       if isinstance(k, tuple) and k
+                       and k[0] == "coll_rv"]
+            for k, rv in rvs:
+                snap = rv.snapshot()
+                if snap["count"]:
+                    # only meetings someone has arrived at: a fully
+                    # idle rendezvous names every rank absent and
+                    # would drown the verdict
+                    snap["cid"] = k[1]
+                    snap["group"] = list(k[2])
+                    rdvs.append(snap)
+        fences: Dict[str, dict] = {}
+        try:
+            fences = self.kv_server.fence_snapshot(f"{sess.ns}/")
+        except Exception:
+            pass
+        doc = {
+            "sid": sess.sid, "tid": sess.tid, "span": sess.span,
+            "ns": sess.ns, "np": sess.np,
+            "run_ms": run_ms, "est_ms": est_ms,
+            "factor_pct": factor,
+            "mttd_ms": round(mttd_ms, 3),
+            "aborted": aborted,
+            "stacks": stacks,
+            "rendezvous": rdvs,
+            "fences": fences,
+            "events": _obs.recorder().snapshot(64),
+        }
+        self.doctor_reports.append(doc)
+        if self.uri_file:
+            path = f"{self.uri_file}.doctor.s{sess.sid}.json"
+            tmp = path + ".tmp"
+            try:
+                with open(tmp, "w") as f:
+                    json.dump(doc, f, indent=1)
+                os.replace(tmp, path)
+                sys.stderr.write(
+                    f"tpu-dvm: wd_stall s{sess.sid} "
+                    f"(run {run_ms}ms > {factor}% of est {est_ms}ms) "
+                    f"— doctor capture -> {path}\n")
+            except OSError:
+                pass
+        self._persist_events(f"wd_stall s{sess.sid}")
 
     def resize(self, new_cap: int):
         """Live pool resize: change resident rank capacity WITHOUT
@@ -2141,6 +2335,25 @@ class DVMServer:
 
         _squota.begin_run(sess.sid)  # quotas are per run
         t0 = time.perf_counter()
+        # watchdog anchors: run start first, THEN clear the one-shot
+        # latch — the reverse order would let a tick fire on the
+        # previous run's stale start
+        sess.run_start_ns = time.perf_counter_ns()
+        sess.wd_fired = False
+        if sess.tid:
+            # propagate the trace context across the KV fence plane:
+            # remote-host components (tpud agents, probes) correlate
+            # this session's fences with the request by reading its
+            # namespace.  Cold path, gated on a carried context.
+            from ompi_tpu.runtime.kvstore import KVClient
+            try:
+                kvc = KVClient(self.kv_server.uri, ns=sess.ns)
+                kvc.put("reqtrace", {"tid": sess.tid,
+                                     "span": sess.span,
+                                     "sid": sess.sid})
+                kvc.close()
+            except OSError:
+                pass
         _ensure_stdio()  # per run, not just at pool start: the host
         # may have swapped sys.stdout since (pytest capture does)
         out, err = _SessionBuf(), _SessionBuf()
@@ -2165,6 +2378,13 @@ class DVMServer:
             set_thread_rte(st.rte)
             statemod.set_current(st)
             _stdio_push(out, err, argv)
+            # per-job tracer tag (DESIGN.md §23): the §16 cid-band
+            # cost model — two int stores bracket the program, so
+            # every span the rank records in between is attributable
+            # to this request by timestamp containment
+            rtr = st.tracer if sess.tid else None
+            if rtr is not None:
+                rtr.req_mark(sess.tid)
             try:
                 runpy.run_path(prog, run_name="__main__")
                 # run boundary: flush deferred fused batches and meet
@@ -2190,6 +2410,8 @@ class DVMServer:
                     failure[0] = failure[0] or 1
                 poison(st, 1, "uncaught exception")
             finally:
+                if rtr is not None:
+                    rtr.req_mark(0)  # close this rank's tag window
                 _stdio_pop()
                 statemod.set_current(None)
                 set_thread_rte(None)
@@ -2203,6 +2425,7 @@ class DVMServer:
         for t in threads:
             t.join()
         wall = time.perf_counter() - t0
+        sess.run_start_ns = 0  # watchdog: no run in flight
         with self.lock:
             self._jobs += 1
         wus = int(wall * 1e6)
@@ -2213,8 +2436,13 @@ class DVMServer:
             self.est_wall_us += (wus - self.est_wall_us) >> 2
         _pv_jobs.add(1, sess.sid)
         _pv_job_wall_us.add(wus, sess.sid)
+        if not failure[0]:
+            _pv_sli_goodput.add(wus, sess.sid)
         _obs.record_event(_obs.EV_DVM_RUN, sess.sid, failure[0] or 0,
                           int(wall * 1000))
+        if sess.tid:
+            _obs.record_event(_obs.EV_REQ_RUN, sess.sid, sess.tid,
+                              sess.span, int(wall * 1000))
         tr = trace.global_tracer()
         if tr is not None:
             tr.instant("dvm_run", "serve", sid=sess.sid,
@@ -2366,6 +2594,7 @@ class DvmClient:
         self.uri_file = uri_file
         self.incarnation: Optional[str] = None
         self._tokens: Dict[int, str] = {}
+        self._tids: Dict[int, int] = {}  # sid -> request trace id
         self._jobid_n = itertools.count()
         self._dial(connect_timeout)
         self._hb = max(0.5, float(_hb_var.value))
@@ -2486,16 +2715,30 @@ class DvmClient:
     def attach(self, np_: int, wait: bool = True,
                timeout: Optional[float] = None, priority: int = 0,
                preemptible: bool = False) -> dict:
+        msg: Dict[str, Any] = {"op": "attach", "np": np_,
+                               "wait": wait, "timeout": timeout,
+                               "priority": priority,
+                               "preemptible": preemptible}
+        tid = 0
+        if _reqtrace.enabled():
+            # mint the request trace context HERE, at the client edge
+            # (DESIGN.md §23) — everything downstream (RPC, admission
+            # queue, rank tracers, KV plane, flight events) carries
+            # this id; traceview --job renders the waterfall under it
+            tid, span = _reqtrace.mint()
+            msg["tid"] = tid
+            msg["span"] = span
         resp = self._rpc(
-            {"op": "attach", "np": np_, "wait": wait,
-             "timeout": timeout, "priority": priority,
-             "preemptible": preemptible},
+            msg,
             deadline=(time.monotonic() + timeout + 30.0)
             if timeout else None)
         if "error" in resp:
             self._raise_typed(resp)
         if "token" in resp:
             self._tokens[int(resp["sid"])] = resp["token"]
+        if tid:
+            self._tids[int(resp["sid"])] = tid
+            resp["tid"] = tid
         return resp
 
     def reattach(self, sid: int, token: Optional[str] = None) -> dict:
@@ -2523,6 +2766,13 @@ class DvmClient:
                                         f"{next(self._jobid_n)}"}
         if deadline_ms is not None:
             msg["deadline_ms"] = int(deadline_ms)
+        tid = self._tids.get(sid)
+        if tid:
+            # every run shares the session's attach-minted trace id
+            # and carries its own span — a (tid, span) pair names one
+            # causal step of the request
+            msg["tid"] = tid
+            msg["span"] = _reqtrace.next_span()
         try:
             _send(self.sock, msg)
         except OSError as e:

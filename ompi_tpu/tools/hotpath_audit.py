@@ -38,6 +38,10 @@ HOT_FUNCTIONS: Dict[str, Tuple[str, ...]] = {
         "Tracer.end",
         "Tracer.tick_ns",
         "Tracer.hist_add",
+        # per-job request tag (DESIGN.md §23): brackets every run on
+        # every resident rank when request tracing is on — two int
+        # ring stores, the same cost class as hist_add
+        "Tracer.req_mark",
         "coll_begin",
         "coll_end",
     ),
@@ -111,6 +115,11 @@ HOT_FUNCTIONS: Dict[str, Tuple[str, ...]] = {
         # per-host lists; the expensive lost-domain collection runs
         # off-path in _host_collect
         "DVMServer._host_tick",
+        # the progress-stall watchdog scan (DESIGN.md §23) ticks at
+        # obs_watchdog_ms/2 for the life of the pool when armed:
+        # integer compares over the session table only — stack/fence
+        # capture lives off-path in _watchdog_collect
+        "DVMServer._watchdog_tick",
     ),
 }
 

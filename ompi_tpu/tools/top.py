@@ -24,6 +24,12 @@ _SESSION_COLS = (
     ("dvm_jobs", "jobs"),
     ("dvm_job_wall_us", "wall_us"),
     ("dvm_queue_wait_us", "qwait_us"),
+    # per-session SLI gauges (DESIGN.md §23): queue-wait p99 from the
+    # banded histogram, preemptions suffered, goodput (successful-run
+    # wall time only)
+    ("queue_wait_p99_us", "qw_p99_us"),
+    ("dvm_sli_preempts", "preempts"),
+    ("dvm_sli_goodput_us", "goodput_us"),
     ("coll_device_fused_batches", "batches"),
     ("coll_device_fused_bytes", "bytes"),
     ("coll_device_cache_hits", "hits"),

@@ -181,6 +181,129 @@ def straggler_report(events: List[dict], top: int = 5) -> List[str]:
     return out
 
 
+def request_phases(dumps: List[dict],
+                   tid: int) -> Dict[int, Dict[str, float]]:
+    """Per-rank per-category span time (us) inside this request's tag
+    windows (DESIGN.md §23).  A rank's ``req_windows`` marks bracket
+    each run — mark k opens window [ts_k, ts_{k+1}) — so every span
+    the rank recorded in between belongs to the request whose 63-bit
+    id the mark carries.  Containment is evaluated on the rank's OWN
+    clock (marks and spans share a timebase), so no offset correction
+    is needed here."""
+    out: Dict[int, Dict[str, float]] = {}
+    for d in dumps:
+        rank = d.get("rank", -1)
+        wins = d.get("req_windows") or []
+        if rank < 0 or not wins:
+            continue
+        by_cat: Dict[str, float] = {}
+        for k, w in enumerate(wins):
+            if w.get("tag") != tid:
+                continue
+            t0 = w["ts"]
+            t1 = wins[k + 1]["ts"] if k + 1 < len(wins) \
+                else float("inf")
+            for ev in d.get("events", ()):
+                if ev.get("ph") != "X":
+                    continue
+                ts = ev.get("ts", 0.0)
+                if t0 <= ts < t1:
+                    cat = ev.get("cat", "?")
+                    by_cat[cat] = (by_cat.get(cat, 0.0)
+                                   + ev.get("dur", 0.0) * 1e6)
+        if by_cat:
+            out[rank] = by_cat
+    return out
+
+
+def job_report(dumps: List[dict], offsets_us: List[float],
+               tid: int) -> tuple:
+    """The per-request waterfall (DESIGN.md §23): the request's
+    flight events — queue wait, park/resume gaps, per-run wall,
+    checkpoint drain stalls, watchdog verdicts — merged and
+    clock-corrected across every dump that carries them, plus
+    per-phase span time from the rank request windows.  Returns
+    ``(lines, info)`` where info carries the additive span sum the
+    reqtrace probe compares against the client-measured wall:
+    ``total_us = queued + sum(run walls) + sum(resume bringups)``
+    (drain stalls overlap run wall and are reported, not summed)."""
+    events = corrected_events(dumps, offsets_us)
+    sids = {e["args"].get("sid") for e in events
+            if e.get("args", {}).get("tid") == tid}
+    req = []
+    for e in events:
+        a = e.get("args", {})
+        name = e.get("name", "")
+        if a.get("tid") == tid and (name.startswith("req_")
+                                    or name == "wd_stall"):
+            req.append(e)
+        elif name == "req_drain" and a.get("band") in sids:
+            # drain events are keyed by the cid-band (== sid): no tid
+            # of their own, correlated through the session
+            req.append(e)
+    if not req:
+        return [f"job 0x{tid:x}: no flight events in these dumps "
+                "(was obs_reqtrace_enable on?)"], {}
+    base = req[0]["ts"]
+    q_us = run_us = resume_us = drain_us = 0
+    runs = parks = stalls = 0
+    lines = [f"request 0x{tid:x}  (session "
+             + ",".join(f"s{s}" for s in sorted(sids)) + ")"]
+    for e in req:
+        a = e.get("args", {})
+        t = e["ts"] - base
+        name = e["name"]
+        if name == "req_attach":
+            q = a.get("queued_us", 0)
+            q_us += q
+            lines.append(f"  t+{t:12.0f}us  attach      "
+                         f"queue wait {q}us")
+        elif name == "req_run":
+            runs += 1
+            w = a.get("wall_ms", 0) * 1000
+            run_us += w
+            lines.append(f"  t+{t:12.0f}us  run #{runs:<3}    "
+                         f"span {a.get('span')}  wall {w}us")
+        elif name == "req_park":
+            parks += 1
+            lines.append(f"  t+{t:12.0f}us  park        "
+                         "preempted (capacity reclaimed)")
+        elif name == "req_resume":
+            r = a.get("us", 0)
+            resume_us += r
+            lines.append(f"  t+{t:12.0f}us  resume      "
+                         f"bringup {r}us")
+        elif name == "req_drain":
+            drain_us += a.get("us", 0)
+            lines.append(f"  t+{t:12.0f}us  ckpt drain  "
+                         f"epoch {a.get('epoch')}  "
+                         f"stalled {a.get('us', 0)}us "
+                         "(overlaps run)")
+        elif name == "wd_stall":
+            stalls += 1
+            lines.append(f"  t+{t:12.0f}us  WD STALL    "
+                         f"run {a.get('run_ms')}ms vs est "
+                         f"{a.get('est_ms')}ms — tools/doctor.py "
+                         "has the capture")
+    phases = request_phases(dumps, tid)
+    for rank in sorted(phases):
+        parts = " ".join(f"{c}={int(v)}us" for c, v in
+                         sorted(phases[rank].items(),
+                                key=lambda cv: -cv[1]))
+        lines.append(f"  r{rank:<3} in-request span time: {parts}")
+    total = q_us + run_us + resume_us
+    lines.append(f"  span sum {total}us  (queue {q_us}us + "
+                 f"{runs} run(s) {run_us}us + {parks} park(s) "
+                 f"resume {resume_us}us; drain stalls {drain_us}us "
+                 "overlap)")
+    info = {"tid": tid, "sids": sorted(sids), "runs": runs,
+            "parks": parks, "stalls": stalls,
+            "queued_us": q_us, "run_us": run_us,
+            "resume_us": resume_us, "drain_us": drain_us,
+            "total_us": total, "phases": phases}
+    return lines, info
+
+
 def _hist_percentiles(hist: List[int]) -> Dict[str, float]:
     """p50/p90/p99 (us) from a log2 latency histogram: bucket b holds
     [2^(b-1), 2^b) us (hist_add's bit_length bucketing), and the
@@ -297,6 +420,12 @@ def main(argv=None) -> int:
                     help="write Chrome trace-event JSON here")
     ap.add_argument("--top", type=int, default=5,
                     help="rows per summary section")
+    ap.add_argument("--job", default=None, metavar="TID",
+                    help="render the per-request waterfall for this "
+                         "trace id (hex 0x... or decimal) instead of "
+                         "the category summary: queue wait, "
+                         "park/resume gaps, per-run wall, drain "
+                         "stalls, per-rank in-request span time")
     ap.add_argument("--metrics", default=None,
                     help="a metrics-RPC snapshot JSON (DvmClient."
                          "metrics() reply): its aggregated histogram "
@@ -308,6 +437,16 @@ def main(argv=None) -> int:
     dumps = load_dumps(opts.dumps)
     offsets = load_offsets(opts.sync) if opts.sync \
         else embedded_offsets(dumps)
+    if opts.job:
+        try:
+            tid = int(opts.job, 0)
+        except ValueError:
+            sys.stderr.write(f"traceview: bad --job id "
+                             f"{opts.job!r} (hex 0x... or decimal)\n")
+            return 2
+        lines, info = job_report(dumps, offsets, tid)
+        sys.stdout.write("\n".join(lines) + "\n")
+        return 0 if info else 1
     metrics = None
     if opts.metrics:
         with open(opts.metrics) as fh:

@@ -270,6 +270,10 @@ PHASE_LABELS = {
 
 _NO_ADAPT = 1 << 62  # _nxt sentinel when adaptation is disabled
 
+# per-job request-tag marks kept per tracer (DESIGN.md §23): one mark
+# per run start/end, so even a 256-deep ring covers hours of serving
+REQ_MARKS = 256
+
 
 def _parse_sample_spec(spec: str) -> Dict[int, int]:
     """'p2p:8,coll:4' -> {cat_id: period}; malformed entries ignored
@@ -328,6 +332,7 @@ class Tracer:
         "_nrec", "_period", "_ctr", "_skipped", "_cnt", "_nxt",
         "_over", "_auto", "_max_period",
         "phase", "sync_offsets_us",
+        "_req_tags", "_req_ts", "_req_n",
     )
 
     def __init__(self, rank: int, capacity: int = 8192) -> None:
@@ -368,6 +373,13 @@ class Tracer:
         # mpisync offsets measured at finalize (sync_state) ride the
         # dump so traceview/critpath need no hand-plumbed --sync file
         self.sync_offsets_us: Optional[List[float]] = None
+        # request-tag mark ring (DESIGN.md §23): a run stamps its
+        # 63-bit trace id on entry and 0 on exit; spans between two
+        # marks belong to that request.  Preallocated so req_mark
+        # stays two column stores
+        self._req_tags = array("q", [0]) * REQ_MARKS
+        self._req_ts = array("q", [0]) * REQ_MARKS
+        self._req_n = 0
         for cid, per in _parse_sample_spec(sample_spec_var.value).items():
             self._ensure_cat(cid)
             self._period[cid] = min(per, self._max_period)
@@ -434,6 +446,32 @@ class Tracer:
                 self._period[cat_id] = p
         self._ctr[cat_id] = p - 1
         return _pcns()
+
+    def gate_sampled(self, cat_id: int) -> bool:
+        """Sampling decision WITHOUT a clock read: start_sampled's
+        1-in-period keep/skip logic for call sites that gate a whole
+        STRUCTURE of spans — the §18 per-op phase ctx — rather than
+        one span.  A skipped sighting is a counter decrement counted
+        sampled-out (the category's exact counters still see every
+        op); a kept one runs the same geometric adaptation and
+        reloads the counter.  The sub-spans of a kept structure then
+        record unconditionally (``start()``/``end()``), so one op's
+        decomposition is always coherent — never a dispatch span
+        whose execute sampled out."""
+        c = self._ctr[cat_id]
+        if c:
+            self._ctr[cat_id] = c - 1
+            self._skipped[cat_id] += 1
+            return False
+        p = self._period[cat_id]
+        seen = self._cnt[cat_id] + self._skipped[cat_id]
+        if seen >= self._nxt[cat_id]:
+            self._nxt[cat_id] = seen + self._auto
+            if p < self._max_period:
+                p += p
+                self._period[cat_id] = p
+        self._ctr[cat_id] = p - 1
+        return True
 
     def end(self, t0: int, name_id: int, cat_id: int,
             a0: int = 0, a1: int = 0, a2: int = 0, a3: int = 0,
@@ -520,6 +558,32 @@ class Tracer:
 
     def tick(self, dur_s: float) -> None:
         self.tick_ns(int(dur_s * 1e9))
+
+    def req_mark(self, tag: int, _pcns=time.perf_counter_ns) -> None:
+        """Stamp the per-job request tag (DESIGN.md §23): the serving
+        plane calls this once at run entry (tag = the run's 63-bit
+        trace id) and once at exit (tag 0), so every span recorded in
+        between is attributable to that request at dump time.  Hot
+        contract (hotpath_audit): two preallocated column stores, one
+        perf-counter read, integer bookkeeping — the same cost class
+        as a ScopedPvar add."""
+        i = self._req_n % REQ_MARKS
+        self._req_tags[i] = tag
+        self._req_ts[i] = _pcns()
+        self._req_n += 1
+
+    def req_windows(self) -> List[dict]:
+        """The live request marks oldest-first as {tag, ts} dicts
+        (epoch-second timestamps, the dump event convention): window
+        k covers [mark[k].ts, mark[k+1].ts).  Cold path."""
+        out = []
+        n = self._req_n
+        start = max(0, n - REQ_MARKS)
+        for k in range(start, n):
+            i = k % REQ_MARKS
+            out.append({"tag": self._req_tags[i],
+                        "ts": self._wall(self._req_ts[i])})
+        return out
 
     def hist_add(self, which: int, dur_s: float) -> None:
         us = int(dur_s * 1e6)
@@ -650,6 +714,11 @@ class Tracer:
             "hists": {n: list(h) for n, h in zip(HIST_NAMES, self.hists)},
             "events": self.snapshot(),
         }
+        req = self.req_windows()
+        if req:
+            # request-tag windows (DESIGN.md §23): traceview --job
+            # attributes this rank's spans to requests by these marks
+            doc["req_windows"] = req
         if self.sync_offsets_us is not None:
             # auto-embedded clock correction (sync_state): traceview
             # and critpath use it when no --sync file is given

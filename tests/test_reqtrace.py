@@ -1,0 +1,381 @@
+"""Request-scoped distributed tracing + the hang doctor
+(docs/DESIGN.md §23): trace-id mint/parse round-trips, the per-rank
+req_mark window ring and its span attribution, the traceview --job
+waterfall reduction (synthetic and CLI), the doctor verdict reducer
+over capture documents, byte-identity of a traced+watchdog-armed run
+vs an untraced one, watchdog false-positive suppression (below the
+stall factor: zero captures; above: exactly one per job), the attach
+--events dropped-count note, per-session scoped-histogram prometheus
+series, and the hotpath-audit coverage of the two new hot functions."""
+
+import json
+import os
+import threading
+
+import pytest
+
+from ompi_tpu import obs, trace
+from ompi_tpu.mca.params import registry
+from ompi_tpu.obs import reqtrace
+from ompi_tpu.testing import run_ranks
+from ompi_tpu.tools import doctor, traceview
+
+HERE = os.path.dirname(__file__)
+PROG = os.path.join(HERE, "_dvm_session_prog.py")
+SLOW_PROG = os.path.join(HERE, "_dvm_slow_prog.py")
+REPO = os.path.dirname(HERE)
+
+
+@pytest.fixture(autouse=True)
+def _clean_reqtrace():
+    yield
+    registry.set("obs_reqtrace_enable", "0")
+    registry.set("obs_watchdog_ms", "0")
+    registry.set("obs_watchdog_factor", "4")
+    registry.set("trace_enable", "0")
+    registry.set("ft_inject_plan", "")
+
+
+# -- trace-context mint/parse ------------------------------------------------
+
+def test_mint_parse_fmt_roundtrip():
+    seen = set()
+    for _ in range(1000):
+        tid, span = reqtrace.mint()
+        assert 0 < tid < 1 << 63
+        assert tid not in seen
+        seen.add(tid)
+        assert span >= 1
+    t = next(iter(seen))
+    assert reqtrace.parse(reqtrace.fmt(t)) == t
+    assert reqtrace.fmt(t).startswith("0x")
+    assert reqtrace.parse(str(t)) == t          # decimal form
+    with pytest.raises(ValueError):
+        reqtrace.parse("not-a-tid")
+
+
+def test_mint_disabled_by_default():
+    assert not reqtrace.enabled()
+    registry.set("obs_reqtrace_enable", "1")
+    assert reqtrace.enabled()
+
+
+def test_next_span_monotonic():
+    a = reqtrace.next_span()
+    b = reqtrace.next_span()
+    assert b > a
+
+
+# -- req_mark ring on the Tracer ---------------------------------------------
+
+def test_req_mark_windows_bracket_spans():
+    registry.set("trace_enable", "1")
+    import numpy as np
+    from ompi_tpu.op import op as mpi_op
+
+    def fn(comm):
+        tr = comm.state.tracer
+        assert tr is not None
+        sbuf = np.ones(8, np.float32)
+        rbuf = np.zeros(8, np.float32)
+        tr.req_mark(0x51)
+        comm.Allreduce(sbuf, rbuf, mpi_op.SUM)
+        tr.req_mark(0)
+        comm.Barrier()
+        wins = tr.req_windows()
+        dump = {"rank": comm.rank, "events": tr.snapshot(),
+                "req_windows": wins}
+        return wins, dump
+
+    out = run_ranks(2, fn)
+    for wins, dump in out:
+        tags = [w["tag"] for w in wins]
+        assert tags == [0x51, 0]
+        ts = [w["ts"] for w in wins]
+        assert ts == sorted(ts)
+        # the window attributes this rank's coll spans to the request
+        phases = traceview.request_phases([dump], 0x51)
+        assert phases.get(dump["rank"], {}).get("coll", 0) > 0
+
+
+def test_req_mark_ring_bounded():
+    registry.set("trace_enable", "1")
+
+    def fn(comm):
+        tr = comm.state.tracer
+        for n in range(trace.REQ_MARKS + 7):
+            tr.req_mark(n + 1)
+        return tr.req_windows()
+
+    wins = run_ranks(1, fn)[0]
+    assert len(wins) == trace.REQ_MARKS
+    # oldest marks rotated out: the survivors are the newest REQ_MARKS
+    assert wins[0]["tag"] == 8
+    assert wins[-1]["tag"] == trace.REQ_MARKS + 7
+
+
+# -- the traceview --job waterfall reduction ---------------------------------
+
+def _flight_dump(tid=0x7, sid=3):
+    evs = [
+        {"name": "req_attach", "cat": "flight", "ph": "i", "ts": 100.0,
+         "rank": -1, "args": {"sid": sid, "tid": tid,
+                              "queued_us": 2000}},
+        {"name": "req_run", "cat": "flight", "ph": "i", "ts": 100.1,
+         "rank": -1, "args": {"sid": sid, "tid": tid, "span": 2,
+                              "wall_ms": 50}},
+        {"name": "req_park", "cat": "flight", "ph": "i", "ts": 100.2,
+         "rank": -1, "args": {"sid": sid, "tid": tid}},
+        {"name": "req_resume", "cat": "flight", "ph": "i", "ts": 100.3,
+         "rank": -1, "args": {"sid": sid, "tid": tid, "us": 1500}},
+        {"name": "req_drain", "cat": "flight", "ph": "i", "ts": 100.35,
+         "rank": -1, "args": {"band": sid, "epoch": 1, "us": 800}},
+        {"name": "req_run", "cat": "flight", "ph": "i", "ts": 100.4,
+         "rank": -1, "args": {"sid": sid, "tid": tid, "span": 3,
+                              "wall_ms": 30}},
+    ]
+    return {"rank": -1, "flight": True, "recorded": len(evs),
+            "dropped": 0, "events": evs}
+
+
+def test_job_report_synthetic_waterfall():
+    fdump = _flight_dump()
+    rdump = {"rank": 0, "events": [
+        {"name": "allreduce", "cat": "coll", "ph": "X", "ts": 100.12,
+         "dur": 0.004, "args": {}}],
+        "req_windows": [{"tag": 0x7, "ts": 100.11},
+                        {"tag": 0, "ts": 100.16}]}
+    lines, info = traceview.job_report([fdump, rdump], [], 0x7)
+    assert info["queued_us"] == 2000
+    assert info["runs"] == 2 and info["run_us"] == 80000
+    assert info["parks"] == 1 and info["resume_us"] == 1500
+    assert info["drain_us"] == 800
+    # drain stalls overlap run wall: reported, never summed
+    assert info["total_us"] == 2000 + 80000 + 1500
+    text = "\n".join(lines)
+    assert "run #1" in text and "run #2" in text
+    assert "drain" in text and "overlap" in text
+    assert "span sum" in text
+    # the rank's in-request span attribution rode along
+    assert info["phases"].get(0, {}).get("coll", 0) == 4000
+    assert "cat=" not in "" and any("in-request span" in ln
+                                    for ln in lines)
+    # an unknown job yields the hint line and empty info
+    lines2, info2 = traceview.job_report([fdump], [], 0x999)
+    assert not info2 and lines2
+
+
+def test_traceview_job_cli(tmp_path, capsys):
+    p = str(tmp_path / "flight.events.json")
+    with open(p, "w") as fh:
+        json.dump(_flight_dump(), fh)
+    assert traceview.main([p, "--job", "0x7"]) == 0
+    out = capsys.readouterr().out
+    assert "span sum" in out and "queue" in out
+    assert traceview.main([p, "--job", "0x999"]) == 1
+    assert traceview.main([p, "--job", "zzz"]) == 2
+
+
+# -- doctor verdict reducer --------------------------------------------------
+
+def _capture_doc(sid=5, tid=0x9):
+    return {
+        "sid": sid, "tid": tid, "span": 2, "ns": f"s{sid}", "np": 4,
+        "run_ms": 900, "est_ms": 100, "factor_pct": 200,
+        "mttd_ms": 12.5, "aborted": None,
+        "stacks": {f"dvm-s{sid}-r0": ["  File x, line 1, in wait\n"]},
+        "rendezvous": [{"cid": 1, "gen": 3, "size": 4, "count": 3,
+                        "arrived": [0, 1, 3], "absent": [2],
+                        "pending_gens": [], "group": [4, 5, 6, 7]}],
+        "fences": {"f1": {"arrived_weight": 2, "waiters": 1,
+                          "arrivals": {"4": 1, "5": 1}}},
+        "events": [{"name": "wd_stall", "cat": "flight", "ph": "i",
+                    "ts": 1.0, "rank": -1,
+                    "args": {"sid": sid, "tid": tid}}],
+    }
+
+
+def test_doctor_verdict_names_absent_rank():
+    lines = doctor.verdict(_capture_doc())
+    text = "\n".join(lines)
+    # slot 2 of group [4,5,6,7] is GLOBAL rank 6 — the verdict names
+    # world ranks, not comm-local slots
+    assert "ABSENT ranks [6]" in text
+    assert "waiting ranks [4,5,7]" in text
+    assert "cid=1" in text and "gen=3" in text
+    assert "0x9" in text and "s5" in text
+    assert "900ms" in text and "rendezvous" in text
+    # fences ride along as supporting evidence when rdvs exist
+    assert "fence f1" in text and "VERDICT: in-flight KV" not in text
+
+
+def test_doctor_verdict_fence_and_local_fallbacks():
+    doc = _capture_doc()
+    doc["rendezvous"] = []
+    text = "\n".join(doctor.verdict(doc))
+    assert "VERDICT: in-flight KV fence(s)" in text
+    doc["fences"] = {}
+    text = "\n".join(doctor.verdict(doc))
+    assert "slow inside local compute" in text
+
+
+def test_doctor_load_captures_and_cli(tmp_path, capsys):
+    uri = str(tmp_path / "pool.uri")
+    cap = f"{uri}.doctor.s5.json"
+    with open(cap, "w") as fh:
+        json.dump(_capture_doc(), fh)
+    # a direct capture path and a uri glob both resolve
+    assert doctor.load_captures(cap)[0]["sid"] == 5
+    assert doctor.load_captures(uri)[0]["sid"] == 5
+    assert doctor.main([uri]) == 0
+    out = capsys.readouterr().out
+    assert "ABSENT ranks [6]" in out and "flight recorder" in out
+    assert doctor.main([uri, "--job", "0x9", "--stacks"]) == 0
+    out = capsys.readouterr().out
+    assert "dvm-s5-r0" in out
+    # a tid mismatch filters everything out -> exit 1 with the hint
+    assert doctor.main([uri, "--job", "0x8"]) == 1
+    assert "obs_watchdog_ms" in capsys.readouterr().err
+
+
+# -- live pool: byte identity + watchdog -------------------------------------
+
+def _pool_run(tmp_path, name, tag):
+    jax = pytest.importorskip("jax")
+    from ompi_tpu.tools.dvm import DVMServer, DvmClient
+    uri = str(tmp_path / f"{name}.uri")
+    srv = DVMServer(4, devices=jax.devices(), uri_file=uri).start()
+    try:
+        with DvmClient(uri) as c:
+            sid = c.attach(2)["sid"]
+            r = c.run(sid, PROG, [tag], timeout=120)
+            c.detach(sid)
+        return r
+    finally:
+        srv.stop()
+
+
+def test_traced_watchdog_run_byte_identical(tmp_path):
+    """Tier-1 identity gate: request tagging + an armed watchdog must
+    never perturb job output — same prog, same DIGEST line."""
+    plain = _pool_run(tmp_path, "plain", "bi")
+    assert plain["code"] == 0, plain.get("stderr", "")[-2000:]
+    registry.set("obs_reqtrace_enable", "1")
+    registry.set("obs_watchdog_ms", "100")
+    traced = _pool_run(tmp_path, "traced", "bi")
+    assert traced["code"] == 0, traced.get("stderr", "")[-2000:]
+    assert traced["stdout"] == plain["stdout"]
+    assert "DIGEST bi " in plain["stdout"]
+
+
+def test_watchdog_suppression_and_single_capture(tmp_path):
+    """Below the stall factor: ZERO doctor events.  Above: exactly one
+    capture per job (the wd_fired latch), with the capture persisted
+    next to the uri file and carrying the request tid."""
+    jax = pytest.importorskip("jax")
+    from ompi_tpu.tools.dvm import DVMServer, DvmClient
+    registry.set("obs_reqtrace_enable", "1")
+    registry.set("obs_watchdog_ms", "100")     # tick every 50 ms
+    uri = str(tmp_path / "wd.uri")
+    srv = DVMServer(4, devices=jax.devices(), uri_file=uri).start()
+    try:
+        with DvmClient(uri) as c:
+            resp = c.attach(2)
+            sid, tid = resp["sid"], resp["tid"]
+            # sharpen the estimator to a deterministic 100 ms
+            assert c.run(sid, PROG, ["warm"],
+                         timeout=120)["code"] == 0
+            srv.est_wall_us = 100_000
+            # slow-but-below-threshold: 1.5 s sleep vs a 200 s limit
+            registry.set("obs_watchdog_factor", "2000")
+            assert c.run(sid, SLOW_PROG, [],
+                         timeout=120)["code"] == 0
+            assert srv.doctor_reports == []
+            # above threshold (200 ms limit): exactly ONE capture
+            srv.est_wall_us = 100_000
+            registry.set("obs_watchdog_factor", "2")
+            assert c.run(sid, SLOW_PROG, [],
+                         timeout=120)["code"] == 0
+            assert len(srv.doctor_reports) == 1
+            doc = srv.doctor_reports[0]
+            assert doc["sid"] == sid and doc["tid"] == tid
+            assert doc["mttd_ms"] >= 0
+            assert doc["stacks"]
+            # nothing rendezvous-blocked during a sleep: the verdict
+            # falls through to local compute
+            assert "slow inside local compute" in \
+                "\n".join(doctor.verdict(doc))
+            cap = f"{uri}.doctor.s{sid}.json"
+            assert os.path.isfile(cap)
+            assert doctor.load_captures(cap)[0]["sid"] == sid
+            # the wd_stall flight event fired exactly once
+            names = [e["name"] for e in obs.recorder().snapshot(256)]
+            assert names.count("wd_stall") == 1
+            c.detach(sid)
+    finally:
+        srv.stop()
+
+
+def test_watchdog_off_by_default(tmp_path):
+    import time as _time
+    jax = pytest.importorskip("jax")
+    from ompi_tpu.tools.dvm import DVMServer
+    # drain any watchdog thread a prior test's halted pool left in
+    # its last 50 ms sleep
+    for _ in range(40):
+        if not any(t.name == "dvm-watchdog"
+                   for t in threading.enumerate()):
+            break
+        _time.sleep(0.05)
+    uri = str(tmp_path / "off.uri")
+    srv = DVMServer(4, devices=jax.devices(), uri_file=uri).start()
+    try:
+        assert not any(t.name == "dvm-watchdog"
+                       for t in threading.enumerate())
+    finally:
+        srv.stop()
+
+
+# -- operator surfaces -------------------------------------------------------
+
+def test_attach_events_dropped_note(tmp_path, capsys):
+    """A compacted ring is never a silent short tail: the note says
+    how many events are gone and why."""
+    from ompi_tpu.tools import attach
+    uri = str(tmp_path / "gone.uri")     # no pool at this uri
+    with open(f"{uri}.events.json", "w") as fh:
+        json.dump({"rank": -1, "flight": True, "recorded": 100,
+                   "dropped": 60,
+                   "events": [{"name": "dvm_run", "cat": "flight",
+                               "ph": "i", "ts": 1.0, "rank": -1,
+                               "args": {}}] * 40}, fh)
+    assert attach.main([uri, "--events", "8"]) == 0
+    out = capsys.readouterr().out
+    assert "60 older event(s) of 100 recorded were dropped" in out
+    assert "obs_events_ring" in out
+
+
+def test_prometheus_scoped_hist_series():
+    """Per-session SLI histograms export as labeled percentile gauges
+    in the 0.0.4 text format: one family, session + q labels."""
+    sh = obs.scoped_hist("dvm_sli_test_qwait_us")
+    sh.add_us(100, band=7)
+    sh.add_us(200, band=7)
+    m = obs.local_metrics(events=0)
+    text = obs.prometheus_text(m)
+    assert "# TYPE ompi_tpu_dvm_sli_test_qwait_us gauge" in text
+    assert 'ompi_tpu_dvm_sli_test_qwait_us{q="p99"}' in text
+    assert ('ompi_tpu_dvm_sli_test_qwait_us{session="7",q="p99"}'
+            in text)
+    # 0.0.4: every non-comment line is "name{labels} value"
+    for ln in text.strip().splitlines():
+        assert ln.startswith("#") or " " in ln
+
+
+def test_hotpath_audit_covers_reqtrace_and_watchdog():
+    from ompi_tpu.tools import hotpath_audit
+    assert "Tracer.req_mark" in hotpath_audit.HOT_FUNCTIONS[
+        "ompi_tpu/trace/__init__.py"]
+    assert "DVMServer._watchdog_tick" in hotpath_audit.HOT_FUNCTIONS[
+        "ompi_tpu/tools/dvm.py"]
+    assert hotpath_audit.audit() == []
