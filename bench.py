@@ -212,6 +212,17 @@ def main() -> None:
                          "under 'probe_ctrlplane' in "
                          "BENCH_DETAIL.json, and FAIL (exit 1) on "
                          "any failed job or hung worker")
+    ap.add_argument("--probe-grayfail", action="store_true",
+                    help="Chaos-close the gray-failure plane: a "
+                         "2-host pool with one slow-but-alive host "
+                         "(slow beats + 10x-stalled resident ranks) "
+                         "must detect, quarantine and migrate around "
+                         "it — mitigated goodput >= 2x unmitigated, "
+                         "MTTM <= 4x the health tick, zero false "
+                         "quarantines on a healthy fleet, zero "
+                         "failed jobs; persist under 'probe_grayfail' "
+                         "in BENCH_DETAIL.json, and FAIL (exit 1) if "
+                         "any gate breaks")
     ap.add_argument("--rma-max-bytes", type=int, default=None,
                     help="Cap the --probe-rma size ladder (the full "
                          "64 MiB curve wants real accelerator "
@@ -572,6 +583,43 @@ def main() -> None:
             sys.exit(1)
         return
 
+    if opts.probe_grayfail:
+        from benchmarks.probe_grayfail import persist, run_probe
+
+        probe = run_probe()
+        notes = persist(probe, detail_path)
+        mit = probe["mitigated"]
+        line = {
+            "metric": f"gray-failure plane, {probe['hosts']}-host "
+                      f"pool with one {probe['slow_factor']}x-slowed "
+                      f"host: detect + quarantine + migrate",
+            "value": probe["goodput_ratio"],
+            "unit": "mitigated_vs_unmitigated_goodput",
+            "mttm_ms": probe["mttm_ms"],
+            "mttm_budget_ms": probe["mttm_budget_ms"],
+            "mitigated_jobs": mit["goodput_jobs"],
+            "unmitigated_jobs": probe["unmitigated"]["goodput_jobs"],
+            "false_quarantines": probe["false_quarantines"],
+            "failed_jobs": probe["failed_jobs"],
+            "migrations": mit.get("migrations", 0),
+            "within_budget": probe["within_budget"],
+        }
+        line.update({k: v for k, v in notes.items() if "error" in k})
+        sys.stderr.write(json.dumps(probe, indent=1) + "\n")
+        print(json.dumps(line))
+        if not probe["within_budget"]:
+            sys.stderr.write(
+                f"FAIL: grayfail probe — goodput ratio "
+                f"{probe['goodput_ratio']}x (floor "
+                f"{probe['ratio_floor']}x), mttm "
+                f"{probe['mttm_ms']}ms (budget "
+                f"{probe['mttm_budget_ms']}ms), false_quarantines="
+                f"{probe['false_quarantines']}, failed_jobs="
+                f"{probe['failed_jobs']}, healthy_ok="
+                f"{probe['healthy']['healthy_ok']}\n")
+            sys.exit(1)
+        return
+
     if opts.probe_ctrlplane:
         from benchmarks.probe_ctrlplane import persist, run_probe
 
@@ -797,6 +845,7 @@ def main() -> None:
                                     "probe_serve", "probe_obs",
                                     "probe_fleet", "probe_rma",
                                     "probe_ctrlplane", "probe_reqtrace",
+                                    "probe_grayfail",
                                     "regress_trajectory")
                           if isinstance(prior, dict) and k in prior},
                        "device_us": dev, "software_us": sw,

@@ -1,0 +1,353 @@
+"""--probe-grayfail microbench: the gray-failure plane (ISSUE 19,
+DESIGN.md §24), proven against a live in-process 2-host pool with
+thread-driven host agents (exact control of beat pacing — the probe
+IS the clock):
+
+1. **Healthy arm (false-positive gate).**  Both hosts beat crisply at
+   the agent's own grace/6 pacing while a submitter streams jobs.
+   The claim: ZERO quarantines (the ``fleet_quarantines`` pvar does
+   not move), no host ever reaches `quarantined`, and every job
+   completes — the plane must cost nothing on a healthy fleet.
+
+2. **Slow-host arm, unmitigated (the baseline the plane must beat).**
+   ``health_enable=0``: host 1 beats slow AND its resident ranks
+   crawl (the ``host_slow`` ft_inject class delays every device-
+   collective deposit by ``delay_ms*(factor-1)``), exactly the
+   alive-but-10x-slow gray failure.  Every np-2 job spans both
+   domains (static banding), so the whole pool runs at the
+   straggler's speed — goodput over a fixed window is the denominator.
+
+3. **Slow-host arm, mitigated.**  Same fault, health plane armed.
+   The beat-interval score trips the hysteresis ladder (healthy ->
+   degraded -> quarantined), the quarantine drains the resident
+   session through the park/resume machinery, and the replay brings
+   it up banded onto host 0 only — after MTTM the pool runs at full
+   speed again.  Gates: mitigated goodput >= RATIO_FLOOR (2x) of
+   unmitigated, MTTM <= 4x the health tick period, zero failed jobs,
+   and the slow host is never declared DEAD (``_host_dead[1] == 0``
+   throughout — the liveness plane must not fire on a gray failure).
+
+Results land in BENCH_DETAIL.json under ``probe_grayfail``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List
+
+HOSTS = 2
+CAPACITY = 2              # one np-2 session spans both domains
+HB_S = 0.2                # dvm_heartbeat_s: hb-loop period
+HOST_GRACE_S = 0.1        # oob_host_grace_s: static floor = 0.7 s
+TICK_MS = 150             # health_tick_ms: below the hb-loop period,
+                          # so the tick fires on EVERY loop wake and
+                          # the effective period is the loop's 200 ms
+TRIP_TICKS = 1            # probe-sized hysteresis (2 rungs = 2 ticks)
+CLEAR_TICKS = 4
+DELAY_MS = 40             # ft_inject_delay_ms: slow rank stalls
+SLOW_FACTOR = 10          # ft_inject_host_slow_factor
+CRISP_BEAT_S = 0.1       # healthy agent pacing (~grace/6)
+SLOW_BEAT_S = 0.5        # slow-but-alive: < grace (0.7 s), > 3x expect
+MEASURE_S = 6.0           # goodput window per slow arm
+HEALTHY_S = 2.5           # healthy-arm traffic window
+RATIO_FLOOR = 2.0         # mitigated/unmitigated goodput gate
+MTTM_TICKS = 4            # MTTM budget in health tick periods
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PROG = os.path.join(REPO, "tests", "_dvm_prog.py")
+
+# every knob the probe tightens, with its probe value; saved/restored
+# around the whole run so nothing leaks into the caller's registry
+_KNOBS = {
+    "dvm_heartbeat_s": HB_S,
+    "oob_host_grace_s": HOST_GRACE_S,
+    "health_tick_ms": TICK_MS,
+    "health_trip_ticks": TRIP_TICKS,
+    "health_clear_ticks": CLEAR_TICKS,
+    "ft_inject_delay_ms": DELAY_MS,
+    "ft_inject_host_slow_factor": SLOW_FACTOR,
+    "ft_inject_victim_host": 1,
+    # the arms flip these; listed here so the caller's values are
+    # restored even if an arm dies mid-flight
+    "health_enable": 1,
+    "ft_inject_plan": "",
+}
+
+
+def _pv(name: str) -> int:
+    from ompi_tpu.mca.params import registry
+    return int(registry._pvars[name].read())
+
+
+class _Beater(threading.Thread):
+    """One in-process host agent: registers its domain on the pool
+    port and beats at ``interval_s`` — the probe flips the interval
+    to turn a crisp host into a slow-but-alive one at a precise
+    instant (a real tpud subprocess would add scheduler noise to the
+    MTTM measurement)."""
+
+    def __init__(self, uri: str, host: int, interval_s: float) -> None:
+        super().__init__(daemon=True, name=f"grayfail-beat-{host}")
+        self.uri = uri
+        self.host = host
+        self.interval_s = interval_s
+        self.stop_ev = threading.Event()
+        self.registered = threading.Event()
+
+    def run(self) -> None:
+        from ompi_tpu.tools.dvm import DvmClient, DvmDisconnect, \
+            DvmError
+        try:
+            with DvmClient(self.uri, connect_timeout=10.0) as cli:
+                cli._rpc({"op": "host_register", "host": self.host,
+                          "pid": os.getpid()})
+                self.registered.set()
+                while not self.stop_ev.wait(self.interval_s):
+                    cli._rpc({"op": "host_beat", "host": self.host})
+        except (DvmError, DvmDisconnect, OSError):
+            pass  # pool stopping under us ends the beat stream
+
+    def halt(self) -> None:
+        self.stop_ev.set()
+
+
+def _new_pool(tmpdir: str, tag: str):
+    import jax
+
+    from ompi_tpu.tools.dvm import DVMServer
+    uri = os.path.join(tmpdir, f"grayfail-{tag}-{time.time_ns()}.uri")
+    srv = DVMServer(CAPACITY, devices=jax.devices(), uri_file=uri,
+                    hosts=HOSTS)
+    srv.start()
+    return srv, uri
+
+
+def _pool_up(tmpdir: str, tag: str):
+    """Pool + both thread agents beating crisply, ready for attach."""
+    srv, uri = _new_pool(tmpdir, tag)
+    beaters = [_Beater(uri, h, CRISP_BEAT_S) for h in range(HOSTS)]
+    for b in beaters:
+        b.start()
+    for b in beaters:
+        if not b.registered.wait(timeout=30):
+            raise RuntimeError(f"host {b.host} agent never registered")
+    return srv, uri, beaters
+
+
+def _pool_down(srv, beaters) -> None:
+    for b in beaters:
+        b.halt()
+    srv.stop()
+    for b in beaters:
+        b.join(timeout=10)
+
+
+def _stream_jobs(uri: str, stop_at: List[float],
+                 done_ts: List[float], errs: List[str]) -> None:
+    """One submitter: a resident np-2 session re-running PROG until
+    told to stop.  Run failures are collected, never swallowed — the
+    zero-failed-jobs gate reads ``errs``."""
+    from ompi_tpu.tools.dvm import DvmClient
+    try:
+        with DvmClient(uri) as cli:
+            sid = cli.attach(2, timeout=120)["sid"]
+            while time.monotonic() < stop_at[0]:
+                r = cli.run(sid, PROG, timeout=180)
+                if r["code"] != 0:
+                    raise RuntimeError(f"rc={r['code']}: "
+                                       f"{r['stderr'][-200:]}")
+                done_ts.append(time.monotonic())
+            cli.detach(sid)
+    except Exception as e:  # noqa: BLE001
+        errs.append(str(e))
+
+
+# -- arm 1: healthy fleet, plane armed — zero false quarantines -------------
+
+
+def _arm_healthy(tmpdir: str) -> Dict:
+    q0 = _pv("fleet_quarantines")
+    srv, uri, beaters = _pool_up(tmpdir, "healthy")
+    try:
+        stop_at = [time.monotonic() + 3600.0]
+        done_ts: List[float] = []
+        errs: List[str] = []
+        th = threading.Thread(target=_stream_jobs,
+                              args=(uri, stop_at, done_ts, errs))
+        th.start()
+        time.sleep(HEALTHY_S)
+        stop_at[0] = 0.0
+        th.join(timeout=300)
+        hp = srv.health
+        worst = max(hp.state) if hp is not None else -1
+        false_q = _pv("fleet_quarantines") - q0
+        return {
+            "window_s": HEALTHY_S,
+            "jobs_done": len(done_ts),
+            "jobs_failed": len(errs),
+            "failures": errs[:3],
+            "false_quarantines": false_q,
+            "worst_state": worst,
+            "healthy_ok": bool(not errs and done_ts
+                               and false_q == 0 and worst < 2),
+        }
+    finally:
+        _pool_down(srv, beaters)
+
+
+# -- arms 2+3: slow host, unmitigated vs mitigated --------------------------
+
+
+def _arm_slow(tmpdir: str, mitigated: bool) -> Dict:
+    """Host 1 turns gray at t0 (slow beats + slow resident ranks);
+    goodput is the completed-job count in [t0, t0 + MEASURE_S].  With
+    the plane armed the MTTM clock runs t0 -> quarantine applied."""
+    from ompi_tpu.mca.params import registry
+
+    registry.set("health_enable", 1 if mitigated else 0)
+    # host_slow armed for the whole arm: the per-state injector cache
+    # is built at world bring-up, so arming must precede the attach.
+    # Rank stalls before t0 only slow the warm-up run.
+    registry.set("ft_inject_plan", "host_slow")
+    try:
+        tag = "mit" if mitigated else "unmit"
+        srv, uri, beaters = _pool_up(tmpdir, tag)
+        try:
+            stop_at = [time.monotonic() + 3600.0]
+            done_ts: List[float] = []
+            errs: List[str] = []
+            th = threading.Thread(target=_stream_jobs,
+                                  args=(uri, stop_at, done_ts, errs))
+            th.start()
+            # warm-up: the session world is up and the crisp beat
+            # EWMA is established before the fault begins
+            deadline = time.monotonic() + 60
+            while not done_ts and time.monotonic() < deadline:
+                time.sleep(0.02)
+            if not done_ts:
+                raise RuntimeError("warm-up run never completed: "
+                                   + "; ".join(errs[:1]))
+            time.sleep(3 * CRISP_BEAT_S)
+
+            t0 = time.monotonic()
+            beaters[1].interval_s = SLOW_BEAT_S  # the gray failure
+            mttm_ms = -1.0
+            if mitigated:
+                while time.monotonic() < t0 + 30:
+                    if srv._health_applied[1] >= 2:
+                        mttm_ms = (time.monotonic() - t0) * 1e3
+                        break
+                    time.sleep(0.005)
+            stop_at[0] = t0 + MEASURE_S
+            th.join(timeout=300)
+            goodput = sum(1 for ts in done_ts if ts >= t0)
+            never_dead = bool(srv._host_dead[1] == 0)
+            out = {
+                "window_s": MEASURE_S,
+                "goodput_jobs": goodput,
+                "jobs_failed": len(errs),
+                "failures": errs[:3],
+                "slow_host_never_dead": never_dead,
+            }
+            if mitigated:
+                hp = srv.health
+                out["mttm_ms"] = round(mttm_ms, 1)
+                out["quarantined"] = bool(srv._health_applied[1] >= 2)
+                out["migrations"] = _pv("fleet_migrations")
+                out["final_state"] = (hp.state[1]
+                                      if hp is not None else -1)
+            return out
+        finally:
+            _pool_down(srv, beaters)
+    finally:
+        registry.set("ft_inject_plan", "")
+        registry.set("health_enable", 1)
+
+
+def run_probe() -> Dict:
+    import tempfile
+
+    # the save/restore below needs every touched knob REGISTERED
+    # (an unregistered knob reads back None, which would then be
+    # "restored" as a None override): import the registering modules
+    import ompi_tpu.ft_inject  # noqa: F401
+    import ompi_tpu.obs.health  # noqa: F401
+    import ompi_tpu.runtime.oob  # noqa: F401
+    import ompi_tpu.tools.dvm  # noqa: F401
+    from ompi_tpu.mca.params import registry
+
+    saved = {k: registry.get(k) for k in _KNOBS}
+    for k, v in _KNOBS.items():
+        registry.set(k, v)
+    tmpdir = tempfile.mkdtemp(prefix="probe_grayfail_")
+    try:
+        healthy = _arm_healthy(tmpdir)
+        unmit = _arm_slow(tmpdir, mitigated=False)
+        mit = _arm_slow(tmpdir, mitigated=True)
+    finally:
+        import shutil
+        shutil.rmtree(tmpdir, ignore_errors=True)
+        for k, v in saved.items():
+            registry.set(k, v)
+    ratio = (mit["goodput_jobs"] / unmit["goodput_jobs"]
+             if unmit["goodput_jobs"] > 0 else 0.0)
+    # the detector's latency contract has two terms: the overdue-beat
+    # horizon (a beat must be 3x late before the score can move — a
+    # floor set by the expected beat interval, not the tick), then at
+    # most MTTM_TICKS effective tick periods for the hysteresis ladder
+    # to walk healthy -> degraded -> quarantined.  The tick rides the
+    # pool heartbeat loop, so its effective period is the larger of
+    # the two knobs.
+    expect_ms = max(50.0, (3 * HB_S + HOST_GRACE_S) / 6 * 1000)
+    mttm_budget_ms = int(3 * expect_ms
+                         + MTTM_TICKS * max(TICK_MS, HB_S * 1000))
+    failed = (healthy["jobs_failed"] + unmit["jobs_failed"]
+              + mit["jobs_failed"])
+    ok = bool(
+        healthy["healthy_ok"]
+        and ratio >= RATIO_FLOOR
+        and 0 < mit["mttm_ms"] <= mttm_budget_ms
+        and mit["quarantined"]
+        and unmit["slow_host_never_dead"]
+        and mit["slow_host_never_dead"]
+        and failed == 0)
+    return {
+        "hosts": HOSTS,
+        "agent": "in-process thread beaters (host_register/host_beat)",
+        "slow_factor": SLOW_FACTOR,
+        "healthy": healthy,
+        "unmitigated": unmit,
+        "mitigated": mit,
+        "goodput_ratio": round(ratio, 2),
+        "ratio_floor": RATIO_FLOOR,
+        "mttm_ms": mit["mttm_ms"],
+        "mttm_budget_ms": mttm_budget_ms,
+        "false_quarantines": healthy["false_quarantines"],
+        "failed_jobs": failed,
+        "within_budget": ok,
+    }
+
+
+def persist(probe: Dict, detail_path: str) -> Dict:
+    """Merge under 'probe_grayfail' in BENCH_DETAIL.json, preserving
+    every other section (the probe_fleet pattern)."""
+    notes: Dict = {}
+    try:
+        with open(detail_path) as fh:
+            detail = json.load(fh)
+        if not isinstance(detail, dict):
+            detail = {}
+    except (OSError, ValueError):
+        detail = {}
+    detail["probe_grayfail"] = probe
+    try:
+        tmp = f"{detail_path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as fh:
+            json.dump(detail, fh, indent=1)
+        os.replace(tmp, detail_path)
+    except OSError as e:
+        notes["detail_error"] = str(e)[:120]
+    return notes

@@ -90,6 +90,15 @@ TOLERANCES: Dict[str, Tuple[str, float]] = {
     # 2 x obs_watchdog_ms; the band absorbs watchdog-tick phase)
     "queue_wait_p99_us": ("lower", 100000.0),
     "doctor_mttd_ms": ("lower", 200.0),
+    # gray-failure plane sentries (ISSUE 19): slow-start -> quarantine
+    # applied (budget 4x the probe's 300 ms health tick; the band
+    # absorbs a tick or two of phase), mitigated-vs-unmitigated
+    # goodput (relative — a broken drain/re-placement halves it), and
+    # false quarantines on the healthy arm, which must stay EXACTLY
+    # zero (the 0.5 absolute band means any nonzero count regresses)
+    "grayfail_mttm_ms": ("lower", 2000.0),
+    "grayfail_goodput_ratio": ("higher", 0.25),
+    "false_quarantines": ("lower", 0.5),
 }
 
 
@@ -220,6 +229,19 @@ def _detail_metrics(detail: dict) -> Dict[str, float]:
         v = rp.get(key)
         if isinstance(v, (int, float)) and v > 0:
             out[key] = float(v)
+    gf = detail.get("probe_grayfail") or {}
+    v = gf.get("mttm_ms")
+    if isinstance(v, (int, float)) and v > 0:
+        out["grayfail_mttm_ms"] = float(v)
+    v = gf.get("goodput_ratio")
+    if isinstance(v, (int, float)) and v > 0:
+        out["grayfail_goodput_ratio"] = float(v)
+    v = gf.get("false_quarantines")
+    # v >= 0 on purpose: the required value IS zero — the v > 0
+    # pattern used above would drop the healthy samples and leave the
+    # sentry blind to the first false quarantine
+    if isinstance(v, (int, float)) and v >= 0:
+        out["false_quarantines"] = float(v)
     return out
 
 

@@ -175,6 +175,11 @@ class TcpModule(BTLModule):
         self._delayed: list = []  # (due_t, conn, frame) injector holds
         from ompi_tpu import ft_inject
         self._inj = ft_inject.btl_injector(state.rank)
+        # gray-failure shaping (DESIGN.md §24): seeded latency/loss
+        # on outbound frames — drops ride the reliable sublayer's
+        # NACK/RTO replay, delays reuse the 'delay' hold queue
+        self._nj = ft_inject.net_jitter_injector(state.rank,
+                                                 scope="tcp_net")
         # inbound sockets double as idle-selector wakeup fds: a rank
         # parked in idle_wait unblocks the moment bytes arrive
         state.progress.register_idle_fd(self.listener.fileno())
@@ -364,6 +369,14 @@ class TcpModule(BTLModule):
             if self._inj is not None \
                     and self._inject(conn, frame, peer):
                 return
+            if self._nj is not None:
+                d = self._nj.maybe_delay_s()
+                if d:
+                    if self._nj.should_drop():
+                        return  # NACK/RTO replays from unacked
+                    self._delayed.append(
+                        (time.monotonic() + d, conn, frame))
+                    return
         conn.txq.append(frame)
         self._drain(conn)
 

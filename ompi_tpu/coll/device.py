@@ -298,6 +298,22 @@ def _coll_sever_injector(state):
     return inj
 
 
+def _coll_slow_injector(state):
+    """ft_inject 'host_slow' (the GRAY failure, DESIGN.md §24): every
+    rank resident on ft_inject_victim_host stalls a deterministic
+    delay_ms*(factor-1) before each deposit — the whole host crawls
+    while its heartbeats keep flowing, which is exactly the shape the
+    health plane must catch (cached per rank-state; False =
+    disarmed or this rank lives elsewhere)."""
+    inj = state.__dict__.get("_coll_slow_inj")
+    if inj is None:
+        from ompi_tpu import ft_inject
+        node = getattr(getattr(state, "rte", None), "node_id", 0)
+        inj = ft_inject.host_slow_injector(node) or False
+        state._coll_slow_inj = inj
+    return inj
+
+
 def _sever_hold(abort_check) -> None:
     """The wedge itself: hold THIS rank before it deposits, in small
     abort-checked sleeps, so the hang doctor finds a live stall (peers
@@ -618,6 +634,9 @@ def meet(comm, value, fn, abort_check) -> Any:
         d = inj.maybe_delay()
         if d:
             time.sleep(d)
+    sl = _coll_slow_injector(comm.state)
+    if sl:
+        time.sleep(sl.delay_s())
     sv = _coll_sever_injector(comm.state)
     if sv and sv.should_sever():
         _sever_hold(abort_check)
@@ -677,6 +696,9 @@ def meet_begin(comm, value, fn, abort_check):
         d = inj.maybe_delay()
         if d:
             time.sleep(d)
+    sl = _coll_slow_injector(comm.state)
+    if sl:
+        time.sleep(sl.delay_s())
     sv = _coll_sever_injector(comm.state)
     if sv and sv.should_sever():
         _sever_hold(abort_check)

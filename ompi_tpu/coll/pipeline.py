@@ -609,12 +609,26 @@ def _hier_plan(comm) -> Tuple[Any, Optional[Any]]:
     plan = comm.__dict__.get("_hier_plan")
     if plan is None:
         from ompi_tpu.comm.communicator import UNDEFINED
+        from ompi_tpu.obs import health as _health
         from ompi_tpu.topo import topo as topomod
         groups = topomod.slice_groups(comm, _hier_slice_var.value)
         mine = next(i for i, g in enumerate(groups) if comm.rank in g)
-        intra = comm.split(mine, key=comm.rank)
+        # gray-failure reroute (DESIGN.md §24): a rank resident on a
+        # degraded host biases its OWN split key past every healthy
+        # rank's, so the slice leader (intra.rank 0 = smallest key)
+        # lands on a healthy host whenever the slice has one.  The
+        # split outcome is computed from the GATHERED keys, so even
+        # if members read the mask at slightly different moments the
+        # result stays collectively consistent — only the ordering
+        # can differ between plans built at different times, never
+        # membership, and the plan is built (and cached) once,
+        # collectively, right here.
+        node = getattr(getattr(comm.state, "rte", None), "node_id", 0)
+        key = comm.rank + (comm.size
+                           if _health.node_degraded(node) else 0)
+        intra = comm.split(mine, key=key)
         lead = comm.split(0 if intra.rank == 0 else UNDEFINED,
-                          key=comm.rank)
+                          key=key)
         plan = (intra, lead)
         comm.__dict__["_hier_plan"] = plan
     return plan

@@ -45,13 +45,18 @@ _plan_var = registry.register(
          "delay, dup, reorder, corrupt, sever, daemon_kill, "
          "oob_sever, kv_partition, rank_kill, io_stall, io_partial, "
          "io_enospc, dvm_disconnect, rma_delay, kv_kill, dvm_kill, "
-         "host_kill, rdv_sever (for the kill classes the number is "
-         "the armed OP COUNT the control-plane process dies at, not "
-         "a rate; host_kill severs ft_inject_victim_host's whole "
-         "failure domain — daemon plus every resident rank; "
-         "rdv_sever wedges ft_inject_victim_rank at its Nth "
-         "device-collective rendezvous — the hang-doctor test "
-         "target).  Empty = framework disabled")
+         "host_kill, rdv_sever, host_slow, net_jitter (for the kill "
+         "classes the number is the armed OP COUNT the control-plane "
+         "process dies at, not a rate; host_kill severs "
+         "ft_inject_victim_host's whole failure domain — daemon plus "
+         "every resident rank; rdv_sever wedges "
+         "ft_inject_victim_rank at its Nth device-collective "
+         "rendezvous — the hang-doctor test target; host_slow is the "
+         "GRAY failure: ft_inject_victim_host stays alive but every "
+         "resident rank and its heartbeat run "
+         "ft_inject_host_slow_factor times slow; net_jitter shapes "
+         "seeded latency/loss onto the tcp + KV client paths).  "
+         "Empty = framework disabled")
 _rate_var = registry.register(
     "ft", "inject", "rate", 0.02, float,
     help="Default per-event injection probability for plan entries "
@@ -87,6 +92,22 @@ _delay_ms_var = registry.register(
     "ft", "inject", "delay_ms", 20, int,
     help="How long a 'delay'-class frame is held before hitting the "
          "wire")
+_slow_factor_var = registry.register(
+    "ft", "inject", "host_slow_factor", 10, int,
+    help="Slowdown multiplier the host_slow gray-failure scenario "
+         "applies to ft_inject_victim_host: resident ranks stall "
+         "delay_ms*(factor-1) at every device-collective deposit and "
+         "the host agent beats factor times slower — alive, never "
+         "silent")
+_jitter_ms_var = registry.register(
+    "ft", "inject", "net_jitter_ms", 5, int,
+    help="Mean added latency (milliseconds) of the net_jitter class; "
+         "each hit sleeps a seeded uniform draw in [0, 2*mean]")
+_jitter_loss_var = registry.register(
+    "ft", "inject", "net_jitter_loss", 0.0, float,
+    help="Per-event probability a net_jitter hit also DROPS the "
+         "frame (tcp path only — the reliable sublayer retransmits; "
+         "KV ops are never dropped, only delayed)")
 
 BTL_CLASSES = ("drop", "delay", "dup", "reorder", "corrupt", "sever")
 NODE_CLASSES = ("daemon_kill", "oob_sever")
@@ -130,6 +151,16 @@ HOST_CLASSES = ("host_kill",)
 # (DESIGN.md §23) must diagnose: "rank R absent from cid C gen G".
 # The hold is abort-aware, so the doctor's poison unwinds it cleanly.
 RDV_CLASSES = ("rdv_sever",)
+# GRAY failure (DESIGN.md §24): the host stays alive — heartbeats
+# keep flowing, just slow — while every resident rank crawls.  No
+# liveness plane ever fires; only the health plane's scoring can see
+# it.  Deterministic (a factor, not a rate): the victim is
+# ft_inject_victim_host, reusing the host_kill victim knob.
+SLOW_CLASSES = ("host_slow",)
+# seeded latency/loss shaping on the tcp + KV client paths — the
+# network-flakiness half of gray failure (jitter feeds the health
+# plane's beat-jitter signal instead of tripping any death path)
+NET_CLASSES = ("net_jitter",)
 
 
 def plan() -> Dict[str, float]:
@@ -455,6 +486,93 @@ def rank_kill_victim() -> int:
     """First armed victim (compat shim for single-victim callers)."""
     v = victim_ranks()
     return v[0] if v else -1
+
+
+class HostSlowInjector:
+    """Deterministic gray-failure slowdown for one host's residents.
+    No RNG, no op counting: the victim is simply SLOW, everywhere,
+    from the first op — ``delay_s()`` is the stall a resident rank
+    adds at every device-collective deposit, ``beat_interval_s(iv)``
+    is the inflated heartbeat pacing of the host agent.  Both derive
+    from delay_ms and host_slow_factor, so a 10x-slow chaos run
+    replays bit-for-bit with zero seeds involved."""
+
+    def __init__(self, host: int) -> None:
+        self.host = host
+        self._announced = False
+
+    @property
+    def factor(self) -> int:
+        return max(2, _slow_factor_var.value)
+
+    def delay_s(self) -> float:
+        """Per-deposit stall of a resident rank: delay_ms scaled so
+        the victim runs ~factor times slower than a clean rank whose
+        per-op cost is about one delay_ms."""
+        self._announce()
+        return max(0, _delay_ms_var.value) * (self.factor - 1) / 1000.0
+
+    def beat_interval_s(self, iv: float, grace: float = 0.0) -> float:
+        """The host agent's inflated beat pacing: alive, never silent
+        — the beat EWMA drifts up instead of the grace tripping.
+        Capped at 3/4 of the liveness grace when the caller knows it:
+        a gray host delays its heartbeats, it does not stop them —
+        uncapped inflation (factor*iv > grace) would be host_kill in
+        disguise and fire the WRONG plane."""
+        self._announce()
+        slow = iv * self.factor
+        if grace > 0:
+            cap = grace * 0.75
+            if slow > cap:
+                slow = max(iv, cap)
+        return slow
+
+    def _announce(self) -> None:
+        if self._announced:
+            return
+        self._announced = True
+        from ompi_tpu import obs as _obs
+        _obs.record_event(_obs.EV_FT_INJECT,
+                          _obs.intern("host_slow"),
+                          _obs.intern("host"))
+
+
+def host_slow_injector(host: int) -> Optional[HostSlowInjector]:
+    """Armed only on ft_inject_victim_host's residents (rank-threads
+    consult with their node_id, the tpud agent with its host id)."""
+    if "host_slow" not in plan() or host != _victim_host_var.value:
+        return None
+    return HostSlowInjector(host)
+
+
+class NetJitterInjector(_Scoped):
+    """Seeded latency/loss shaping on the network client paths (btl
+    tcp frames, KV ops).  A 'net_jitter' roll sleeps a uniform draw
+    in [0, 2*net_jitter_ms]; on the tcp path it may also drop the
+    frame with net_jitter_loss probability (the reliable sublayer
+    retransmits — KV callers never see a drop, only added RTT, which
+    is exactly what the health plane's kv_rtt signal scores)."""
+
+    def maybe_delay_s(self) -> float:
+        """Returns seconds to hold the op/frame (0 = clean)."""
+        if self._roll() == "net_jitter":
+            ms = max(0, _jitter_ms_var.value)
+            return self._rng.uniform(0.0, 2.0 * ms) / 1000.0
+        return 0.0
+
+    def should_drop(self) -> bool:
+        """tcp frames only: a seeded loss decision taken AFTER a
+        jitter hit (callers pair it with maybe_delay_s)."""
+        loss = _jitter_loss_var.value
+        return loss > 0 and self._rng.random() < loss
+
+
+def net_jitter_injector(rank: int,
+                        scope: str = "net") -> Optional[NetJitterInjector]:
+    p = {c: r for c, r in plan().items() if c in NET_CLASSES}
+    if not p:
+        return None
+    return NetJitterInjector(scope, rank, p)
 
 
 def after_s() -> float:

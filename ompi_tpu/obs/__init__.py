@@ -296,6 +296,12 @@ EV_REQ_PARK = 27
 EV_REQ_RESUME = 28
 EV_WD_STALL = 29
 EV_REQ_DRAIN = 30
+# gray-failure health plane (DESIGN.md §24): hysteresis transitions
+# on the host state machine plus the quarantine drain-and-migrate
+EV_HOST_DEGRADED = 31
+EV_HOST_QUARANTINE = 32
+EV_HOST_RECOVERED = 33
+EV_MIGRATE = 34
 
 EVENT_NAMES = (
     "ulfm_detect", "ulfm_revoke", "ulfm_agree", "ulfm_shrink",
@@ -305,7 +311,8 @@ EVENT_NAMES = (
     "dvm_resize", "dvm_quota", "ctrl_adjust", "kv_failover",
     "dvm_rehydrate", "dvm_replay", "host_lost", "host_respawn",
     "req_attach", "req_run", "req_park", "req_resume", "wd_stall",
-    "req_drain",
+    "req_drain", "host_degraded", "host_quarantine", "host_recovered",
+    "dvm_migrate",
 )
 
 # Per-type argument field names (positional a0..a3); a trailing "$"
@@ -343,6 +350,10 @@ EVENT_FIELDS = (
     ("sid", "tid", "us"),                    # req_resume
     ("sid", "tid", "run_ms", "est_ms"),      # wd_stall
     ("band", "epoch", "us"),                 # req_drain
+    ("host", "score", "state"),              # host_degraded
+    ("host", "score", "sessions"),           # host_quarantine
+    ("host", "score"),                       # host_recovered
+    ("sid", "host", "us"),                   # dvm_migrate
 )
 
 # interned strings for event args (reason/cls/scope): the ring holds
@@ -861,6 +872,23 @@ def prometheus_text(metrics: Dict[str, Any],
                 lines.append(f'{prefix}_{hname}'
                              f'{{session="{_prom_escape(str(band))}",'
                              f'q="{tag}"}} {p.get(tag, 0.0)}')
+    # per-host gray-failure health rows (DESIGN.md §24): numeric
+    # state (0 healthy / 1 degraded / 2 quarantined) + score as one
+    # host-labeled family each, so alerting can key on max() directly
+    hh = metrics.get("host_health")
+    if hh:
+        lines.append(f"# TYPE {prefix}_host_health_state gauge")
+        for row in hh:
+            st = row.get("state", "healthy")
+            code = st if isinstance(st, int) else \
+                {"healthy": 0, "degraded": 1, "quarantined": 2}.get(st, 0)
+            lines.append(f'{prefix}_host_health_state'
+                         f'{{host="{row.get("host", 0)}"}} {code}')
+        lines.append(f"# TYPE {prefix}_host_health_score gauge")
+        for row in hh:
+            lines.append(f'{prefix}_host_health_score'
+                         f'{{host="{row.get("host", 0)}"}} '
+                         f'{row.get("score", 0)}')
     pct = metrics.get("percentiles", {})
     if pct:
         lines.append(f"# TYPE {prefix}_latency_us gauge")

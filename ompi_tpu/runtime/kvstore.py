@@ -853,6 +853,10 @@ class KVClient:
         from ompi_tpu import ft_inject
         self._inj = ft_inject.kv_injector(
             int(os.environ.get("TPUMPI_RANK", "0")))
+        # gray-failure shaping (DESIGN.md §24): seeded added latency
+        # on every KV op — the health plane's kv_rtt signal target
+        self._nj = ft_inject.net_jitter_injector(
+            int(os.environ.get("TPUMPI_RANK", "0")), scope="kv_net")
 
     def _connect(self) -> socket.socket:
         # with a standby available, fail a dead endpoint fast and
@@ -932,6 +936,12 @@ class KVClient:
                     from ompi_tpu.runtime import oob
                     time.sleep(oob.backoff_s(backoffs, delay, cap=2.0))
                     backoffs += 1
+            if self._nj is not None:
+                # net_jitter: delay only, never a drop — KV callers
+                # see added RTT, exactly what the health plane scores
+                d = self._nj.maybe_delay_s()
+                if d:
+                    time.sleep(d)
             with self._lock:
                 if self._inj is not None and self._inj.sever():
                     # injected partition: close the socket under our

@@ -108,10 +108,21 @@ class FleetController:
         margin = 100 + depth * 25
         if margin > self.margin_max:
             margin = self.margin_max
-        self.shed_margin_pct = margin
         wf = self.wd_base_pct + depth * 25
         if wf > self.wd_base_pct * 2:
             wf = self.wd_base_pct * 2
+        hp = getattr(srv, "health", None)
+        if hp is not None and hp.degraded_n > 0:
+            # gray-failure mitigation (DESIGN.md §24): a degraded
+            # host runs slow ON PURPOSE while the health plane holds
+            # it — widen the shed margin and the watchdog tolerance
+            # by 1.5x so the estimator and the hang doctor don't
+            # punish sessions the fleet chose not to migrate yet
+            margin = margin + (margin >> 1)
+            if margin > self.margin_max:
+                margin = self.margin_max
+            wf = wf + (wf >> 1)
+        self.shed_margin_pct = margin
         self.wd_factor_pct = wf
         if depth >= self.grow_depth and cap < self.ceil:
             want = cap + self.grow_step

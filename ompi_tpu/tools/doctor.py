@@ -101,6 +101,33 @@ def _fence_lines(doc: dict) -> List[str]:
     return out
 
 
+def _straggler_lines(doc: dict) -> List[str]:
+    """Gray-failure discrimination (DESIGN.md §24): the capture
+    carries the pool's per-host health rows and the session's rank
+    placement.  A session stalled with ranks resident on a host the
+    health plane already scores sick is a STRAGGLER case — the ranks
+    are arriving (just consistently last), so the absent-rank
+    diagnosis would be wrong and the fix is migration, not blame."""
+    rows = doc.get("host_health") or []
+    placement = doc.get("placement") or []
+    out: List[str] = []
+    for row in rows:
+        state = row.get("state", "healthy")
+        if state == "healthy" or row.get("excluded"):
+            continue
+        host = row.get("host")
+        ranks = [r for r, h in enumerate(placement) if h == host]
+        if not ranks:
+            continue
+        sig = ",".join(row.get("signals") or []) or "beat"
+        out.append(
+            f"  host {host} is {state} (health score "
+            f"{row.get('score')}, signals [{sig}]) — resident "
+            f"rank(s) [{','.join(str(r) for r in ranks)}] are "
+            f"arriving but consistently last")
+    return out
+
+
 def verdict(doc: dict) -> List[str]:
     """The reduced diagnosis for one capture, most specific evidence
     first.  Pure (testable on a dict); returns printable lines."""
@@ -125,6 +152,11 @@ def verdict(doc: dict) -> List[str]:
         lines.append("VERDICT: rank(s) absent from an in-flight "
                      "rendezvous — everyone else is parked waiting:")
         lines.extend(rdv)
+        straggler = _straggler_lines(doc)
+        if straggler:
+            lines.append("  (gray-failure context: the absent "
+                         "rank(s) may be STRAGGLING, not dead —)")
+            lines.extend(straggler)
     if fen:
         if not rdv:
             lines.append("VERDICT: in-flight KV fence(s) never "
@@ -134,10 +166,19 @@ def verdict(doc: dict) -> List[str]:
                          "namespace:)")
         lines.extend(fen)
     if not rdv and not fen:
-        lines.append(
-            "VERDICT: no partially-arrived rendezvous or in-flight "
-            "fence — the session is slow inside local compute (see "
-            "stacks), not blocked on a peer")
+        straggler = _straggler_lines(doc)
+        if straggler:
+            lines.append(
+                "VERDICT: straggler — rank(s) on a degraded host ARE "
+                "arriving, just last every time; migrate the session "
+                "(or quarantine the host), don't hunt for an absent "
+                "rank:")
+            lines.extend(straggler)
+        else:
+            lines.append(
+                "VERDICT: no partially-arrived rendezvous or "
+                "in-flight fence — the session is slow inside local "
+                "compute (see stacks), not blocked on a peer")
     nstk = len(doc.get("stacks") or {})
     if nstk:
         lines.append(f"  {nstk} rank stack(s) captured "

@@ -539,6 +539,10 @@ class _Session:
         # stalled run fires exactly one doctor capture
         self.run_start_ns = 0
         self.wd_fired = False
+        # health-plane placement override (DESIGN.md §24): rank ->
+        # host band stamped at _bringup when any domain is degraded
+        # or quarantined.  None = the static contiguous banding.
+        self.placement: Optional[List[int]] = None
 
     def remember_done(self, jobid: str, code: int) -> None:
         self.completed[jobid] = code
@@ -651,6 +655,15 @@ class DVMServer:
         # lost domains not yet replaced: read by FleetController.tick
         # as a shrink inhibitor (a fleet mid-rehydration is not idle)
         self.hosts_rehydrating = 0
+        # gray-failure health plane (ISSUE 19, DESIGN.md §24): scores
+        # slow-but-alive domains and drives the degrade/quarantine
+        # mitigation ladder.  None on single-host pools and when
+        # health_enable=0 — every consumer null-checks.
+        self.health: Any = None
+        # last health state _health_collect applied per host: the
+        # delta against HealthPlane.state tells escalation from
+        # recovery when transitions are drained
+        self._health_applied = [0] * self.hosts
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -675,6 +688,16 @@ class DVMServer:
             # host_kill is in-process safe (no os._exit): embedded
             # pools arm it too, unlike dvm_kill
             self._hkill = _fi.host_kill_injector()
+            from ompi_tpu.obs import health as _health
+            if _health._enable_var.value:
+                # expected beat interval mirrors the agent's own
+                # pacing (tools/tpud beats at grace/6); the adaptive
+                # grace floors at the static horizon computed above
+                self.health = _health.HealthPlane(
+                    self.hosts,
+                    expect_beat_ns=max(50_000_000,
+                                       self._host_grace_ns // 6),
+                    floor_grace_ns=self._host_grace_ns)
         _pv_hosts_active.add(self.hosts)
         self.listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self.listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -837,6 +860,15 @@ class DVMServer:
             if self.hosts > 1 \
                     and self._host_tick(time.perf_counter_ns()):
                 self._host_collect()
+            # gray-failure plane (DESIGN.md §24): same split — the
+            # audited score/hysteresis tick latches transitions, the
+            # cold collect applies the mitigation ladder (the skew
+            # corroboration sample is cold too: pure reads)
+            hp = self.health
+            if hp is not None:
+                self._health_sample(hp)
+                if hp.tick(time.perf_counter_ns()):
+                    self._health_collect()
             j = self._journal
             if j is not None:
                 j.tick()  # flush buffered bookkeeping records
@@ -957,7 +989,13 @@ class DVMServer:
                             "hosts": self.hosts,
                             "hosts_lost": sum(self._host_dead),
                             "hosts_rehydrating":
-                                self.hosts_rehydrating})
+                                self.hosts_rehydrating,
+                            "hosts_degraded":
+                                self.health.degraded_n
+                                if self.health else 0,
+                            "hosts_quarantined":
+                                self.health.quarantined_n
+                                if self.health else 0})
             return False
         if op == "host_register":
             # DCN control path: a tpud host agent (one per failure
@@ -981,7 +1019,13 @@ class DVMServer:
         if op == "host_beat":
             h = int(msg.get("host", -1))
             if 0 <= h < self.hosts and self._host_dead[h] == 0:
-                self._host_beat[h] = time.perf_counter_ns()
+                now = time.perf_counter_ns()
+                self._host_beat[h] = now
+                if self.health is not None:
+                    # feeds the shared beat estimator: inter-arrival
+                    # EWMA + jitter drive both the health score and
+                    # the adaptive per-host liveness grace
+                    self.health.note_beat(h, now)
             conn.reply({"ok": True})
             return False
         if op == "host_kill":
@@ -1246,6 +1290,8 @@ class DVMServer:
             "hosts": self.hosts,
             "hosts_lost": sum(self._host_dead),
             "hosts_rehydrating": self.hosts_rehydrating,
+            "host_health": (self.health.snapshot()
+                            if self.health is not None else None),
             "ctrl": None if self.ctrl is None else {
                 "ticks": self.ctrl.ticks,
                 "shed_margin_pct": self.ctrl.shed_margin_pct,
@@ -1442,9 +1488,15 @@ class DVMServer:
         """Ranks of `sess` resident on host domain `h` — the same
         contiguous banding _bringup stamps into each rank's node_id,
         so liveness, placement and the modex all agree on who lives
-        where."""
+        where.  A health-plane placement override (sess.placement,
+        stamped at _bringup when a domain is degraded/quarantined)
+        wins over the static banding — liveness must kill exactly the
+        ranks that actually live on the dead host."""
         if self.hosts < 2:
             return list(range(sess.np)) if h == 0 else []
+        if sess.placement is not None:
+            return [r for r in range(sess.np)
+                    if sess.placement[r] == h]
         return [r for r in range(sess.np)
                 if r * self.hosts // sess.np == h]
 
@@ -1461,13 +1513,23 @@ class DVMServer:
         beat = self._host_beat
         dead = self._host_dead
         pend = self._host_pending
+        hp = self.health
+        graces = hp.grace_ns if hp is not None else None
         n = self.hosts
         hit = 0
         h = 0
         while h < n:
             b = beat[h]
+            # adaptive per-host grace (DESIGN.md §24): the shared
+            # beat estimator widens a jittery-but-alive host's
+            # horizon and keeps a crisp host at the static floor
+            g = grace
+            if graces is not None:
+                g = graces[h]
+                if g < grace:
+                    g = grace
             if b > 0 and dead[h] == 0 and pend[h] == 0 \
-                    and now - b > grace:
+                    and now - b > g:
                 pend[h] = 1
                 hit += 1
             h += 1
@@ -1514,6 +1576,12 @@ class DVMServer:
             sessions = list(self.sessions.values())
         _pv_hosts_lost.add(1)
         _pv_hosts_active.add(-1)
+        if self.health is not None:
+            # a dead domain leaves the gray-failure sweep: the
+            # liveness plane owns it now (scores/state reset so a
+            # respawned host starts healthy with fresh estimates)
+            self.health.exclude(h, True)
+            self._health_applied[h] = 0
         lost_sids: List[int] = []
         nranks = 0
         for sess in sessions:
@@ -1621,6 +1689,8 @@ class DVMServer:
             self._host_lost_ns[h] = 0
             self.hosts_rehydrating = max(0, self.hosts_rehydrating - 1)
             sids = self._host_lost_sids.pop(h, [])
+        if self.health is not None:
+            self.health.exclude(h, False)
         if h > 0 and self.uri_file and self._journal is not None:
             jh = _Journal(self._journal_path(h))
             self._hjournals[h] = jh
@@ -1652,6 +1722,192 @@ class DVMServer:
             f"({len(sids)} session(s) rehydrating)\n")
         self._pump()
         return mttr_ms
+
+    # -- gray-failure health plane (DESIGN.md §24) -------------------------
+
+    def _health_collect(self) -> None:
+        """Cold half of the gray-failure plane: drain the transitions
+        the audited tick latched and walk the mitigation ladder —
+        degraded stops new placement (and reroutes hier leaders,
+        widens deadlines), quarantined drains-and-migrates, recovery
+        walks back down.  Never declares death: that stays the
+        liveness plane's job."""
+        hp = self.health
+        if hp is None:
+            return
+        from ompi_tpu.obs import health as _health
+        tr = trace.global_tracer()
+        for h in hp.collect():
+            new = hp.state[h]
+            old = self._health_applied[h]
+            self._health_applied[h] = new
+            score = hp.score[h]
+            if new > old and new == _health.DEGRADED:
+                _obs.record_event(_obs.EV_HOST_DEGRADED, h, score, new)
+                if tr is not None:
+                    tr.instant("host_degraded", "fleet", host=h,
+                               score=score)
+                sys.stderr.write(
+                    f"tpu-dvm: host {h} DEGRADED (score {score}, "
+                    f"signals {','.join(hp.tripped(h)) or 'beat'}) — "
+                    f"new placements avoid it, deadlines widened\n")
+            elif new > old and new == _health.QUARANTINED:
+                hp.note_quarantine()
+                moved = self._quarantine_drain(h)
+                _obs.record_event(_obs.EV_HOST_QUARANTINE, h, score,
+                                  moved)
+                if tr is not None:
+                    tr.instant("host_quarantine", "fleet", host=h,
+                               score=score, sessions=moved)
+                sys.stderr.write(
+                    f"tpu-dvm: host {h} QUARANTINED (score {score}) — "
+                    f"{moved} session(s) draining onto healthy "
+                    f"domains\n")
+                if _health._respawn_var.value:
+                    # operator opted into cycling the offender: the
+                    # death path is safe here because the drain just
+                    # parked every resident (never-failed-jobs holds)
+                    self.kill_host(h)
+                    self.respawn_host(h)
+                    hp.exclude(h, False)
+                    self._health_applied[h] = 0
+            elif new < old:
+                _obs.record_event(_obs.EV_HOST_RECOVERED, h, score)
+                if tr is not None:
+                    tr.instant("host_recovered", "fleet", host=h,
+                               score=score)
+                sys.stderr.write(
+                    f"tpu-dvm: host {h} recovered to "
+                    f"{_health.STATE_NAMES[new]} (score {score})\n")
+
+    def _health_sample(self, hp) -> None:
+        """Cold corroboration sweep (rides the heartbeat loop):
+        approximate per-host rendezvous-wait microseconds from each
+        resident rank's straggler-skew histogram (trace.HIST_RDV_WAIT,
+        the PR 13 phase gauge) and feed the cross-host SKEW to the
+        health plane — attributed to the host everyone else waits FOR
+        (stragglers arrive last, so their own rdv_wait is the
+        smallest)."""
+        tot = [0] * self.hosts
+        cnt = [0] * self.hosts
+        with self.lock:
+            sessions = list(self.sessions.values())
+        for sess in sessions:
+            states = sess.states
+            for r in range(len(states)):
+                st = states[r]
+                if st is None:
+                    continue
+                tr_ = getattr(st, "tracer", None)
+                if tr_ is None:
+                    continue
+                h = self._place_node(sess, r)
+                if not 0 <= h < self.hosts:
+                    continue
+                hist = tr_.hists[trace.HIST_RDV_WAIT]
+                us = 0
+                for b in range(len(hist)):
+                    c = hist[b]
+                    if c:
+                        us += c * (1 << b) >> 1  # mid-bucket estimate
+                tot[h] += us
+                cnt[h] += 1
+        lo_h = -1
+        lo_v = -1
+        hi_v = -1
+        for h in range(self.hosts):
+            if cnt[h] == 0:
+                continue
+            avg = tot[h] // cnt[h]
+            if lo_v < 0 or avg < lo_v:
+                lo_v = avg
+                lo_h = h
+            if avg > hi_v:
+                hi_v = avg
+        if lo_h >= 0 and hi_v > lo_v:
+            hp.note_rdv_skew(lo_h, hi_v - lo_v)
+
+    def _quarantine_drain(self, h: int) -> int:
+        """Drain-and-migrate every session resident on quarantined
+        host `h` through the PR 12 preemption machinery: running
+        sessions are poisoned with preempt_requested (the run replays
+        from checkpoint after re-bringup — the client sees a slower
+        run, never a failed one), idle sessions are parked directly.
+        The next _bringup places them off the quarantined domain
+        (_plan_placement skips non-healthy hosts).  No ULFM
+        publication, no KV crash, no journal close: the host is ALIVE
+        — just too slow to serve."""
+        hp = self.health
+        with self.lock:
+            sessions = list(self.sessions.values())
+        moved = 0
+        t0 = time.perf_counter_ns()
+        for sess in sessions:
+            ranks = self.host_ranks(sess, h)
+            if not ranks:
+                continue
+            park = False
+            with sess.lock:
+                if sess.dead or sess.parked or sess.world is None:
+                    continue
+                if sess.running:
+                    sess.preempt_requested = True
+                    self._poison_session(
+                        sess, 75, f"host {h} quarantined (migrating)")
+                else:
+                    sess.preempt_requested = False
+                    sess.parked = True
+                    park = True
+            if park:
+                self._park(sess)
+            moved += 1
+            us = (time.perf_counter_ns() - t0) // 1000
+            _obs.record_event(_obs.EV_MIGRATE, sess.sid, h, us)
+        if moved and hp is not None:
+            hp.note_migration(moved)
+        return moved
+
+    def _plan_placement(self, np_: int) -> Optional[List[int]]:
+        """Rank->host bands for a new (or re-admitted) session.  All
+        domains healthy: None — the static contiguous banding
+        `rank*hosts//np` stays byte-for-byte what PR 16 shipped.  Any
+        domain degraded/quarantined/dead: band over the healthy-host
+        list only, so new placements simply never land on a sick
+        domain (the §17 admission path is unchanged — capacity still
+        gates; this only decides WHERE)."""
+        if self.hosts < 2:
+            return None
+        hp = self.health
+        healthy = [h for h in range(self.hosts)
+                   if self._host_dead[h] == 0
+                   and (hp is None or hp.placement_ok(h))]
+        if len(healthy) == self.hosts:
+            return None
+        if not healthy:
+            # every domain sick: fall back to the static banding
+            # rather than refusing service (degraded > dead)
+            return None
+        return [healthy[r * len(healthy) // np_] for r in range(np_)]
+
+    def _place_node(self, sess: _Session, rank: int) -> int:
+        if self.hosts < 2:
+            return 0
+        if sess.placement is not None:
+            return sess.placement[rank]
+        return rank * self.hosts // sess.np
+
+    def _touches_degraded(self, sess: _Session) -> bool:
+        """Does any of this session's resident ranks live on a
+        degraded (or worse) domain?  Drives the deadline-widening arm
+        of the mitigation ladder."""
+        hp = self.health
+        if hp is None or self.hosts < 2:
+            return False
+        for r in range(sess.np):
+            h = self._place_node(sess, r)
+            if hp.state[h] >= 1 and hp.excluded[h] == 0:
+                return True
+        return False
 
     # -- admission ---------------------------------------------------------
 
@@ -1829,6 +2085,13 @@ class DVMServer:
         _pv_attaches.add(1)
         _pv_queue_wait_us.add(queued_us, sess.sid)
         _pv_sli_qwait.add_us(queued_us, sess.sid)
+        if self.health is not None and queued_us > 0:
+            # queue-wait SLI corroboration: attributed to the hosts
+            # this session actually landed on (small weight — the
+            # beat estimator stays the load-bearing signal)
+            for h in set(self._place_node(sess, r)
+                         for r in range(sess.np)):
+                self.health.note_queue_wait(h, queued_us)
         _pv_attach_us_max.update_max(attach_us)
         _obs.record_event(_obs.EV_DVM_ATTACH, sess.sid, np_, attach_us)
         if tid:
@@ -1998,7 +2261,15 @@ class DVMServer:
             margin = 100 + 25 * len(self._waiters)
             if margin > 400:
                 margin = 400
-        if est * margin // 100 <= deadline_ms * 1000:
+        eff_deadline = deadline_ms
+        hp = self.health
+        if hp is not None and hp.degraded_n > 0 \
+                and self._touches_degraded(sess):
+            # mitigation ladder (DESIGN.md §24): a session whose ranks
+            # sit on a degraded host runs slow ON PURPOSE — widen its
+            # deadline instead of shedding its work
+            eff_deadline = deadline_ms * hp.widen_pct() // 100
+        if est * margin // 100 <= eff_deadline * 1000:
             return
         _pv_sheds.add(1)
         _obs.record_event(_obs.EV_DVM_SHED, sess.sid, deadline_ms,
@@ -2118,6 +2389,13 @@ class DVMServer:
             "rendezvous": rdvs,
             "fences": fences,
             "events": _obs.recorder().snapshot(64),
+            # gray-failure context (DESIGN.md §24): lets the doctor
+            # tell a STRAGGLER (rank arriving but consistently last,
+            # resident on a scored-sick host) from an absent rank
+            "host_health": (self.health.snapshot()
+                            if self.health is not None else None),
+            "placement": [self._place_node(sess, r)
+                          for r in range(sess.np)],
         }
         self.doctor_reports.append(doc)
         if self.uri_file:
@@ -2210,6 +2488,11 @@ class DVMServer:
         world = HybridWorld(sess.np, 0, sess.np)
         sess.world = world
         sess.states = [None] * sess.np
+        # health-aware placement (DESIGN.md §24): recomputed at every
+        # bring-up — a session parked off a quarantined host comes
+        # back banded onto healthy domains only; with an all-healthy
+        # fleet this is None and the static banding is unchanged
+        sess.placement = self._plan_placement(sess.np)
         errs: List[tuple] = []
 
         def boot(rank: int) -> None:
@@ -2218,8 +2501,7 @@ class DVMServer:
                 # domains — node_id flows into the modex, so topology-
                 # aware consumers (tuned collectives, buddy placement)
                 # see the real placement instead of one flat host
-                node = (rank * self.hosts // sess.np
-                        if self.hosts > 1 else 0)
+                node = self._place_node(sess, rank)
                 rte = SessionRTE(world, rank, self.kv_server.uri,
                                  node_id=node, jobid=sess.jobid,
                                  session_dir=sess.dir, kv_ns=sess.ns)
@@ -2399,7 +2681,21 @@ class DVMServer:
             except SystemExit as e:
                 code = e.code if isinstance(e.code, int) else (
                     0 if e.code is None else 1)
-                if code != 0:
+                from ompi_tpu.ft import ulfm as _ulfm
+                if (isinstance(e, _ulfm.RankKilled)
+                        and getattr(st, "ulfm", None) is not None):
+                    # injected permanent rank death on a ULFM-enabled
+                    # world: publish it like the host-kill path does
+                    # instead of poisoning the session — an aware
+                    # program shrinks around the corpse and the run
+                    # completes (never a failed job); a non-aware one
+                    # dies on the survivors' ERR_PROC_FAILED below
+                    st.ulfm_dead = True
+                    err.write(f"[dvm s{sess.sid} rank {st.rank}] "
+                              f"ft_inject rank_kill: ULFM failure "
+                              f"published, survivors may shrink\n")
+                    _ulfm.publish_world_failure(st.rte.world, st.rank)
+                elif code != 0:
                     with flock:
                         failure[0] = failure[0] or code
                     poison(st, code, "SystemExit")

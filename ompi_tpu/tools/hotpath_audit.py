@@ -121,6 +121,16 @@ HOT_FUNCTIONS: Dict[str, Tuple[str, ...]] = {
         # capture lives off-path in _watchdog_collect
         "DVMServer._watchdog_tick",
     ),
+    # the gray-failure health scoring tick (DESIGN.md §24) rides the
+    # same heartbeat loop as _host_tick whenever health_enable is on
+    # for a multi-host pool: integer EWMA reads, threshold compares
+    # and streak counters over preallocated per-host lists.  State
+    # transitions only LATCH here (pending[h] = 1); the event
+    # recording, quarantine drain and placement rebuild run off-path
+    # in DVMServer._health_collect
+    "ompi_tpu/obs/health.py": (
+        "HealthPlane.tick",
+    ),
 }
 
 _BANNED_BUILTIN_CALLS = ("dict", "list", "set", "tuple", "frozenset")

@@ -105,6 +105,57 @@ def plan_migration(store_dir: str,
     return cmd, rankfile
 
 
+def plan_evacuation(store_dir: str,
+                    node: str) -> Tuple[List[str], str, Dict[int, str]]:
+    """Gray-failure drain (DESIGN.md §24): move EVERY rank of a
+    degraded/quarantined node onto the remaining allocation nodes,
+    round-robin — the whole-host analog of --move, so the operator
+    acting on a `straggler` doctor verdict (or a quarantine event)
+    types one node name instead of N rank moves.  Returns (cmd,
+    rankfile text, the computed moves); pure beyond reads."""
+    with open(os.path.join(store_dir, "job.json")) as f:
+        job = json.load(f)
+    from ompi_tpu.runtime import ras, rmaps
+    nodes = ras.allocate(job.get("hosts"), job.get("hostfile"),
+                         job.get("simulate"), job["np"])
+    names = [n.name for n in nodes]
+    if node not in names:
+        raise ValueError(f"--evacuate: unknown node {node!r} "
+                         f"(allocation has {sorted(names)})")
+    targets = [n for n in names if n != node]
+    if not targets:
+        raise ValueError("--evacuate: no healthy node left to "
+                         "receive the ranks")
+    # effective placement: a prior migration's rankfile wins, else
+    # the original mapping policy (same precedence as plan_migration)
+    placement: Dict[int, str] = {}
+    prior = os.path.join(store_dir, "migrate.rankfile")
+    if os.path.exists(prior):
+        with open(prior) as f:
+            for line in f:
+                line = line.strip()
+                if line.startswith("rank") and "=" in line:
+                    rpart, npart = line[4:].split("=", 1)
+                    placement[int(rpart.strip())] = npart.strip()
+    else:
+        maps = rmaps.map_ranks(nodes, job["np"], 1,
+                               policy=job.get("map_by", "byslot"),
+                               oversubscribe=True)
+        for m in maps:
+            for p in m.procs:
+                for r in range(p.rank_base,
+                               p.rank_base + max(1, p.nlocal)):
+                    placement[r] = m.node.name
+    moves = {r: targets[i % len(targets)]
+             for i, r in enumerate(sorted(
+                 r for r, n in placement.items() if n == node))}
+    if not moves:
+        raise ValueError(f"--evacuate: no rank currently placed on "
+                         f"{node!r}")
+    cmd, rankfile = plan_migration(store_dir, moves)
+    return cmd, rankfile, moves
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     if not argv or argv[0] in ("-h", "--help"):
@@ -117,6 +168,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             "launched with mpirun --ckpt-dir?)\n")
         return 2
     moves: Dict[int, str] = {}
+    evacuate: Optional[str] = None
     extra: List[str] = []
     it = iter(argv[1:])
     for a in it:
@@ -128,15 +180,28 @@ def main(argv: Optional[List[str]] = None) -> int:
             except (StopIteration, ValueError):
                 sys.stderr.write("migrate: --move needs RANK=NODE\n")
                 return 2
+        elif a == "--evacuate":
+            try:
+                evacuate = next(it)
+            except StopIteration:
+                sys.stderr.write("migrate: --evacuate needs NODE\n")
+                return 2
         else:
             extra.append(a)
-    if not moves:
-        sys.stderr.write("migrate: at least one --move RANK=NODE "
-                         "required (plain restart: use "
-                         "ompi_tpu.tools.restart)\n")
+    if not moves and not evacuate:
+        sys.stderr.write("migrate: at least one --move RANK=NODE or "
+                         "--evacuate NODE required (plain restart: "
+                         "use ompi_tpu.tools.restart)\n")
         return 2
     try:
-        cmd, rankfile = plan_migration(store_dir, moves)
+        if evacuate:
+            if moves:
+                raise ValueError("--evacuate and --move are "
+                                 "exclusive (evacuation computes the "
+                                 "moves itself)")
+            cmd, rankfile, moves = plan_evacuation(store_dir, evacuate)
+        else:
+            cmd, rankfile = plan_migration(store_dir, moves)
     except (ValueError, OSError) as e:
         sys.stderr.write(f"migrate: {e}\n")
         return 2

@@ -83,6 +83,18 @@ class HNP:
         self._last_beat: Dict[int, float] = {}
         self._beat_dead: set = set()
         self._grace_timers: Dict[int, threading.Timer] = {}
+        # adaptive liveness grace (DESIGN.md §24): the SAME per-host
+        # beat estimator the DVM pool sweep uses — inter-arrival EWMA
+        # + jitter widen a jittery-but-alive daemon's silence horizon
+        # above the static budget*interval floor, so it is not
+        # declared lost while a crisp daemon keeps the tight floor
+        from ompi_tpu.obs.health import HostBeatEstimator
+        iv0 = max(0.001, oob.heartbeat_interval_var.value or 0.0)
+        floor_s = (max(0, oob.heartbeat_budget_var.value) * iv0
+                   + max(0.0, oob.host_grace_var.value))
+        self._beat_est = HostBeatEstimator(
+            len(maps), floor_ns=max(1, int(floor_s * 1e9)),
+            mult=max(1, oob.heartbeat_budget_var.value))
         # every launch sent per node, for idempotent replay after a
         # daemon reconnect (the daemon dedups by lid): a launch lost
         # in a sever window must not strand the node rankless
@@ -238,6 +250,7 @@ class HNP:
             with self.lock:
                 if node in self._last_beat:
                     self._last_beat[node] = time.monotonic()
+                    self._beat_est.note(node, time.monotonic_ns())
 
     def _grace_expire(self, node: int) -> None:
         with self.lock:
@@ -254,12 +267,17 @@ class HNP:
         # failure domain is declared lost (one knob paces this monitor
         # and the DVM host-liveness plane alike)
         horizon = budget * iv + max(0.0, oob.host_grace_var.value)
+        est = self._beat_est
         while not self._stop:
             time.sleep(iv / 2)
             now = time.monotonic()
             with self.lock:
+                # per-node adaptive horizon, floored at the static
+                # one: a node whose own beat EWMA/jitter says "slow
+                # but alive" earns extra silence before the verdict
                 stale = [n for n, t in self._last_beat.items()
-                         if now - t > horizon
+                         if now - t > max(horizon,
+                                          est.grace_ns(n) / 1e9)
                          and n not in self._beat_dead]
             for node in stale:
                 with self.lock:

@@ -91,6 +91,27 @@ def render(m: dict, events: int = 8) -> str:
         lines.append(f"  fleet: hosts {h_act} active  "
                      f"{h_lost} lost (lifetime)  "
                      f"{m.get('hosts_rehydrating', 0)} rehydrating")
+    # gray-failure health plane (DESIGN.md §24): per-host state,
+    # score, and the signals that tripped it — plus the lifetime
+    # quarantine/migration counters from the fleet_* pvars
+    hh = m.get("host_health")
+    if hh:
+        sick = pv.get("fleet_host_health", 0)
+        lines.append(
+            f"  health: {sick} host(s) not healthy  "
+            f"quarantines {pv.get('fleet_quarantines', 0)}  "
+            f"migrations {pv.get('fleet_migrations', 0)} (lifetime)")
+        for row in hh:
+            state = row.get("state", "healthy")
+            if state == "healthy" and not row.get("signals"):
+                continue  # a quiet fleet keeps a quiet frame
+            sig = ",".join(row.get("signals") or []) or "-"
+            lines.append(
+                f"    host {row.get('host')}: {state:<11} "
+                f"score {row.get('score', 0):>3}  "
+                f"beat_ewma {row.get('beat_ewma_ms', 0)}ms  "
+                f"grace {row.get('grace_ms', 0)}ms  "
+                f"signals [{sig}]")
     # critical-path profiler gauges (DESIGN.md §18): what phase is
     # eating the dispatch budget right now, and how skewed arrivals are
     gating = pv.get("obs_critpath_gating_phase")

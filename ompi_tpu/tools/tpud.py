@@ -138,6 +138,15 @@ def host_agent(opts) -> int:
         return 1
     grace = float(resp.get("grace_s", 1.0))
     iv = max(0.05, grace / 6.0)
+    from ompi_tpu import ft_inject
+    slow = ft_inject.host_slow_injector(opts.host)
+    if slow is not None:
+        # gray failure (ft_inject host_slow): beat SLOWER — but keep
+        # beating.  The liveness grace must never fire; only the
+        # health plane's beat-EWMA scoring can see this host is sick
+        iv = slow.beat_interval_s(iv, grace=grace)
+        sys.stderr.write(f"{tag}: host_slow armed — beating "
+                         f"{slow.factor}x slow ({iv:.2f}s)\n")
     sys.stderr.write(f"{tag}: registered with fleet incarnation "
                      f"{resp.get('incarnation')} (beat every "
                      f"{iv:.2f}s)\n")

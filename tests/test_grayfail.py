@@ -1,0 +1,492 @@
+"""Gray-failure plane tests (DESIGN.md §24): the health plane must
+see a slow-but-alive host that no liveness grace will ever catch —
+score it from signals the stack already emits, walk the hysteresis
+ladder one rung per sustained streak (never a false trip on a crisp
+host), stop placing on it when degraded, drain-and-migrate when
+quarantined (never a failed job), and recover one rung per clean
+streak.  The chaos matrix combines host_slow with rank_kill on the
+OTHER host: ULFM shrink completes byte-identically while the slow
+host stays degraded-not-dead."""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from ompi_tpu.mca.params import registry
+
+jax = pytest.importorskip("jax")
+
+# knob registration happens at import: an unregistered knob reads back
+# None from the registry, which _restore would then "restore" as a
+# None override and crash the coercion
+import ompi_tpu.ft_inject  # noqa: E402,F401
+import ompi_tpu.runtime.oob  # noqa: E402,F401
+from ompi_tpu.obs import health as _health  # noqa: E402
+from ompi_tpu.obs.health import (DEGRADED, HEALTHY,  # noqa: E402
+                                 QUARANTINED, HealthPlane,
+                                 HostBeatEstimator, node_degraded)
+from ompi_tpu.tools.dvm import DVMServer, DvmClient  # noqa: E402
+
+HERE = os.path.dirname(__file__)
+PROG = os.path.join(HERE, "_dvm_session_prog.py")
+HOST_PROG = os.path.join(HERE, "_fleet_host_prog.py")
+
+MS = 1_000_000  # ns per ms
+
+
+def _set(vals):
+    saved = {k: registry.get(k) for k in vals}
+    for k, v in vals.items():
+        registry.set(k, v)
+    return saved
+
+
+def _restore(saved):
+    for k, v in saved.items():
+        registry.set(k, v)
+
+
+def _pv(name):
+    return registry._pvars[name].read()
+
+
+def _pool2(tmp_path, capacity):
+    uri = str(tmp_path / "dvm.uri")
+    srv = DVMServer(capacity, devices=jax.devices(), uri_file=uri,
+                    hosts=2).start()
+    return srv, uri
+
+
+def _wait_for(pred, timeout=30.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _lines(stdout, kind, tag):
+    out = []
+    for line in stdout.splitlines():
+        parts = line.split()
+        if len(parts) >= 3 and parts[0] == kind and parts[1] == tag:
+            out.append(parts[2:])
+    return out
+
+
+def _reset_health(srv):
+    """Leave no process-global residue (degraded-mask bits, the
+    fleet_host_health level gauge) for later tests in this process."""
+    hp = srv.health
+    if hp is None:
+        return
+    for h in range(hp.hosts):
+        hp.reset_host(h)
+    hp.collect()
+
+
+class _Beater(threading.Thread):
+    """An in-process stand-in for a tpud host agent: registers on the
+    pool port and beats at a test-controlled pace — exact slow-beat
+    control without subprocess scheduler noise."""
+
+    def __init__(self, uri, host, interval_s):
+        super().__init__(daemon=True)
+        self.uri = uri
+        self.host = host
+        self.interval_s = interval_s
+        self._halt = threading.Event()
+
+    def halt(self):
+        self._halt.set()
+
+    def run(self):
+        c = DvmClient(self.uri)
+        try:
+            c._rpc({"op": "host_register", "host": self.host,
+                    "pid": os.getpid()})
+            while not self._halt.wait(self.interval_s):
+                c._rpc({"op": "host_beat", "host": self.host})
+        except Exception:
+            pass  # server stopping tears the socket under us
+        finally:
+            try:
+                c.sock.close()
+            except Exception:
+                pass
+
+
+# -- tentpole: the audited hot tick -----------------------------------------
+
+
+def test_health_tick_is_hotpath_audited():
+    """HealthPlane.tick is DECLARED hot (so a refactor that starts
+    allocating on the heartbeat sweep fails tier-1) and currently
+    passes the audit."""
+    from ompi_tpu.tools import hotpath_audit
+    funcs = hotpath_audit.HOT_FUNCTIONS.get("ompi_tpu/obs/health.py")
+    assert funcs and "HealthPlane.tick" in funcs
+    assert hotpath_audit.audit() == []
+
+
+# -- tentpole: hysteresis state machine (synthetic time, no pool) ------------
+
+
+def test_hysteresis_ladder_one_rung_per_streak():
+    """A slow host escalates healthy -> degraded -> quarantined one
+    rung per trip streak and recovers one rung per clear streak; the
+    crisp host beside it never trips (zero false positives)."""
+    saved = _set({"health_enable": 1, "health_tick_ms": 1,
+                  "health_trip_ticks": 2, "health_clear_ticks": 2,
+                  "health_degrade_score": 40,
+                  "health_quarantine_score": 75})
+    base_lvl = _pv("fleet_host_health")
+    try:
+        expect = 100 * MS
+        hp = HealthPlane(2, expect_beat_ns=expect,
+                         floor_grace_ns=1000 * MS)
+        t0 = 1000 * MS  # nonzero epoch: last_ns == 0 means never-beaten
+        hp.note_beat(0, t0)
+        hp.note_beat(1, t0)
+        states = []
+        t = t0
+        for i in range(1, 9):  # host 1 beats once per 800ms
+            t = t0 + i * expect
+            hp.note_beat(0, t)
+            if i % 8 == 0:
+                hp.note_beat(1, t)
+            hp.tick(t + 1)
+            states.append(hp.state[1])
+            if hp.pending[1]:
+                assert hp.collect() == [1]
+        # overdue rule scored host 1 before its slow beat ever arrived
+        # (at t=400ms since=4x expect), then the ladder walked
+        # 0 -> 1 -> 2 with trip_ticks=2 per rung — never skipping one
+        assert states[-1] == QUARANTINED
+        for a, b in zip(states, states[1:]):
+            assert b - a <= 1, f"ladder skipped a rung: {states}"
+        assert DEGRADED in states, states
+        assert not hp.placement_ok(1) and hp.placement_ok(0)
+        assert node_degraded(1) and not node_degraded(0)
+        assert _pv("fleet_host_health") == base_lvl + 1
+        assert hp.snapshot()[1]["state"] == "quarantined"
+
+        # crisp host 0: no trips, ever
+        assert hp.state[0] == HEALTHY and hp.score[0] == 0
+
+        # recovery: crisp beats drain the EWMA, one rung per clear
+        # streak back to healthy
+        down = []
+        for i in range(1, 16):
+            t += expect
+            hp.note_beat(0, t)
+            hp.note_beat(1, t)
+            hp.tick(t + 1)
+            down.append(hp.state[1])
+            if hp.pending[1]:
+                assert hp.collect() == [1]
+        assert down[-1] == HEALTHY, down
+        for a, b in zip(down, down[1:]):
+            assert a - b <= 1, f"recovery skipped a rung: {down}"
+        assert DEGRADED in down, down
+        hp.collect()
+        assert not node_degraded(1)
+        assert _pv("fleet_host_health") == base_lvl
+    finally:
+        _restore(saved)
+        _health.set_degraded_mask(0)
+
+
+def test_overdue_beat_scores_before_arrival():
+    """Detection must not wait for a 10x-slowed beat to arrive: once
+    a beat is 3x late the gap itself replaces the EWMA.  A host that
+    NEVER beat belongs to the liveness plane and is skipped."""
+    saved = _set({"health_enable": 1, "health_tick_ms": 1})
+    try:
+        expect = 100 * MS
+        hp = HealthPlane(2, expect_beat_ns=expect,
+                         floor_grace_ns=1000 * MS)
+        t = 0
+        for i in range(5):  # crisp EWMA for host 0; host 1 never beats
+            t = i * expect
+            hp.note_beat(0, t)
+        hp.tick(t + 1)
+        assert hp.score[0] == 0
+        hp.tick(t + 9 * expect)  # silence: 9x overdue, no new beat
+        assert hp.score[0] == 100
+        assert hp.score[1] == 0 and hp.up_streak[1] == 0
+    finally:
+        _restore(saved)
+
+
+# -- satellite: adaptive host-liveness grace ---------------------------------
+
+
+def test_adaptive_grace_floor_and_widening():
+    """A crisp host sits exactly at the static floor; a jittery-but-
+    alive host widens its own grace past it (so the liveness plane
+    stops declaring it dead); the consumer's beat pacing multiplier
+    is honored."""
+    saved = _set({"health_grace_jitter_k": 4})
+    try:
+        floor = 1000 * MS
+        est = HostBeatEstimator(2, floor_ns=floor, mult=6)
+        t0, t1 = 0, 0
+        for _ in range(10):  # host 0: metronome 100ms beats
+            t0 += 100 * MS
+            est.note(0, t0)
+        assert est.grace_ns(0) == floor
+        for i in range(10):  # host 1: alternating 50ms / 450ms
+            t1 += (50 if i % 2 == 0 else 450) * MS
+            est.note(1, t1)
+        assert est.grace_ns(1) > floor
+        assert est.grace_ns(99) == floor  # out-of-range: static floor
+
+        # mult mirrors the consumer's pacing (tpud beats at grace/6,
+        # the HNP daemon at its own budget): 12 * 100ms clears a 1s
+        # floor where 6 * 100ms sat on it
+        est12 = HostBeatEstimator(1, floor_ns=floor, mult=12)
+        t = 0
+        for _ in range(10):
+            t += 100 * MS
+            est12.note(0, t)
+        assert est12.grace_ns(0) > floor
+    finally:
+        _restore(saved)
+
+
+# -- satellite: doctor straggler verdict -------------------------------------
+
+
+def test_doctor_straggler_verdict():
+    """A stalled session with no absent rank but ranks resident on a
+    host the health plane scores sick gets the STRAGGLER verdict —
+    naming the host, its score, and the resident ranks — instead of
+    the absent-rank hunt."""
+    from ompi_tpu.tools.doctor import verdict
+    doc = {"sid": 3, "np": 4, "ns": "s3", "run_ms": 900,
+           "est_ms": 100, "factor_pct": 300, "mttd_ms": 12,
+           "placement": [0, 0, 1, 1],
+           "host_health": [
+               {"host": 0, "state": "healthy", "score": 0,
+                "signals": [], "excluded": False},
+               {"host": 1, "state": "degraded", "score": 62,
+                "signals": ["beat_slow", "rdv_skew"],
+                "excluded": False}]}
+    text = "\n".join(verdict(doc))
+    assert "VERDICT: straggler" in text
+    assert "host 1 is degraded" in text and "score 62" in text
+    assert "[2,3]" in text and "beat_slow" in text
+
+    # same capture, healthy fleet: no straggler story to tell
+    doc["host_health"][1]["state"] = "healthy"
+    text = "\n".join(verdict(doc))
+    assert "straggler" not in text
+    assert "local compute" in text
+
+    # an EXCLUDED (dead) host is the liveness plane's case, not a
+    # gray-failure one
+    doc["host_health"][1]["state"] = "quarantined"
+    doc["host_health"][1]["excluded"] = True
+    text = "\n".join(verdict(doc))
+    assert "straggler" not in text
+
+
+# -- satellite: whole-host evacuation via migrate ----------------------------
+
+
+def test_migrate_evacuate_plans_whole_host(tmp_path):
+    """--evacuate NODE computes the per-rank moves itself: every rank
+    of the sick node lands round-robin on the remaining allocation;
+    a prior migration's rankfile is the effective placement, so a
+    second evacuation of the now-empty node is an error, not a
+    silent no-op."""
+    from ompi_tpu.tools.migrate import plan_evacuation
+    store = tmp_path / "store"
+    store.mkdir()
+    (store / "job.json").write_text(json.dumps(
+        {"np": 4, "simulate": "2x2", "rpp": 1, "prog": "app.py",
+         "args": [], "map_by": "byslot"}))
+    cmd, rankfile, moves = plan_evacuation(str(store), "sim1")
+    assert moves == {2: "sim0", 3: "sim0"}
+    assert "rank 2=sim0" in rankfile and "rank 3=sim0" in rankfile
+    assert "--restart" in cmd and "--oversubscribe" in cmd
+
+    with pytest.raises(ValueError, match="unknown node"):
+        plan_evacuation(str(store), "nosuch")
+
+    (store / "migrate.rankfile").write_text(rankfile)
+    with pytest.raises(ValueError, match="no rank currently placed"):
+        plan_evacuation(str(store), "sim1")
+
+
+# -- mitigation ladder on a live pool ----------------------------------------
+
+
+def test_quarantine_drains_and_replaces_placement(tmp_path):
+    """A quarantined host drains its residents through the preemption
+    machinery (park, not kill — the host is alive) and the next
+    bring-up bands the session over healthy hosts only; new attaches
+    avoid the quarantined domain too.  The host is never declared
+    dead and nothing fails."""
+    srv, uri = _pool2(tmp_path, 4)
+    base_q = _pv("fleet_quarantines")
+    base_m = _pv("fleet_migrations")
+    c = DvmClient(uri)
+    try:
+        sid = c.attach(4)["sid"]
+        r = c.run(sid, PROG, ["gq"], timeout=120)
+        assert r["code"] == 0, r["stderr"][-2000:]
+        sess = srv.sessions[sid]
+        assert sess.placement is None  # all healthy: static banding
+
+        hp = srv.health
+        hp.state[1] = QUARANTINED
+        hp.pending[1] = 1
+        srv._health_applied[1] = DEGRADED
+        srv._health_collect()
+        assert _pv("fleet_quarantines") == base_q + 1
+        assert _pv("fleet_migrations") == base_m + 1
+        assert sess.parked  # idle resident: parked directly
+
+        r2 = c.run(sid, PROG, ["gq"], timeout=120)
+        assert r2["code"] == 0, r2["stderr"][-2000:]  # never a failed job
+        assert sess.placement == [0, 0, 0, 0]
+        assert r2["stdout"] == r["stdout"]  # placement is identity-free
+
+        c2 = DvmClient(uri)
+        # np-4 session holds all capacity; nothing else fits — check
+        # the planner directly for a fresh admission
+        assert srv._plan_placement(2) == [0, 0]
+        c2.sock.close()
+        assert srv._host_dead[1] == 0  # quarantined, never dead
+        rows = c.metrics()["host_health"]
+        assert rows[1]["state"] == "quarantined"
+        c.detach(sid)
+    finally:
+        c.sock.close()
+        _reset_health(srv)
+        srv.stop()
+
+
+def test_stats_and_metrics_expose_health(tmp_path):
+    """Per-host health rows ride the metrics RPC (top's column, the
+    doctor capture); stats carries the degraded/quarantined counts.
+    A single-host pool has no gray-failure plane to report."""
+    srv, uri = _pool2(tmp_path, 2)
+    c = DvmClient(uri)
+    try:
+        st = c.stats()
+        assert st["hosts_degraded"] == 0
+        assert st["hosts_quarantined"] == 0
+        m = c.metrics()
+        rows = m["host_health"]
+        assert len(rows) == 2
+        for row in rows:
+            assert row["state"] == "healthy" and row["score"] == 0
+            assert row["grace_ms"] > 0
+    finally:
+        c.sock.close()
+        srv.stop()
+
+    uri1 = str(tmp_path / "one.uri")
+    srv1 = DVMServer(2, devices=jax.devices(), uri_file=uri1).start()
+    c1 = DvmClient(uri1)
+    try:
+        assert c1.metrics()["host_health"] is None
+        assert c1.stats()["hosts_degraded"] == 0
+    finally:
+        c1.sock.close()
+        srv1.stop()
+
+
+def test_dead_host_excluded_from_health_plane(tmp_path):
+    """Death stays the liveness plane's case: a killed host leaves
+    the scoring sweep (excluded, state reset) so the gray-failure
+    plane never quarantines a corpse, and a respawned host rejoins
+    healthy with fresh estimates."""
+    srv, uri = _pool2(tmp_path, 4)
+    try:
+        hp = srv.health
+        srv.kill_host(1)
+        assert hp.excluded[1] == 1 and hp.state[1] == HEALTHY
+        assert not hp.placement_ok(1)
+        mttr = srv.respawn_host(1)
+        assert mttr > 0
+        assert hp.excluded[1] == 0 and hp.state[1] == HEALTHY
+        assert hp.placement_ok(1)
+    finally:
+        _reset_health(srv)
+        srv.stop()
+
+
+# -- satellite: chaos matrix — host_slow x rank_kill -------------------------
+
+
+def test_chaos_matrix_host_slow_and_rank_kill(tmp_path):
+    """The gray failure and a hard failure at once: host 1 runs slow
+    (host_slow — beats delayed, residents crawling) while rank_kill
+    takes rank 1 on the HEALTHY host.  ULFM shrink must complete with
+    one consistent failure set and byte-identical survivor digests;
+    the slow host ends DEGRADED — never dead, never quarantined
+    (score can't reach the pinned threshold), zero failed jobs."""
+    saved = _set({
+        "dvm_heartbeat_s": 0.2,
+        "oob_host_grace_s": 0.1,
+        "health_tick_ms": 150,
+        "health_trip_ticks": 1,
+        "health_clear_ticks": 64,       # hold degraded for the run
+        "health_quarantine_score": 101,  # unreachable: score caps at 100
+        "ft_inject_plan": "host_slow,rank_kill",
+        "ft_inject_skip": 0,
+        "ft_inject_victim_host": 1,
+        "ft_inject_victim_rank": "1",
+        "ft_inject_after": 0.3,
+        "ft_inject_delay_ms": 5,
+    })
+    base_q = _pv("fleet_quarantines")
+    srv, uri = _pool2(tmp_path, 4)
+    beaters = [_Beater(uri, 0, 0.08), _Beater(uri, 1, 0.6)]
+    for b in beaters:
+        b.start()
+    c = DvmClient(uri)
+    try:
+        sid = c.attach(4)["sid"]
+        r = c.run(sid, HOST_PROG, ["gm", "40"], timeout=240)
+        assert r["code"] == 0, r["stderr"][-2000:]  # zero failed jobs
+        shrinks = _lines(r["stdout"], "SHRINKS", "gm")
+        digs = _lines(r["stdout"], "DIGEST", "gm")
+        # survivors = 0 (host 0) and 2,3 (the SLOW host — slow ranks
+        # still finish); victim rank 1 exited silently
+        assert sorted(int(s[0]) for s in shrinks) == [0, 2, 3], shrinks
+        assert all(int(s[1]) == 1 for s in shrinks), \
+            f"a survivor saw a torn failure set: {shrinks}"
+        assert len(digs) == 3 and len({d[0] for d in digs}) == 1, digs
+
+        # the slow host is degraded-not-dead: the health plane saw it
+        # (beats 3x slower than host 0's) while the adaptive grace
+        # kept the liveness plane quiet
+        _wait_for(lambda: srv._health_applied[1] >= DEGRADED,
+                  timeout=20, what="host 1 degraded")
+        assert srv._host_dead[1] == 0, "slow host declared DEAD"
+        assert srv.health.state[1] == DEGRADED
+        assert _pv("fleet_quarantines") == base_q  # degraded only
+        # and the healthy host never tripped anything
+        assert srv.health.state[0] == HEALTHY
+        assert srv._health_applied[0] == 0
+        st = c.stats()
+        assert st["hosts_degraded"] >= 1 and st["hosts_lost"] == 0
+        c.detach(sid)
+    finally:
+        for b in beaters:
+            b.halt()
+        c.sock.close()
+        for b in beaters:
+            b.join(timeout=5)
+        _reset_health(srv)
+        srv.stop()
+        _restore(saved)
