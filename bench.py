@@ -223,6 +223,19 @@ def main() -> None:
                          "failed jobs; persist under 'probe_grayfail' "
                          "in BENCH_DETAIL.json, and FAIL (exit 1) if "
                          "any gate breaks")
+    ap.add_argument("--probe-sdc", action="store_true",
+                    help="Chaos-close the silent-data-corruption "
+                         "plane: a fully-checked device mesh with a "
+                         "flip-every-op corrupting rank (detection "
+                         "rate must be 1.0, conviction pinned to the "
+                         "victim chip, every retried result "
+                         "byte-exact), a clean armed arm (zero false "
+                         "positives), and a live 2-host pool where "
+                         "one conviction must quarantine the "
+                         "corrupting host within the MTTQ budget "
+                         "with zero failed jobs; persist under "
+                         "'probe_sdc' in BENCH_DETAIL.json, and FAIL "
+                         "(exit 1) if any gate breaks")
     ap.add_argument("--rma-max-bytes", type=int, default=None,
                     help="Cap the --probe-rma size ladder (the full "
                          "64 MiB curve wants real accelerator "
@@ -327,6 +340,8 @@ def main() -> None:
             "phase_within_budget": probe["phase_within_budget"],
             "reqtrace_overhead_pct": probe["reqtrace_overhead_pct"],
             "reqtrace_within_budget": probe["reqtrace_within_budget"],
+            "integrity_overhead_pct": probe["integrity_overhead_pct"],
+            "integrity_within_budget": probe["integrity_within_budget"],
             "within_budget": probe["within_budget"],
         }
         line.update({k: v for k, v in notes.items() if "error" in k})
@@ -334,18 +349,20 @@ def main() -> None:
         print(json.dumps(line))
         if not probe["within_budget"] or \
                 not probe["phase_within_budget"] or \
-                not probe["reqtrace_within_budget"]:
+                not probe["reqtrace_within_budget"] or \
+                not probe["integrity_within_budget"]:
             # the acceptance contract: >5% MEDIAN tracing overhead is
             # a regression, and it fails LOUDLY, never as a footnote
             # (best-of is reported for context but never gates); the
-            # phase profiler and per-op request tagging ride the SAME
-            # budget
+            # phase profiler, per-op request tagging and the armed
+            # sdc-integrity plane ride the SAME budget
             sys.stderr.write(
                 f"FAIL: median tracing overhead "
                 f"{probe['overhead_pct']}% / phase overhead "
                 f"{probe['phase_overhead_pct']}% / reqtrace overhead "
-                f"{probe['reqtrace_overhead_pct']}% exceeds the "
-                f"{probe['budget_pct']}% budget\n")
+                f"{probe['reqtrace_overhead_pct']}% / integrity "
+                f"overhead {probe['integrity_overhead_pct']}% exceeds "
+                f"the {probe['budget_pct']}% budget\n")
             sys.exit(1)
         return
 
@@ -620,6 +637,42 @@ def main() -> None:
             sys.exit(1)
         return
 
+    if opts.probe_sdc:
+        from benchmarks.probe_sdc import persist, run_probe
+
+        probe = run_probe()
+        notes = persist(probe, detail_path)
+        det = probe["detect"]
+        pool = probe["pool"]
+        line = {
+            "metric": f"sdc integrity plane, {probe['nranks']}-rank "
+                      f"checked mesh + {pool.get('hosts')}-host pool: "
+                      f"detect + attribute + quarantine",
+            "value": probe["sdc_detection_rate"],
+            "unit": "detection_rate",
+            "sdc_false_positives": probe["sdc_false_positives"],
+            "sdc_mttq_ms": probe["sdc_mttq_ms"],
+            "mttq_budget_ms": probe["mttq_budget_ms"],
+            "convicted_ranks": det["convicted_ranks"],
+            "retry_ops": det["retry_ops"],
+            "byte_exact": det["byte_exact"],
+            "failed_jobs": probe["failed_jobs"],
+            "within_budget": probe["within_budget"],
+        }
+        line.update({k: v for k, v in notes.items() if "error" in k})
+        sys.stderr.write(json.dumps(probe, indent=1) + "\n")
+        print(json.dumps(line))
+        if not probe["within_budget"]:
+            sys.stderr.write(
+                f"FAIL: sdc probe — gates {probe['gates']} "
+                f"(detection_rate={probe['sdc_detection_rate']}, "
+                f"false_positives={probe['sdc_false_positives']}, "
+                f"mttq {probe['sdc_mttq_ms']}ms of "
+                f"{probe['mttq_budget_ms']}ms budget, failed_jobs="
+                f"{probe['failed_jobs']})\n")
+            sys.exit(1)
+        return
+
     if opts.probe_ctrlplane:
         from benchmarks.probe_ctrlplane import persist, run_probe
 
@@ -845,7 +898,7 @@ def main() -> None:
                                     "probe_serve", "probe_obs",
                                     "probe_fleet", "probe_rma",
                                     "probe_ctrlplane", "probe_reqtrace",
-                                    "probe_grayfail",
+                                    "probe_grayfail", "probe_sdc",
                                     "regress_trajectory")
                           if isinstance(prior, dict) and k in prior},
                        "device_us": dev, "software_us": sw,

@@ -1,0 +1,288 @@
+"""--probe-sdc: close the silent-data-corruption plane end to end.
+
+Three arms, each against an acceptance gate (DESIGN.md §25):
+
+  detect   a 4-rank device mesh with ``device_sdc`` armed on rank 1
+           (flip EVERY op) and the integrity plane checking EVERY op
+           (integrity_sample=1): every injected flip must be caught at
+           the rendezvous, bisection must convict rank 1 and nobody
+           else, the poisoned op must be retried from pristine sources
+           (every step's result byte-exact against the analytic
+           answer), and the job must complete — detection rate 1.0,
+           zero failed jobs.
+  clean    the same fully-armed world with NO injector: zero
+           mismatches, zero convictions over a longer op stream — the
+           false-positive gate.  A detector that cries wolf gets
+           turned off in production, so this arm is as load-bearing
+           as the detection arm.
+  pool     a live 2-host DVM pool running the self-verifying SDC
+           workload with a ONE-SHOT flip on rank 1: the conviction
+           must flow through the §24 health plane's decisive ``sdc``
+           signal into an applied quarantine of the corrupting host,
+           with MTTQ (first conviction -> quarantine applied) inside
+           a budget derived from the probe's own heartbeat/tick
+           cadence — and the job still exits 0 with every rank's
+           result exact (never a failed job).
+
+The detection-rate denominator is by construction, not by counter:
+``device_sdc:1`` with period 1 fires on every collective the victim
+rank deposits, so injected == steps exactly and the rate has no
+self-grading term.  MTTQ timestamps come from one process — the
+conviction hook fires in the pool's executing rank thread and the
+quarantine is observed via the server's applied-state ledger — so the
+clock base is a single perf_counter_ns domain.
+
+``bench.py --probe-sdc`` persists under ``probe_sdc`` in
+BENCH_DETAIL.json and FAILS (exit 1) when any gate breaks.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import tempfile
+import time
+from typing import Dict
+
+NRANKS = 4
+VICTIM = 1                # ft_inject_victim_rank: the corrupting chip
+DETECT_STEPS = 40         # injected arm: one flip per step
+CLEAN_STEPS = 200         # false-positive arm: longer, fully checked
+HOSTS = 2
+POOL_STEPS = 6            # pool workload length (flip is one-shot)
+FLIP_AT = 3               # pool arm: corrupt exactly op FLIP_AT
+HB_S = 0.15               # dvm_heartbeat_s: hb-loop (= sweep) period
+TICK_MS = 100             # health_tick_ms: below the hb period, so
+                          # the tick fires on every sweep wake
+#: conviction -> quarantine-applied budget: a handful of effective
+#: sweep periods (hb wake + tick + collect), with CI-box slack
+MTTQ_BUDGET_MS = 8 * (HB_S * 1000.0 + TICK_MS)
+
+PROG = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tests", "_sdc_prog.py")
+
+#: every knob the probe sets, saved/restored around the whole run
+_KNOBS = {
+    "integrity_enable": "1",
+    "integrity_sample": "1",       # check every device collective
+    "integrity_sample_auto": "0",  # pin the period (no adaptation)
+    "ft_inject_victim_rank": str(VICTIM),
+    "ft_inject_plan": "",          # each arm sets its own plan
+    "ft_inject_sdc_period": "1",
+    "health_enable": "1",
+    "health_tick_ms": str(TICK_MS),
+    "dvm_heartbeat_s": str(HB_S),
+}
+
+
+def _pv(name: str) -> int:
+    from ompi_tpu.mca.params import registry
+    return registry._pvars[name].read()
+
+
+def _mesh_arm(steps: int, inject: bool) -> Dict:
+    """One fully-checked 4-rank device world; with ``inject`` the
+    victim rank flips every op it deposits.  Returns pvar deltas, the
+    conviction roster and the per-rank count of byte-exact steps."""
+    from ompi_tpu.mca.params import registry
+    from ompi_tpu.obs import integrity as ig
+    from ompi_tpu.testing import run_ranks
+
+    registry.set("ft_inject_plan", "device_sdc:1" if inject else "")
+    registry.set("ft_inject_sdc_period", "1")
+    ig.refresh()
+    ig.reset()
+    base = {k: _pv(f"integrity_{k}") for k in
+            ("checks", "mismatches", "convictions", "retry_ops")}
+
+    def fn(comm):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ompi_tpu.op.op import SUM
+        x = jnp.full((64,), float(comm.rank + 1), jnp.float32)
+        want = np.full(64, NRANKS * (NRANKS + 1) / 2.0, np.float32)
+        exact = 0
+        for _ in range(steps):
+            got = np.asarray(comm.allreduce_arr(x, SUM))
+            exact += int(np.array_equal(got, want))
+        return exact
+
+    exact = run_ranks(NRANKS, fn, devices=True, timeout=600)
+    conv = ig.convicted_snapshot()
+    out = {k: _pv(f"integrity_{k}") - base[k] for k in base}
+    out["steps"] = steps
+    out["exact_steps_min"] = min(exact)
+    out["byte_exact"] = bool(min(exact) == steps)
+    out["convicted_ranks"] = sorted({r["rank"] for r in conv})
+    return out
+
+
+def _pool_arm(tmpdir: str) -> Dict:
+    """Live 2-host pool, one-shot flip: conviction -> decisive sdc
+    signal -> quarantine applied, timed as MTTQ."""
+    import jax
+
+    from ompi_tpu.mca.params import registry
+    from ompi_tpu.obs import integrity as ig
+    from ompi_tpu.obs.health import QUARANTINED
+    from ompi_tpu.tools.dvm import DVMServer, DvmClient
+
+    registry.set("ft_inject_plan", f"device_sdc:{FLIP_AT}")
+    registry.set("ft_inject_sdc_period", "0")  # one-shot
+    ig.refresh()
+    ig.reset()
+
+    conv_ns = [0]
+
+    def _stamp(rec, _c=conv_ns):
+        if _c[0] == 0:
+            _c[0] = time.perf_counter_ns()
+
+    ig.install_convict_hook(_stamp)
+    uri = os.path.join(tmpdir, f"sdc-{time.time_ns()}.uri")
+    srv = DVMServer(NRANKS, devices=jax.devices(), uri_file=uri,
+                    hosts=HOSTS)
+    srv.start()
+    c = DvmClient(uri)
+    failed = 0
+    try:
+        sid = c.attach(NRANKS)["sid"]
+        r = c.run(sid, PROG, ["probe", str(POOL_STEPS)], timeout=240)
+        ok_ranks = len(re.findall(r"SDC probe \d+ ok", r["stdout"]))
+        if r["code"] != 0 or ok_ranks != NRANKS:
+            failed = 1
+        conv = ig.convicted_snapshot()
+        if not conv or conv_ns[0] == 0:
+            return {"hosts": HOSTS, "error": "no conviction recorded",
+                    "failed_jobs": 1, "mttq_ms": -1.0}
+        host = int(conv[0]["host"])
+        applied_ns = 0
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if srv._health_applied[host] >= QUARANTINED:
+                applied_ns = time.perf_counter_ns()
+                break
+            time.sleep(0.005)
+        mttq_ms = ((applied_ns - conv_ns[0]) / 1e6
+                   if applied_ns else -1.0)
+        other = 1 - host
+        out = {
+            "hosts": HOSTS,
+            "pool_steps": POOL_STEPS,
+            "ok_ranks": ok_ranks,
+            "failed_jobs": failed,
+            "convicted_rank": int(conv[0]["rank"]),
+            "convicted_host": host,
+            "quarantine_applied": bool(applied_ns),
+            "mttq_ms": round(mttq_ms, 1),
+            # the healthy host must be untouched, and the metrics RPC
+            # must carry the conviction rows to operators
+            "other_host_clean": bool(
+                srv._health_applied[other] == 0
+                and srv.health.sdc[other] == 0),
+            "metrics_rows": len(c.metrics().get("sdc") or []),
+        }
+        c.detach(sid)
+        return out
+    finally:
+        c.sock.close()
+        ig.remove_convict_hook(_stamp)
+        hp = srv.health
+        if hp is not None:
+            for h in range(HOSTS):
+                hp.reset_host(h)
+            hp.collect()
+        srv.stop()
+
+
+def run_probe() -> Dict:
+    # the mesh arms need a multi-device CPU backend; force it before
+    # anything imports jax (the probe_rma idiom)
+    if "xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") +
+            " --xla_force_host_platform_device_count=8")
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    # import the registering modules before touching their knobs
+    import ompi_tpu.ft_inject  # noqa: F401
+    import ompi_tpu.obs.health  # noqa: F401
+    import ompi_tpu.tools.dvm  # noqa: F401
+    from ompi_tpu.mca.params import registry
+    from ompi_tpu.obs import integrity as ig
+
+    saved = {k: registry.get(k) for k in _KNOBS}
+    for k, v in _KNOBS.items():
+        registry.set(k, v)
+    try:
+        detect = _mesh_arm(DETECT_STEPS, inject=True)
+        clean = _mesh_arm(CLEAN_STEPS, inject=False)
+        with tempfile.TemporaryDirectory() as td:
+            pool = _pool_arm(td)
+    finally:
+        for k, v in saved.items():
+            registry.set(k, v)
+        ig.refresh()
+        ig.reset()
+
+    # injected == steps by construction: after_ops=1, period=1 flips
+    # every collective the victim deposits
+    rate = detect["mismatches"] / float(detect["steps"])
+    false_pos = clean["mismatches"]
+    mttq_ms = pool.get("mttq_ms", -1.0)
+    failed = int(detect["byte_exact"] is False) + \
+        int(clean["byte_exact"] is False) + \
+        int(pool.get("failed_jobs", 1))
+    gates = {
+        "detection_rate_1": bool(rate >= 1.0),
+        "conviction_pinned": bool(
+            detect["convicted_ranks"] == [VICTIM]
+            and pool.get("convicted_rank") == VICTIM),
+        "retry_byte_exact": bool(
+            detect["byte_exact"] and detect["retry_ops"] >= detect["steps"]),
+        "false_positives_0": bool(
+            false_pos == 0 and clean["convictions"] == 0),
+        "mttq_within_budget": bool(0 < mttq_ms <= MTTQ_BUDGET_MS),
+        "pool_isolation": bool(pool.get("quarantine_applied")
+                               and pool.get("other_host_clean")),
+        "zero_failed_jobs": bool(failed == 0),
+    }
+    return {
+        "nranks": NRANKS,
+        "victim": VICTIM,
+        "detect": detect,
+        "clean": clean,
+        "pool": pool,
+        "sdc_detection_rate": round(rate, 4),
+        "sdc_false_positives": int(false_pos),
+        "sdc_mttq_ms": mttq_ms,
+        "mttq_budget_ms": round(MTTQ_BUDGET_MS, 1),
+        "failed_jobs": failed,
+        "gates": gates,
+        "within_budget": bool(all(gates.values())),
+    }
+
+
+def persist(probe: Dict, detail_path: str) -> Dict:
+    """Merge under 'probe_sdc' in BENCH_DETAIL.json, preserving every
+    other section (the probe_dispatch/full-sweep pattern)."""
+    notes: Dict = {}
+    try:
+        with open(detail_path) as fh:
+            detail = json.load(fh)
+        if not isinstance(detail, dict):
+            detail = {}
+    except (OSError, ValueError):
+        detail = {}
+    detail["probe_sdc"] = probe
+    try:
+        tmp = f"{detail_path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as fh:
+            json.dump(detail, fh, indent=1)
+        os.replace(tmp, detail_path)
+    except OSError as e:
+        notes["detail_error"] = str(e)[:120]
+    return notes

@@ -99,6 +99,21 @@ TOLERANCES: Dict[str, Tuple[str, float]] = {
     "grayfail_mttm_ms": ("lower", 2000.0),
     "grayfail_goodput_ratio": ("higher", 0.25),
     "false_quarantines": ("lower", 0.5),
+    # sdc-integrity plane sentries (ISSUE 20): the detection rate on
+    # the flip-every-op arm must stay EXACTLY 1.0 (the 1% relative
+    # band means a single missed flip out of the probe's 40 regresses),
+    # false positives on the clean armed arm must stay EXACTLY zero
+    # (0.5 absolute band — same contract as false_quarantines), and
+    # conviction-to-quarantine latency is bounded by a couple of
+    # effective health sweeps (the band absorbs sweep phase; a real
+    # regression — a lost decisive-signal path making sdc wait out the
+    # beat-score hysteresis — lands in multiples of the budget)
+    "sdc_detection_rate": ("higher", 0.01),
+    "sdc_false_positives": ("lower", 0.5),
+    "sdc_mttq_ms": ("lower", 1000.0),
+    # the armed integrity plane's steady-state overhead rides the
+    # trace_overhead budget model: an absolute percentage-point band
+    "integrity_overhead_pct": ("lower", 2.0),
 }
 
 
@@ -172,6 +187,9 @@ def _detail_metrics(detail: dict) -> Dict[str, float]:
     to = detail.get("trace_overhead") or {}
     if isinstance(to.get("overhead_pct"), (int, float)):
         out["trace_overhead_pct"] = float(to["overhead_pct"])
+    if isinstance(to.get("integrity_overhead_pct"), (int, float)):
+        out["integrity_overhead_pct"] = \
+            float(to["integrity_overhead_pct"])
     ob = detail.get("probe_obs") or {}
     if isinstance(ob.get("overhead_pct"), (int, float)):
         out["obs_overhead_pct"] = float(ob["overhead_pct"])
@@ -242,6 +260,16 @@ def _detail_metrics(detail: dict) -> Dict[str, float]:
     # sentry blind to the first false quarantine
     if isinstance(v, (int, float)) and v >= 0:
         out["false_quarantines"] = float(v)
+    sd = detail.get("probe_sdc") or {}
+    for key in ("sdc_detection_rate", "sdc_mttq_ms"):
+        v = sd.get(key)
+        if isinstance(v, (int, float)) and v > 0:
+            out[key] = float(v)
+    v = sd.get("sdc_false_positives")
+    # v >= 0 for the same reason as false_quarantines: zero IS the
+    # required value, and dropping it would blind the sentry
+    if isinstance(v, (int, float)) and v >= 0:
+        out["sdc_false_positives"] = float(v)
     return out
 
 

@@ -52,6 +52,17 @@ probe's "runs" only ``CHUNK_OPS`` ops long (real serving runs are
 two to four orders of magnitude longer, so the per-run cost is
 overstated here, never hidden), against the same untraced blocks
 and the same bound.
+
+The sdc-integrity plane (DESIGN.md §25) rides the same budget with
+its own world: integrity gates DEVICE collectives only (the host
+Allreduce above never reaches the rendezvous gate), so the
+``integrity`` arm runs a second 4-rank device mesh with the same
+palindromic micro-chunk interleave — disarmed vs armed at the
+``integrity_sample`` steady state (the adaptive sampler is ramped to
+its period cap before anything is timed, transient disclosed via
+``integrity_ramp_ops``).  ``integrity_overhead_pct`` is the paired
+per-block median against the disarmed blocks of the SAME device
+world, judged against the SAME 5%% bound.
 """
 
 from __future__ import annotations
@@ -75,6 +86,17 @@ SUB_ROUNDS = 15    # micro-chunk visits of EVERY arm per block
 BLOCK_OPS = CHUNK_OPS * SUB_ROUNDS  # per arm per reported block
 BLOCKS = 7         # reported off/on/phase/reqtrace block rounds
 BUDGET_PCT = 5.0   # acceptance bound for the ON path (median)
+
+# integrity-arm world (device mesh — slower per op than the host
+# Allreduce, so fewer ops bound the wall clock; the chunking keeps
+# the same adjacent-regime pairing property)
+I_CHUNK_OPS = 25
+I_SUB_ROUNDS = 8
+I_BLOCKS = 5
+I_BLOCK_OPS = I_CHUNK_OPS * I_SUB_ROUNDS
+I_RAMP_OPS = 600   # armed ops carrying the integrity sampler's period
+                   # from 1 to the integrity_sample cap (auto=2 during
+                   # the probe, so the ramp is ~2x the period sum)
 
 
 def _probe_world() -> Dict:
@@ -191,8 +213,68 @@ def _probe_world() -> Dict:
     return run_ranks(NRANKS, fn, timeout=600)[0]
 
 
+def _integrity_world() -> Dict:
+    """Device-mesh companion world for the integrity arm: the §25
+    plane gates device collectives at the rendezvous, so its cost is
+    measured where it is actually paid.  Two arms (disarmed / armed at
+    the sampler's steady-state period), same palindromic micro-chunk
+    interleave and per-block pairing as the host world.  The arm
+    toggle is ``integrity.set_armed`` — the exact module flag the
+    coll hot path reads per op, so the disarmed chunks price the
+    always-on ``_ig.on`` check honestly rather than a world that
+    never imported the plane."""
+    from ompi_tpu.obs import integrity as ig
+    from ompi_tpu.op.op import SUM
+    from ompi_tpu.testing import run_ranks
+
+    def fn(comm):
+        import jax.numpy as jnp
+        x = jnp.full((8,), float(comm.rank + 1), jnp.float32)
+        for _ in range(WARMUP):
+            comm.allreduce_arr(x, SUM)
+        # ramp the adaptive integrity sampler to its steady-state
+        # period cap before anything is timed (same disclosure model
+        # as the trace sampler's RAMP_OPS)
+        ig.set_armed(True)
+        for _ in range(I_RAMP_OPS):
+            comm.allreduce_arr(x, SUM)
+        acc = [[0.0] * 2 for _ in range(I_BLOCKS)]
+        for b in range(I_BLOCKS):
+            for s in range(I_SUB_ROUNDS):
+                rev = (b * I_SUB_ROUNDS + s) % 2 == 1
+                for pos in range(2):
+                    mode = 1 - pos if rev else pos
+                    comm.Barrier()
+                    # every rank sets the same value between barriers
+                    # (the flag is module-global across rank threads,
+                    # so the writes are idempotent, never racing)
+                    ig.set_armed(mode == 1)
+                    comm.Barrier()
+                    t0 = time.perf_counter()
+                    for _ in range(I_CHUNK_OPS):
+                        comm.allreduce_arr(x, SUM)
+                    acc[b][mode] += time.perf_counter() - t0
+        ig.set_armed(True)
+        comm.Barrier()
+        return {"ig_off_us_blocks": [acc[b][0] / I_BLOCK_OPS * 1e6
+                                     for b in range(I_BLOCKS)],
+                "ig_on_us_blocks": [acc[b][1] / I_BLOCK_OPS * 1e6
+                                    for b in range(I_BLOCKS)]}
+
+    return run_ranks(NRANKS, fn, devices=True, timeout=600)[0]
+
+
 def run_probe() -> Dict:
     from ompi_tpu.mca.params import registry
+
+    # the integrity arm's device mesh needs a multi-device CPU
+    # backend; force it before anything imports jax (probe_rma idiom)
+    if "xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") +
+            f" --xla_force_host_platform_device_count={NRANKS}")
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
     registry.set("trace_enable", "1")
     # big enough that KEPT spans never wrap (the sampler caps kept
@@ -206,6 +288,26 @@ def run_probe() -> Dict:
         snap = _probe_world()
     finally:
         registry.set("trace_enable", "0")
+
+    # integrity arm: its own device world, armed via the knobs the
+    # refresh() at mpi_init reads; auto=2 ramps the sampler to the
+    # 1-in-64 steady state inside I_RAMP_OPS
+    from ompi_tpu.obs import integrity as ig
+    ig_saved = {k: registry.get(k) for k in
+                ("integrity_enable", "integrity_sample",
+                 "integrity_sample_auto")}
+    registry.set("integrity_enable", "1")
+    registry.set("integrity_sample", "64")
+    registry.set("integrity_sample_auto", "2")
+    ig_checks0 = registry._pvars["integrity_checks"].read()
+    try:
+        isnap = _integrity_world()
+    finally:
+        for k, v in ig_saved.items():
+            registry.set(k, v)
+        ig.refresh()
+    ig_checks = registry._pvars["integrity_checks"].read() - ig_checks0
+
     off_times = snap["off_us_blocks"]
     on_times = snap["on_us_blocks"]
     phase_times = snap["phase_us_blocks"]
@@ -231,6 +333,12 @@ def run_probe() -> Dict:
     overhead_med = _paired_med(on_times)
     phase_overhead_med = _paired_med(phase_times)
     req_overhead_med = _paired_med(req_times)
+    # integrity pairs within ITS OWN device world's blocks — the host
+    # world's untraced blocks price a different op entirely
+    ig_off = isnap["ig_off_us_blocks"]
+    ig_on = isnap["ig_on_us_blocks"]
+    ig_overhead_med = statistics.median(
+        (a - o) / o * 100.0 for a, o in zip(ig_on, ig_off))
     gil = getattr(sys, "_is_gil_enabled", lambda: True)()
     return {
         "nranks": NRANKS,
@@ -270,6 +378,21 @@ def run_probe() -> Dict:
         "reqtrace_us_all": [round(x, 2) for x in req_times],
         "reqtrace_overhead_pct": round(req_overhead_med, 2),
         "reqtrace_within_budget": bool(req_overhead_med <= BUDGET_PCT),
+        # sdc-integrity plane (DESIGN.md §25): disarmed vs armed at
+        # the 1-in-integrity_sample steady state on a device mesh,
+        # paired per block inside that world, same budget
+        "integrity_nranks": NRANKS,
+        "integrity_ops_per_block": I_BLOCK_OPS,
+        "integrity_blocks": I_BLOCKS,
+        "integrity_ramp_ops": I_RAMP_OPS,
+        "integrity_sample_cap": 64,
+        "integrity_checks_sampled": ig_checks,
+        "integrity_off_us_median": round(statistics.median(ig_off), 2),
+        "integrity_us_median": round(statistics.median(ig_on), 2),
+        "integrity_off_us_all": [round(x, 2) for x in ig_off],
+        "integrity_us_all": [round(x, 2) for x in ig_on],
+        "integrity_overhead_pct": round(ig_overhead_med, 2),
+        "integrity_within_budget": bool(ig_overhead_med <= BUDGET_PCT),
         "budget_pct": BUDGET_PCT,
         "within_budget": bool(overhead_med <= BUDGET_PCT),
         "traced_spans": snap.get("spans", {}),

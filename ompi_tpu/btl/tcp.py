@@ -80,8 +80,18 @@ _rto_var = registry.register(
     help="Sender resends its unacked window when no ack arrives for "
          "this long (0 disables the timer; NACKs still resend)")
 
-_RHDR = struct.Struct("<BIQ")  # rtype, wire-header crc, seq
-_T_DATA, _T_HELLO, _T_ACK, _T_NACK = 0, 1, 2, 3
+_pay_digest_var = registry.register(
+    "btl", "tcp", "payload_digest", True, bool,
+    help="CRC the bytes the header CRC does not cover (payload + "
+         "pickle tails) on every reliable DATA frame; a mismatch is "
+         "NACKed and the pristine unacked window replayed — catches "
+         "wire corruption the narrow header span is blind to")
+
+_RHDR = struct.Struct("<BIQ")   # rtype, wire-header crc, seq
+_RHDRD = struct.Struct("<BIQI")  # ... + payload crc (_T_DATAD; same
+#                                  prefix, so _RHDR.unpack_from still
+#                                  reads rtype/crc/seq off either)
+_T_DATA, _T_HELLO, _T_ACK, _T_NACK, _T_DATAD = 0, 1, 2, 3, 4
 
 
 class _Conn:
@@ -166,6 +176,7 @@ class TcpModule(BTLModule):
         self._out: Dict[int, _Conn] = {}
         self._in: List[_Conn] = []
         self.reliable = _reliable_var.value
+        self.pay_digest = self.reliable and _pay_digest_var.value
         # per-PEER receive stream state: survives connection severs
         # (the whole point — a reconnecting sender resends its window
         # and the expected-seq cursor dedups), dies at ft_reset
@@ -352,9 +363,17 @@ class TcpModule(BTLModule):
         if self.reliable:
             seq = conn.tx_seq
             conn.tx_seq = seq + 1
-            frame = [struct.pack(">I", _RHDR.size + len(hdr) + plen)
-                     + _RHDR.pack(_T_DATA, wire.frame_crc(hdr), seq)
-                     + hdr]
+            if self.pay_digest:
+                frame = [struct.pack(">I",
+                                     _RHDRD.size + len(hdr) + plen)
+                         + _RHDRD.pack(_T_DATAD, wire.frame_crc(hdr),
+                                       seq,
+                                       wire.payload_crc(hdr, payload))
+                         + hdr]
+            else:
+                frame = [struct.pack(">I", _RHDR.size + len(hdr) + plen)
+                         + _RHDR.pack(_T_DATA, wire.frame_crc(hdr), seq)
+                         + hdr]
         else:
             frame = [struct.pack(">I", len(hdr) + plen) + hdr]
         if plen:
@@ -397,6 +416,25 @@ class TcpModule(BTLModule):
             conn.txq.append([bytes(bad)] + frame[1:])
             self._drain(conn)
             return True
+        if act == "corrupt_payload":
+            # flip a bit OUTSIDE the header-CRC span (the header CRC
+            # stays valid by construction): only the payload digest
+            # (btl_tcp_payload_digest) can see this flip
+            if len(frame) > 1:
+                bad = bytearray(frame[1])
+                bad[len(bad) // 2] ^= 0x10
+                conn.txq.append([frame[0], bytes(bad)])
+                self._drain(conn)
+                return True
+            head = bytearray(frame[0])
+            rh = _RHDRD.size if head[4] == _T_DATAD else _RHDR.size
+            hdr = head[4 + rh:]
+            if len(hdr) > wire.hdr_span(hdr):
+                head[-1] ^= 0x10  # pickle-body tail past the span
+                conn.txq.append([bytes(head)])
+                self._drain(conn)
+                return True
+            return False  # fully-covered frame: nothing to flip above CRC
         if act == "dup":
             conn.txq.append(frame)
             conn.txq.append(frame)
@@ -557,9 +595,10 @@ class TcpModule(BTLModule):
                 self._ctl_send(conn, _T_ACK, self._rx_expected[peer])
                 events += 1
                 continue
-            if rtype != _T_DATA:
+            if rtype != _T_DATA and rtype != _T_DATAD:
                 continue  # stray control on a data stream: ignore
-            frame = body[_RHDR.size:]
+            frame = body[_RHDRD.size if rtype == _T_DATAD
+                         else _RHDR.size:]
             peer = conn.rx_peer
             if peer < 0:
                 # hello-first contract violated (mixed reliable
@@ -583,6 +622,10 @@ class TcpModule(BTLModule):
                 continue
             try:
                 wire.check_crc(frame, crc)
+                if rtype == _T_DATAD:
+                    (pcrc,) = struct.unpack_from(
+                        "<I", body, _RHDR.size)
+                    wire.check_payload_crc(frame, pcrc)
                 frag = wire.decode(frame)
             except Exception:
                 # CRC mismatch, or a decode that blew up on bytes the

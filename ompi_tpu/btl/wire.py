@@ -73,6 +73,27 @@ def check_crc(frame, crc: int) -> None:
             f"wire header CRC mismatch (code byte {frame[0]})")
 
 
+def payload_crc(hdr, payload=None) -> int:
+    """CRC of everything the header CRC does NOT cover: the hdr tail
+    past the span (pickle bodies) plus the raw payload buffer.  The
+    sender computes it from (hdr, payload) before they are gathered;
+    the receiver recomputes from the contiguous frame — identical
+    bytes, identical digest (btl_tcp_payload_digest)."""
+    import zlib
+    c = zlib.crc32(bytes(hdr[hdr_span(hdr):]))
+    if payload is not None:
+        if not isinstance(payload, (bytes, bytearray, memoryview)):
+            payload = memoryview(payload)
+        c = zlib.crc32(payload, c)
+    return c & 0xFFFFFFFF
+
+
+def check_payload_crc(frame, crc: int) -> None:
+    if payload_crc(frame) != crc:
+        raise CorruptFrame(
+            f"wire payload CRC mismatch (code byte {frame[0]})")
+
+
 def _is_buf(x) -> bool:
     """Only real byte buffers ride the binary fast path; opaque
     payload objects (device arrays, btl/tpu) take the pickle

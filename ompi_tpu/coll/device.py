@@ -41,6 +41,7 @@ import numpy as np
 
 from ompi_tpu import obs as _obs
 from ompi_tpu import trace as _trace
+from ompi_tpu.obs import integrity as _ig
 from ompi_tpu.coll.framework import CollComponent, CollModule, coll_framework
 from ompi_tpu.pml.monitoring import count_offload
 from ompi_tpu.coll.tuned import TunedModule
@@ -104,6 +105,10 @@ _XLA_REDUCERS = {"MPI_SUM", "MPI_MAX", "MPI_MIN"}
 # commutative+associative ops lowered as all_gather + on-device fold
 _GATHER_FOLD = {"MPI_PROD", "MPI_LAND", "MPI_BAND", "MPI_LOR",
                 "MPI_BOR", "MPI_LXOR", "MPI_BXOR"}
+
+# dispatch kind -> integrity-plane spec kind (DESIGN.md §25)
+_CK_KINDS = {"allreduce": "allreduce", "reduce_scatter": "redscat",
+             "allgather": "gather", "alltoall": "alltoall"}
 
 
 def _is_jax_array(x) -> bool:
@@ -311,6 +316,23 @@ def _coll_slow_injector(state):
         node = getattr(getattr(state, "rte", None), "node_id", 0)
         inj = ft_inject.host_slow_injector(node) or False
         state._coll_slow_inj = inj
+    return inj
+
+
+def _coll_sdc_injector(state):
+    """ft_inject 'device_sdc' (the SILENT failure, DESIGN.md §25):
+    the victim rank's chip bit-flips its collective operand at the
+    armed op count — after the integrity gate digested it, exactly
+    the divergence the bisection round attributes.  On an unsampled
+    op the flip lands on the raw operand and propagates silently:
+    the honest semantics of 1-in-N detection (cached per rank-state;
+    False = disarmed or this rank is not the victim)."""
+    inj = state.__dict__.get("_coll_sdc_inj")
+    if inj is None:
+        from ompi_tpu import ft_inject
+        inj = ft_inject.sdc_injector(
+            state.rank, getattr(state, "size", None)) or False
+        state._coll_sdc_inj = inj
     return inj
 
 
@@ -622,11 +644,16 @@ class Rendezvous:
                 self.cv.release()
 
 
-def meet(comm, value, fn, abort_check) -> Any:
+def meet(comm, value, fn, abort_check, ck=None) -> Any:
     """The one rendezvous entry point for offloaded collectives:
     reports the bypassed traffic to pml/monitoring (the offload fast
     paths must not blind the observability story), then runs the
-    meeting with this rank's progress engine kept turning."""
+    meeting with this rank's progress engine kept turning.  ``ck`` is
+    the integrity-plane check spec (DESIGN.md §25): non-None only when
+    the plane is armed and the op is algebraically checkable — the
+    sampled gate may then wrap (value, fn) in a digest-carrying pair.
+    The spec depends only on (kind, op, dtype), so every rank passes
+    the same ck and the comm-consistent sampling invariant holds."""
     rv = _get_rendezvous(comm)
     track_state(comm.state)
     inj = _coll_delay_injector(comm.state)
@@ -642,6 +669,11 @@ def meet(comm, value, fn, abort_check) -> Any:
         _sever_hold(abort_check)
     nbytes = int(getattr(value, "nbytes", 0) or 0)
     count_offload(comm, nbytes)
+    if ck is not None:
+        value, fn = _ig.gate(comm, value, fn, ck)
+    sj = _coll_sdc_injector(comm.state)
+    if sj and sj.should_flip():
+        value = _ig.flip_value(value)
     tr = comm.state.tracer
     if tr is None:
         return rv.run(comm.rank, value, fn, abort_check,
@@ -681,14 +713,15 @@ def meet(comm, value, fn, abort_check) -> Any:
     return out
 
 
-def meet_begin(comm, value, fn, abort_check):
+def meet_begin(comm, value, fn, abort_check, ck=None):
     """Asynchronous rendezvous entry: deposit and return a handle
     without waiting for the result.  The last arriver's computation
     always runs on the dispatcher thread, so the caller's thread is
     free to pack the NEXT segment while the device computes this one
     — the overlap the segmented pipeline is built on.  Collect with
     ``meet_finish``; every begun handle MUST be finished (results are
-    refcounted per generation)."""
+    refcounted per generation).  ``ck`` is the integrity check spec,
+    exactly as in ``meet``."""
     rv = _get_rendezvous(comm)
     track_state(comm.state)
     inj = _coll_delay_injector(comm.state)
@@ -704,6 +737,11 @@ def meet_begin(comm, value, fn, abort_check):
         _sever_hold(abort_check)
     nbytes = int(getattr(value, "nbytes", 0) or 0)
     count_offload(comm, nbytes)
+    if ck is not None:
+        value, fn = _ig.gate(comm, value, fn, ck)
+    sj = _coll_sdc_injector(comm.state)
+    if sj and sj.should_flip():
+        value = _ig.flip_value(value)
     tr = comm.state.tracer
     t0 = 0
     ph = None
@@ -1146,8 +1184,8 @@ class TpuCollModule(CollModule):
         comm.__dict__["_device_abort_check"] = check
         return check
 
-    def _run(self, comm, value, fn):
-        out = meet(comm, value, fn, self._abort_check(comm))
+    def _run(self, comm, value, fn, ck=None):
+        out = meet(comm, value, fn, self._abort_check(comm), ck)
         self.pvar_offload.add(1)
         return out
 
@@ -1173,7 +1211,8 @@ class TpuCollModule(CollModule):
                                    op.name)
             return _scatter_out(jfn(g), mesh, comm.size)
 
-        out = self._run(comm, x, fn)
+        ck = _ig.spec("allreduce", op.name, x) if _ig.on else None
+        out = self._run(comm, x, fn, ck)
         return out.reshape(()) if was_scalar else out
 
     def reduce_scatter_block_arr(self, comm, x, op: Op):
@@ -1192,7 +1231,8 @@ class TpuCollModule(CollModule):
                                    g.dtype, opname)
             return _scatter_out(jfn(g), mesh, comm.size)
 
-        return self._run(comm, x, fn)
+        ck = _ig.spec("redscat", opname, x) if _ig.on else None
+        return self._run(comm, x, fn, ck)
 
     def allgather_arr(self, comm, x):
         if not self._eligible(comm, x):
@@ -1205,7 +1245,8 @@ class TpuCollModule(CollModule):
             jfn = _mesh_collective("allgather", mesh, g.shape, g.dtype)
             return _scatter_out(jfn(g), mesh, comm.size)
 
-        return self._run(comm, x, fn)
+        ck = _ig.spec("gather", "", x) if _ig.on else None
+        return self._run(comm, x, fn, ck)
 
     def alltoall_arr(self, comm, x):
         if not self._eligible(comm, x) or _ndim_of(x) == 0 \
@@ -1225,7 +1266,8 @@ class TpuCollModule(CollModule):
             jfn = _mesh_collective("alltoall", mesh, g.shape, g.dtype)
             return _scatter_out(jfn(g), mesh, comm.size)
 
-        return self._run(comm, x, fn)
+        ck = _ig.spec("alltoall", "", x) if _ig.on else None
+        return self._run(comm, x, fn, ck)
 
     def bcast_arr(self, comm, x, root: int):
         if not self._eligible(comm, x) \
@@ -1245,7 +1287,8 @@ class TpuCollModule(CollModule):
             jfn = _mesh_collective("bcast", mesh, g.shape, g.dtype, root)
             return _scatter_out(jfn(g), mesh, comm.size)
 
-        out = self._run(comm, x, fn)
+        ck = _ig.spec("bcast", "", x, root) if _ig.on else None
+        out = self._run(comm, x, fn, ck)
         return out.reshape(()) if was_scalar else out
 
     def reduce_arr(self, comm, x, op: Op, root: int):
@@ -1411,7 +1454,9 @@ class HbmCollModule(CollModule):
                 return _o(_j(*shards), _n)
 
             plans[pkey] = fn
-        return meet(comm, x, fn, self._abort_check(comm))
+        ck = _ig.spec(_CK_KINDS.get(kind, kind), opname, x) \
+            if _ig.on else None
+        return meet(comm, x, fn, self._abort_check(comm), ck)
 
     def allreduce_arr(self, comm, x, op: Op):
         if not self._eligible(comm, x) or (
@@ -1461,7 +1506,8 @@ class HbmCollModule(CollModule):
         def fn(shards):
             return [shards[root]] * comm.size
 
-        return meet(comm, x, fn, self._abort_check(comm))
+        ck = _ig.spec("bcast", "", x, root) if _ig.on else None
+        return meet(comm, x, fn, self._abort_check(comm), ck)
 
     def reduce_arr(self, comm, x, op: Op, root: int):
         if not _reduce_as_allreduce_var.value:

@@ -34,6 +34,7 @@ import numpy as np
 
 from ompi_tpu import obs as _obs
 from ompi_tpu import trace as _trace
+from ompi_tpu.obs import integrity as _ig
 from ompi_tpu.mca.params import registry
 from ompi_tpu.op.op import Op
 from ompi_tpu.pml.request import Request
@@ -188,6 +189,43 @@ def _group_plan(sig):
     return (tuple((opname, dt, tuple(slots))
                   for (opname, dt), slots in groups.items()),
             tuple(folds))
+
+
+def _fused_ck(mode, sig):
+    """Integrity spec for one fused batch (DESIGN.md §25): one claim
+    per deposit buffer — mesh mode digests each packed group buffer
+    (claim index = group index), hbm mode digests each slot array.
+    Returns None when any slot falls outside the checkable algebra
+    (gather folds, non-native reducers, unsupported dtypes): a partly
+    checked batch could not attribute a mismatch to one rank, so the
+    whole batch runs unchecked instead."""
+    ents = []
+    if mode == "hbm":
+        for i, (kind, _shape, dt, extra) in enumerate(sig):
+            if kind == "bcast":
+                s = _ig.spec_static("bcast", "", np.empty(0, dt), extra)
+                if s is None:
+                    return None
+                ents.append(("b", s[1], i, int(extra), s[2]))
+            elif kind == "allreduce":
+                s = _ig.spec_static("allreduce", extra, np.empty(0, dt))
+                if s is None:
+                    return None
+                ents.append(("g", s[1], i, (i,), s[2]))
+            else:
+                return None
+    else:
+        groups, folds = _group_plan(sig)
+        if folds:
+            return None
+        for gi, (opname, dt, slots) in enumerate(groups):
+            # bcast slots ride SUM groups root-masked to the identity,
+            # so the group conservation sum covers them exactly.
+            s = _ig.spec_static("allreduce", opname, np.empty(0, dt))
+            if s is None:
+                return None
+            ents.append(("g", s[1], gi, slots, s[2]))
+    return ("fused", tuple(ents))
 
 
 def _build_pack(dev, sig, slots, roots):
@@ -612,7 +650,9 @@ class _FusionEngine:
             # read the same arrays
             return [list(outs)] * size
 
-        return device.meet(comm, (sig, arrays), fn, self._abort_check)
+        ck = _fused_ck(mode, sig) if _ig.on else None
+        return device.meet(comm, (sig, arrays), fn, self._abort_check,
+                           ck)
 
 
 def _engine(comm) -> _FusionEngine:

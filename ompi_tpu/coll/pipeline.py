@@ -61,6 +61,7 @@ import numpy as np
 
 from ompi_tpu import trace as _trace
 from ompi_tpu.mca.params import registry
+from ompi_tpu.obs import integrity as _ig
 
 # interned span names for the per-kind dispatch spans (args: cid,
 # payload bytes, interned algorithm tag)
@@ -333,10 +334,12 @@ def _pull_segment(it, ph):
     return job
 
 
-def _run_pipelined(module, comm, jobs) -> List[Any]:
+def _run_pipelined(module, comm, jobs, ck=None) -> List[Any]:
     """Drive (value, fn) segment jobs through the async rendezvous
     with bounded depth.  Every begun handle is finished even on error
-    — peers park on the generation's refcounted results."""
+    — peers park on the generation's refcounted results.  ``ck`` is
+    the integrity-plane spec shared by every segment (each segment
+    takes its own sampling decision at the meet gate)."""
     from ompi_tpu.coll import device
     depth = max(1, _depth_var.value)
     check = module._abort_check(comm)
@@ -353,7 +356,8 @@ def _run_pipelined(module, comm, jobs) -> List[Any]:
             if job is None:
                 break
             value, fn = job
-            handles.append(device.meet_begin(comm, value, fn, check))
+            handles.append(device.meet_begin(comm, value, fn, check,
+                                             ck))
             pv_segments.add(1)
             if len(handles) > depth:
                 outs.append(device.meet_finish(comm, handles.popleft(),
@@ -444,9 +448,10 @@ def _mesh_seg_reduce(module, comm, x, op, alg: str):
         return device._scatter_out(jfn(g), mesh, size)
 
     pad = _pad_value(opname, dtype)
+    ck = _ig.spec("allreduce", opname, flat) if _ig.on else None
     outs = _run_pipelined(module, comm,
                           ((p, fn) for p in _flat_segments(flat, n, seg,
-                                                           pad)))
+                                                           pad)), ck)
     return _unpack_trim(comm, outs, n, seg).reshape(shape)
 
 
@@ -466,9 +471,11 @@ def _mesh_seg_bcast(module, comm, x, root: int):
         jfn = _seg_kernel("segbcast", mesh, seg, dtype, root)
         return device._scatter_out(jfn(g), mesh, size)
 
+    ck = _ig.spec("bcast", "", flat, root) if _ig.on else None
     outs = _run_pipelined(module, comm,
                           ((p, fn) for p in _flat_segments(flat, n, seg,
-                                                           dtype.type(0))))
+                                                           dtype.type(0))),
+                          ck)
     return _unpack_trim(comm, outs, n, seg).reshape(shape)
 
 
@@ -502,7 +509,8 @@ def _mesh_seg_alltoall(module, comm, x):
                     axis=1)
             yield sub.reshape(-1), fn
 
-    outs = _run_pipelined(module, comm, jobs())
+    ck = _ig.spec("alltoall", "", rows) if _ig.on else None
+    outs = _run_pipelined(module, comm, jobs(), ck)
     pieces = [o.reshape(size, m) for o in outs]
     tail = cols - (len(pieces) - 1) * m
     if tail != m:
@@ -539,9 +547,10 @@ def _hbm_seg_reduce(module, comm, x, op):
         return out_map(jbody(*shards), size)
 
     pad = _pad_value(opname, dtype)
+    ck = _ig.spec("allreduce", opname, flat) if _ig.on else None
     outs = _run_pipelined(module, comm,
                           ((p, fn) for p in _flat_segments(flat, n, seg,
-                                                           pad)))
+                                                           pad)), ck)
     return _unpack_trim(comm, outs, n, seg).reshape(shape)
 
 
@@ -570,7 +579,8 @@ def _hbm_seg_alltoall(module, comm, x):
                     axis=1)
             yield sub.reshape(-1), fn
 
-    outs = _run_pipelined(module, comm, jobs())
+    ck = _ig.spec("alltoall", "", rows) if _ig.on else None
+    outs = _run_pipelined(module, comm, jobs(), ck)
     pieces = [o.reshape(size, m) for o in outs]
     tail = cols - (len(pieces) - 1) * m
     if tail != m:

@@ -59,6 +59,7 @@ import numpy as np
 from ompi_tpu import obs as _obs
 from ompi_tpu import trace as _trace
 from ompi_tpu.coll import pipeline as _pl
+from ompi_tpu.obs import integrity as _ig
 from ompi_tpu.mca.params import registry
 from ompi_tpu.runtime import staging as _staging
 
@@ -141,10 +142,10 @@ class Plan:
     ``execute`` argument; everything else was decided at build."""
 
     __slots__ = ("alg", "alg_id", "nsegs", "seg", "total", "itemsize",
-                 "np_dtype", "pad_val", "fn", "meet", "device")
+                 "np_dtype", "pad_val", "fn", "meet", "device", "ck")
 
     def __init__(self, alg: str, nsegs: int, seg: int, np_dtype,
-                 pad_val, fn, meet, device) -> None:
+                 pad_val, fn, meet, device, ck=None) -> None:
         self.alg = alg
         self.alg_id = _ALG_ID[alg]
         self.nsegs = nsegs
@@ -156,6 +157,9 @@ class Plan:
         self.fn = fn
         self.meet = meet
         self.device = device
+        # integrity spec, built unconditionally (plans outlive
+        # arm/disarm); execute() re-gates on the live arm flag
+        self.ck = ck
 
     def execute(self, module, comm, flat, n: int):
         """The whole steady-state op.  Hot (once per large-message
@@ -169,7 +173,8 @@ class Plan:
         value = flat
         if n != self.total:
             value = _pack(comm, flat, n, self)
-        out = self.meet(comm, value, self.fn, module._abort_check(comm))
+        out = self.meet(comm, value, self.fn, module._abort_check(comm),
+                        self.ck if _ig.on else None)
         if n != self.total:
             out = _unpack(comm, out, n, self)
         pv_exec_us.add((time.perf_counter_ns() - ns0) // 1000,
@@ -347,7 +352,9 @@ def _build_mesh_plan(comm, alg: str, nsegs: int, seg: int, np_dtype,
 
     return Plan(alg, nsegs, seg, np_dtype,
                 _pl._pad_value(opname, np_dtype), fn, device.meet,
-                devs[comm.rank])
+                devs[comm.rank],
+                _ig.spec_static("allreduce", opname,
+                                np.empty(0, np_dtype)))
 
 
 def mesh_reduce(module, comm, x, op, alg: str):
@@ -397,7 +404,9 @@ def _build_hbm_plan(module, comm, nsegs: int, seg: int, np_dtype,
 
     return Plan("hbm", nsegs, seg, np_dtype,
                 _pl._pad_value(opname, np_dtype), fn, device.meet,
-                device_hint)
+                device_hint,
+                _ig.spec_static("allreduce", opname,
+                                np.empty(0, np_dtype)))
 
 
 def hbm_reduce(module, comm, x, op):

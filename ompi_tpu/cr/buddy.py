@@ -75,6 +75,11 @@ _pv_partner = _registry.register_pvar(
     "cr", "buddy", "partner_restores",
     help="Times this rank served a held partner copy to a restoring "
          "(typically respawned) rank")
+_pv_crc_fallback = _registry.register_pvar(
+    "cr", "buddy", "restore_crc_fallbacks",
+    help="Buddy restores abandoned because a rank's replica failed "
+         "its CRC (memory corruption in the in-memory tier): the "
+         "whole world falls one ladder rung to the fs epoch")
 _pv_us = _registry.register_pvar(
     "cr", "buddy", "replicate_us", var_class="highwatermark",
     help="Worst-case wall time of one buddy checkpoint (quiesce + "
@@ -300,7 +305,34 @@ def restore(comm) -> Optional[Any]:
             rbuf = np.empty(int(n[0]), dtype=np.uint8)
             comm.Recv(rbuf, supplier, _TAG_RESTORE + 1)
             bs["self"][restore_seq] = rbuf.tobytes()
-    out = _shard.loads(bs["self"][restore_seq], state.device)
+    # CRC-verify before trusting the in-memory replica (DESIGN.md
+    # §25 rode this in: a corrupting host flips bits in parked blobs
+    # too).  The verdict is AGREED — a single corrupt rank sends the
+    # whole world one ladder rung down to the fs epoch together,
+    # never a world split across checkpoint sequences.
+    from ompi_tpu.op.op import MIN
+    try:
+        out = _shard.loads(bs["self"][restore_seq], state.device)
+        ok = 1
+    except Exception:
+        # shard CRC mismatch (ValueError), or a decode blown up on
+        # corrupt metadata the per-shard CRCs don't cover — either
+        # way the replica is untrustworthy
+        out = None
+        ok = 0
+    good = np.array([ok], dtype=np.int64)
+    tot = np.empty(1, dtype=np.int64)
+    comm.Allreduce(good, tot, MIN)
+    if int(tot[0]) == 0:
+        if comm.rank == 0:
+            _pv_crc_fallback.add(1)
+        from ompi_tpu import obs as _obs
+        _obs.record_event(_obs.EV_CKPT_CRC_FALLBACK,
+                          restore_seq, rank=comm.rank)
+        raise RuntimeError(
+            f"buddy restore: replica CRC mismatch at seq "
+            f"{restore_seq} (in-memory tier corrupt) — falling back "
+            f"to the filesystem epoch")
     bs["committed"] = restore_seq
     comm.Barrier()
     return out

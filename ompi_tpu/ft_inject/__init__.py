@@ -45,7 +45,8 @@ _plan_var = registry.register(
          "delay, dup, reorder, corrupt, sever, daemon_kill, "
          "oob_sever, kv_partition, rank_kill, io_stall, io_partial, "
          "io_enospc, dvm_disconnect, rma_delay, kv_kill, dvm_kill, "
-         "host_kill, rdv_sever, host_slow, net_jitter (for the kill "
+         "host_kill, rdv_sever, host_slow, net_jitter, device_sdc, "
+         "corrupt_payload (for the kill "
          "classes the number is the armed OP COUNT the control-plane "
          "process dies at, not a rate; host_kill severs "
          "ft_inject_victim_host's whole failure domain — daemon plus "
@@ -55,7 +56,12 @@ _plan_var = registry.register(
          "GRAY failure: ft_inject_victim_host stays alive but every "
          "resident rank and its heartbeat run "
          "ft_inject_host_slow_factor times slow; net_jitter shapes "
-         "seeded latency/loss onto the tcp + KV client paths).  "
+         "seeded latency/loss onto the tcp + KV client paths; "
+         "device_sdc is the SILENT failure — ft_inject_victim_rank's "
+         "chip bit-flips its collective operand at the armed op "
+         "count, visible only to the integrity plane; "
+         "corrupt_payload flips tcp frame bytes BEYOND the header-CRC "
+         "span, exercising the payload digest above CRC).  "
          "Empty = framework disabled")
 _rate_var = registry.register(
     "ft", "inject", "rate", 0.02, float,
@@ -109,7 +115,19 @@ _jitter_loss_var = registry.register(
          "frame (tcp path only — the reliable sublayer retransmits; "
          "KV ops are never dropped, only delayed)")
 
-BTL_CLASSES = ("drop", "delay", "dup", "reorder", "corrupt", "sever")
+_sdc_period_var = registry.register(
+    "ft", "inject", "sdc_period", 0, int,
+    help="device_sdc repeat period after the first armed flip (every "
+         "Nth subsequent collective on the victim also flips); 0 = "
+         "one-shot — probes measuring detection RATE arm a period so "
+         "one run carries many independent flips")
+
+# corrupt_payload flips frame bytes OUTSIDE the header-CRC span (the
+# header CRC stays valid by construction — equivalent to recomputing
+# it after the flip), so only the reliable layer's payload digest
+# (btl_tcp_payload_digest) can catch it
+BTL_CLASSES = ("drop", "delay", "dup", "reorder", "corrupt", "sever",
+               "corrupt_payload")
 NODE_CLASSES = ("daemon_kill", "oob_sever")
 # checkpoint-I/O faults, consumed by the cr/ckpt shard-write path:
 #   io_stall   — the write is held delay_ms before hitting the disk
@@ -317,6 +335,61 @@ def rdv_sever_injector(rank: int,
     if "rdv_sever" not in p or rank not in victim_ranks(size):
         return None
     return RdvSeverInjector(rank, p["rdv_sever"])
+
+
+# silent data corruption (DESIGN.md §25): the victim rank's chip
+# bit-flips its own collective operand AFTER the integrity gate
+# digests it — no error, no slowdown, no heartbeat change; only the
+# integrity plane's sampled cross-check can see it
+SDC_CLASSES = ("device_sdc",)
+
+
+class SdcInjector:
+    """Deterministic operand bit-flip at the device-collective meet:
+    fires at the armed op count (the RdvSeverInjector model — no RNG,
+    replays bit-for-bit) and then, when ft_inject_sdc_period > 0,
+    every period-th collective after that, so one chaos run carries
+    many independent flips for detection-RATE measurement."""
+
+    def __init__(self, rank: int, after_ops: float, period: int = 0) -> None:
+        self.rank = rank
+        # a rate below 1 (the bare-class default) means "no explicit
+        # count": arm a post-bring-up default
+        self.after_ops = int(after_ops) if after_ops >= 1 else 8
+        self.period = max(0, int(period))
+        self._count = 0
+        self.flips = 0
+        self.last_flip_ns = 0
+
+    def should_flip(self) -> bool:
+        self._count += 1
+        n = self._count
+        if n < self.after_ops:
+            return False
+        if n > self.after_ops:
+            if self.period <= 0 or (n - self.after_ops) % self.period:
+                return False
+        self.flips += 1
+        import time as _time
+        self.last_flip_ns = _time.monotonic_ns()
+        from ompi_tpu import obs as _obs
+        from ompi_tpu import trace
+        tr = trace.current_tracer()
+        if tr is not None:
+            tr.instant("ft_inject", "fault", cls="device_sdc",
+                       scope="coll", rank=self.rank)
+        _obs.record_event(_obs.EV_FT_INJECT,
+                          _obs.intern("device_sdc"),
+                          _obs.intern("coll"), rank=self.rank)
+        return True
+
+
+def sdc_injector(rank: int,
+                 size: Optional[int] = None) -> Optional[SdcInjector]:
+    p = plan()
+    if "device_sdc" not in p or rank not in victim_ranks(size):
+        return None
+    return SdcInjector(rank, p["device_sdc"], _sdc_period_var.value)
 
 
 class RmaInjector(_Scoped):

@@ -302,6 +302,11 @@ EV_HOST_DEGRADED = 31
 EV_HOST_QUARANTINE = 32
 EV_HOST_RECOVERED = 33
 EV_MIGRATE = 34
+# silent-data-corruption plane (DESIGN.md §25): sampled check
+# mismatch, bisection conviction of a rank/chip, retry-from-source
+EV_SDC_MISMATCH = 35
+EV_SDC_CONVICT = 36
+EV_SDC_RETRY = 37
 
 EVENT_NAMES = (
     "ulfm_detect", "ulfm_revoke", "ulfm_agree", "ulfm_shrink",
@@ -312,7 +317,7 @@ EVENT_NAMES = (
     "dvm_rehydrate", "dvm_replay", "host_lost", "host_respawn",
     "req_attach", "req_run", "req_park", "req_resume", "wd_stall",
     "req_drain", "host_degraded", "host_quarantine", "host_recovered",
-    "dvm_migrate",
+    "dvm_migrate", "sdc_mismatch", "sdc_convict", "sdc_retry",
 )
 
 # Per-type argument field names (positional a0..a3); a trailing "$"
@@ -354,6 +359,9 @@ EVENT_FIELDS = (
     ("host", "score", "sessions"),           # host_quarantine
     ("host", "score"),                       # host_recovered
     ("sid", "host", "us"),                   # dvm_migrate
+    ("cid", "seq", "kind$"),                 # sdc_mismatch
+    ("rank", "host", "kind$"),               # sdc_convict
+    ("cid", "seq", "rank"),                  # sdc_retry
 )
 
 # interned strings for event args (reason/cls/scope): the ring holds
@@ -775,6 +783,10 @@ def attach(state) -> None:
     is-None check — the same contract as the tracer slot."""
     register_pvars()
     recorder()
+    # arm (or refresh) the SDC-detection plane from its knobs — the
+    # coll meet path reads the integrity module's cached flag only
+    from ompi_tpu.obs import integrity as _integrity
+    _integrity.refresh()
     iv = _interval_var.value
     if iv and iv > 0 and state.tracer is not None:
         sc = Scraper(state.tracer, iv)

@@ -235,6 +235,10 @@ class HealthPlane:
         self.qwait_us = [0] * n
         self.kv_rtt_us = [0] * n
         self.io_stalls = [0] * n
+        # sdc convictions (DESIGN.md §25): unlike the graded signals
+        # above, a conviction is decisive evidence — one poisons the
+        # host straight to quarantined, no hysteresis
+        self.sdc = [0] * n
         # state machine (all preallocated ints)
         self.score = [0] * n
         self.state = [0] * n
@@ -244,6 +248,7 @@ class HealthPlane:
         self.excluded = [0] * n  # dead/rehydrating: server-maintained
         self.degraded_n = 0      # hosts at state >= 1 (controller reads)
         self.quarantined_n = 0
+        self.sdc_n = 0           # hosts carrying an sdc conviction
 
     # -- signal ingestion (cold paths) ---------------------------------
 
@@ -272,6 +277,18 @@ class HealthPlane:
     def note_io_stall(self, h: int, n: int = 1) -> None:
         if 0 <= h < self.hosts and n > 0:
             self.io_stalls[h] += int(n)
+
+    def note_sdc(self, h: int, n: int = 1) -> None:
+        """An integrity conviction (obs/integrity) landed on host
+        ``h``: decisive — the next tick quarantines the host outright
+        (a chip computing wrong answers cannot be widened around)."""
+        if 0 <= h < self.hosts and n > 0:
+            self.sdc[h] += int(n)
+            c = 0
+            for x in self.sdc:
+                if x > 0:
+                    c += 1
+            self.sdc_n = c
 
     # -- the audited hot half ------------------------------------------
 
@@ -307,15 +324,39 @@ class HealthPlane:
         downs = self.down_streak
         pend = self.pending
         excl = self.excluded
+        sdc = self.sdc
         n = self.hosts
         hit = 0
         deg = 0
         quar = 0
+        sdcn = 0
         h = 0
         while h < n:
-            if excl[h] == 1 or last[h] == 0:
-                # dead / rehydrating / never-beaten domains belong to
-                # the liveness plane, not the gray-failure plane
+            if excl[h] == 1:
+                # dead / rehydrating domains belong to the liveness
+                # plane, not the gray-failure plane
+                score[h] = 0
+                ups[h] = 0
+                h += 1
+                continue
+            if sdc[h] > 0:
+                # sdc conviction: decisive, no hysteresis — wrong
+                # answers are worse than slow ones, and the conviction
+                # itself proves the chip is alive (DESIGN.md §25)
+                sdcn += 1
+                score[h] = 100
+                ups[h] = 0
+                downs[h] = 0
+                if state[h] != QUARANTINED:
+                    state[h] = QUARANTINED
+                    pend[h] = 1
+                    hit += 1
+                deg += 1
+                quar += 1
+                h += 1
+                continue
+            if last[h] == 0:
+                # never-beaten domains have no gray-failure evidence
                 score[h] = 0
                 ups[h] = 0
                 h += 1
@@ -381,6 +422,7 @@ class HealthPlane:
             h += 1
         self.degraded_n = deg
         self.quarantined_n = quar
+        self.sdc_n = sdcn
         return hit
 
     # -- the cold half --------------------------------------------------
@@ -433,6 +475,12 @@ class HealthPlane:
         self.qwait_us[h] = 0
         self.kv_rtt_us[h] = 0
         self.io_stalls[h] = 0
+        self.sdc[h] = 0
+        c = 0
+        for x in self.sdc:
+            if x > 0:
+                c += 1
+        self.sdc_n = c
         self.est.last_ns[h] = 0
         self.est.ewma_ns[h] = 0
         self.est.jitter_ns[h] = 0
@@ -457,6 +505,8 @@ class HealthPlane:
         out: List[str] = []
         if not 0 <= h < self.hosts:
             return out
+        if self.sdc[h] > 0:
+            out.append("sdc")
         expect = self.expect_ns
         ew = self.est.ewma_ns[h]
         if ew > 0 and ew * 100 // expect > 150:
@@ -484,6 +534,7 @@ class HealthPlane:
                 "beat_jitter_ms": self.est.jitter_ns[h] // 1_000_000,
                 "grace_ms": self.est.grace[h] // 1_000_000,
                 "rdv_skew_us": self.rdv_skew_us[h],
+                "sdc": self.sdc[h],
                 "signals": self.tripped(h),
                 "excluded": bool(self.excluded[h]),
             })

@@ -112,7 +112,10 @@ class FleetController:
         if wf > self.wd_base_pct * 2:
             wf = self.wd_base_pct * 2
         hp = getattr(srv, "health", None)
-        if hp is not None and hp.degraded_n > 0:
+        if hp is not None and (hp.degraded_n > 0 or hp.sdc_n > 0):
+            # an sdc conviction (DESIGN.md §25) counts here too: the
+            # convicted host is mid-drain and its retried collectives
+            # inflate session run times the same way a slow host does.
             # gray-failure mitigation (DESIGN.md §24): a degraded
             # host runs slow ON PURPOSE while the health plane holds
             # it — widen the shed margin and the watchdog tolerance

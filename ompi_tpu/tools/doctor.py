@@ -128,6 +128,22 @@ def _straggler_lines(doc: dict) -> List[str]:
     return out
 
 
+def _sdc_lines(doc: dict) -> List[str]:
+    """Silent-data-corruption attribution (DESIGN.md §25): the
+    capture carries the pool's integrity-conviction rows.  A convicted
+    chip names itself — the operator's fix is to keep the host
+    quarantined (and RMA the chip), not to debug the model."""
+    out: List[str] = []
+    for rec in doc.get("sdc") or []:
+        out.append(
+            f"  CONVICTED: rank {rec.get('rank')} on host "
+            f"{rec.get('host')} (comm cid={rec.get('cid')}, op "
+            f"{rec.get('kind')}) produced a corrupt collective "
+            f"operand — detected by the integrity plane, op retried "
+            f"on pristine operands")
+    return out
+
+
 def verdict(doc: dict) -> List[str]:
     """The reduced diagnosis for one capture, most specific evidence
     first.  Pure (testable on a dict); returns printable lines."""
@@ -146,6 +162,12 @@ def verdict(doc: dict) -> List[str]:
         lines.append(
             f"  ULFM: world already carries aborted ranks "
             f"{doc['aborted']} — the stall is downstream of a fault")
+    sdc = _sdc_lines(doc)
+    if sdc:
+        lines.append("SDC VERDICT: the integrity plane convicted "
+                     "corrupting chip(s) — quarantine is the fix, "
+                     "results were already repaired by retry:")
+        lines.extend(sdc)
     rdv = _rdv_lines(doc)
     fen = _fence_lines(doc)
     if rdv:

@@ -218,6 +218,12 @@ class DvmDisconnect(DvmError):
     dedups against its journal, so the job runs exactly once."""
 
 
+def _integrity_snapshot() -> list:
+    """Process-global sdc conviction rows for doctor reports."""
+    from ompi_tpu.obs import integrity as _integrity
+    return _integrity.convicted_snapshot()
+
+
 def _send(sock: socket.socket, obj: dict) -> None:
     data = json.dumps(obj).encode()
     sock.sendall(struct.pack(">I", len(data)) + data)
@@ -698,6 +704,17 @@ class DVMServer:
                     expect_beat_ns=max(50_000_000,
                                        self._host_grace_ns // 6),
                     floor_grace_ns=self._host_grace_ns)
+                # sdc plane (DESIGN.md §25): a collective-integrity
+                # conviction on any resident rank feeds the decisive
+                # per-host sdc signal — next health tick quarantines
+                from ompi_tpu.obs import integrity as _integrity
+                hp = self.health
+
+                def _on_sdc(rec, _hp=hp):
+                    _hp.note_sdc(int(rec.get("host", 0)))
+
+                self._sdc_hook = _on_sdc
+                _integrity.install_convict_hook(_on_sdc)
         _pv_hosts_active.add(self.hosts)
         self.listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self.listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -765,6 +782,10 @@ class DVMServer:
 
     def stop(self) -> None:
         self._drain()
+        if getattr(self, "_sdc_hook", None) is not None:
+            from ompi_tpu.obs import integrity as _integrity
+            _integrity.remove_convict_hook(self._sdc_hook)
+            self._sdc_hook = None
         if self._journal is not None:
             # orderly stop == clean halt: drop the journal, nothing
             # should rehydrate from an intentional shutdown
@@ -1292,6 +1313,7 @@ class DVMServer:
             "hosts_rehydrating": self.hosts_rehydrating,
             "host_health": (self.health.snapshot()
                             if self.health is not None else None),
+            "sdc": _integrity_snapshot(),
             "ctrl": None if self.ctrl is None else {
                 "ticks": self.ctrl.ticks,
                 "shed_margin_pct": self.ctrl.shed_margin_pct,
@@ -2394,6 +2416,9 @@ class DVMServer:
             # resident on a scored-sick host) from an absent rank
             "host_health": (self.health.snapshot()
                             if self.health is not None else None),
+            # sdc convictions (DESIGN.md §25): the doctor's integrity
+            # verdict names the convicted chip from these rows
+            "sdc": _integrity_snapshot(),
             "placement": [self._place_node(sess, r)
                           for r in range(sess.np)],
         }

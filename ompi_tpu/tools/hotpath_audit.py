@@ -131,6 +131,16 @@ HOT_FUNCTIONS: Dict[str, Tuple[str, ...]] = {
     "ompi_tpu/obs/health.py": (
         "HealthPlane.tick",
     ),
+    # the sdc-integrity plane (DESIGN.md §25) touches EVERY device
+    # collective when armed: sample() is the 1-in-N countdown gate on
+    # the meet path (integer decrement over a preallocated per-comm
+    # list), fold() combines per-rank digests at verify time.  The
+    # expensive halves — host copies, digesting, bisection, retry —
+    # run only on the sampled 1-in-N ops inside gate()/_run_checked
+    "ompi_tpu/obs/integrity.py": (
+        "sample",
+        "fold",
+    ),
 }
 
 _BANNED_BUILTIN_CALLS = ("dict", "list", "set", "tuple", "frozenset")

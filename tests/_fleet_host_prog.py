@@ -11,7 +11,15 @@ Ranks on the dead host exit 0 the moment they see themselves in the
 failure set (a killed host's ranks do not get to finalize; in the
 in-process harness the thread stands in for the vanished process).
 
-argv: tag steps
+argv: tag steps [kill_rank:kill_step]
+
+The optional third argument makes the death fully deterministic:
+world rank ``kill_rank`` calls ``ulfm.kill_now`` at the top of step
+``kill_step`` of its FIRST incarnation — a step-boundary kill with no
+wall-clock timer in the loop, for tests that compose this workload
+with other fault classes and must not race the victim's init window
+(the timer-armed mid-op variant stays covered by the ``rank_kill``
+chaos matrices).
 
 Every survivor prints ``SHRINKS {tag} {rank} {n}`` and
 ``DIGEST {tag} {sha256}``; the test asserts n == 1 everywhere and all
@@ -29,6 +37,9 @@ from ompi_tpu.op import op as mpi_op
 
 tag = sys.argv[1]
 steps = int(sys.argv[2])
+kill_rank, kill_step = (-1, -1)
+if len(sys.argv) > 3:
+    kill_rank, kill_step = (int(x) for x in sys.argv[3].split(":"))
 
 comm = ompi_tpu.init()
 me = comm.rank
@@ -50,6 +61,12 @@ while step < steps:
         # BEFORE each op — a dead rank must never meet survivors that
         # already shrank around it.
         sys.exit(0)
+    if me == kill_rank and step == kill_step and shrinks == 0:
+        # deterministic step-boundary death (first incarnation only):
+        # RankKilled propagates out of runpy and the pool runner
+        # publishes it exactly like the timer-armed rank_kill path
+        from ompi_tpu.ft import ulfm as _ulfm
+        _ulfm.kill_now(comm.state)
     contrib = np.full(32, float((step + 1) * (work.rank + 1)),
                       np.float64)
     r = np.empty_like(contrib)

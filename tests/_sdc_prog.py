@@ -1,0 +1,39 @@
+"""SDC chaos workload (run by test_integrity.py against a multi-host
+DVM pool): a stepped device allreduce whose analytic result is known
+on every rank, so each step self-verifies.  With device_sdc armed on
+the victim rank and the integrity plane sampling every op, every flip
+must be detected at the rendezvous, the op retried from pristine
+sources, and every rank's result stays byte-exact — the prog prints
+``SDC {tag} {rank} ok`` only when all steps matched.
+
+argv: tag steps
+"""
+import sys
+
+import numpy as np
+
+import ompi_tpu
+from ompi_tpu.op import op as mpi_op
+
+tag = sys.argv[1]
+steps = int(sys.argv[2])
+
+comm = ompi_tpu.init()
+rank, size = comm.rank, comm.size
+expect = float(size * (size + 1) // 2)
+ok = True
+for _step in range(steps):
+    if comm.state.device is not None:
+        import jax.numpy as jnp
+        x = jnp.full((32,), float(rank + 1), jnp.float32)
+        got = np.asarray(comm.allreduce_arr(x, mpi_op.SUM))
+    else:
+        x = np.full(32, rank + 1.0, np.float32)
+        got = np.empty_like(x)
+        comm.Allreduce(x, got, mpi_op.SUM)
+    if not np.array_equal(got, np.full(32, expect, np.float32)):
+        ok = False
+# one atomic write: rank-threads share the session stdout buffer
+sys.stdout.write(f"SDC {tag} {rank} {'ok' if ok else 'bad'}\n")
+sys.stdout.flush()
+ompi_tpu.finalize()

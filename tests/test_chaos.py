@@ -55,7 +55,8 @@ def reference(tmp_path_factory):
 
 
 @pytest.mark.parametrize("plan", ["drop", "delay", "dup", "reorder",
-                                  "corrupt", "sever"])
+                                  "corrupt", "corrupt_payload",
+                                  "sever"])
 def test_btl_fault_class_byte_identical(plan, reference, tmp_path):
     """Each frame-level fault class, alone, at the fixed seed: the
     reliable sublayer must absorb it and the digest must match the
